@@ -1,0 +1,53 @@
+"""horovod_tpu: a TPU-native data-parallel training framework.
+
+A from-scratch rebuild of the capabilities of the reference system
+(``agileml/horovod`` -- see SURVEY.md): the NCCL/MPI collective op layer is
+re-implemented over XLA collectives on the ICI/DCN device mesh, the tensor
+fusion buffer is an HBM-resident bucketing pass at trace time, the response
+cache is a compiled-executable cache, and the background coordinator thread
+disappears entirely under SPMD.
+
+Public API (mirrors ``import horovod.torch as hvd`` surface)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(optax.adamw(1e-3),
+                                   compression=hvd.Compression.bf16)
+    step = hvd.make_train_step(loss_fn, opt)
+"""
+
+from .core.basics import (  # noqa: F401
+    init, shutdown, is_initialized, mesh, reduce_axes,
+    size, rank, local_size, local_rank, cross_size, cross_rank,
+    is_homogeneous, nccl_built, mpi_built, gloo_built, tpu_built,
+    mpi_threads_supported,
+)
+from .core.exceptions import (  # noqa: F401
+    HorovodTpuError, HorovodInternalError, HostsUpdatedInterrupt,
+    NotInitializedError, ProcessSetError,
+)
+from .core.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, get_process_set,
+    process_set_names,
+)
+from .collectives.reduce_op import (  # noqa: F401
+    ReduceOp, Average, Sum, Min, Max, Product, Adasum,
+)
+from .collectives.compression import Compression  # noqa: F401
+from .collectives import ops  # noqa: F401  (in-step collectives)
+from .collectives.eager import (  # noqa: F401
+    allreduce, allreduce_async, grouped_allreduce, allgather, broadcast,
+    reducescatter, alltoall, barrier, join, synchronize, poll, local_result,
+)
+from .optim.distributed import (  # noqa: F401
+    DistributedOptimizer, DistributedAdasumOptimizer, allreduce_gradients,
+)
+from .optim.functions import (  # noqa: F401
+    broadcast_parameters, broadcast_optimizer_state, broadcast_object,
+)
+from .training import (  # noqa: F401
+    make_train_step, make_eval_step, shard_batch, replicate,
+    batch_sharding, replicated_sharding,
+)
+
+__version__ = "0.1.0"

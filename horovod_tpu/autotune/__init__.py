@@ -1,0 +1,82 @@
+"""Online autotuning of the fusion threshold (ParameterManager analogue).
+
+The reference (``horovod/common/parameter_manager.cc`` + Bayesian
+optimization in ``optim/bayesian_optimization.cc``) tunes fusion threshold
+and cycle time against observed throughput.  On TPU there is no cycle time
+(no background loop), so the tunable surface is the gradient bucket size.
+Round-1 implementation is the reference's documented fallback strategy --
+discrete candidate sweep scored by observed step throughput -- with the GP
+surrogate as a later upgrade.
+
+Usage: the training loop reports ``record_step(seconds, bytes)`` each step;
+every ``steps_per_sample`` steps the tuner moves to the next candidate, and
+after one full sweep it locks in the argmax.  ``HOROVOD_AUTOTUNE=1``
+enables it; ``HOROVOD_AUTOTUNE_LOG`` writes the CSV of samples, matching
+the reference's warm-start log format in spirit.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+_MiB = 1024 * 1024
+_CANDIDATES = [2 * _MiB, 8 * _MiB, 32 * _MiB, 64 * _MiB, 128 * _MiB]
+
+
+class Autotuner:
+    def __init__(self, config, steps_per_sample: int = 10,
+                 candidates: Optional[List[int]] = None):
+        self.candidates = list(candidates or _CANDIDATES)
+        base = config.fusion_threshold
+        if base not in self.candidates:
+            self.candidates.append(base)
+        self.steps_per_sample = steps_per_sample
+        self.log_path = config.autotune_log
+        self._idx = 0
+        self._step = 0
+        self._accum_s = 0.0
+        self._accum_bytes = 0
+        self._scores: List[float] = []
+        self._best: Optional[int] = None
+        self._samples: List[tuple] = []
+
+    def fusion_threshold(self) -> int:
+        if self._best is not None:
+            return self._best
+        return self.candidates[self._idx]
+
+    @property
+    def done(self) -> bool:
+        return self._best is not None
+
+    def record_step(self, seconds: float, nbytes: int) -> None:
+        """Report one training step's wall time and gradient bytes."""
+        if self._best is not None:
+            return
+        self._accum_s += seconds
+        self._accum_bytes += nbytes
+        self._step += 1
+        if self._step < self.steps_per_sample:
+            return
+        score = self._accum_bytes / max(self._accum_s, 1e-9)  # bytes/s
+        self._samples.append((self.candidates[self._idx], score))
+        self._scores.append(score)
+        self._step = 0
+        self._accum_s = 0.0
+        self._accum_bytes = 0
+        self._idx += 1
+        if self._idx >= len(self.candidates):
+            best_i = max(range(len(self._scores)),
+                         key=lambda i: self._scores[i])
+            self._best = self.candidates[best_i]
+            self._write_log()
+
+    def _write_log(self) -> None:
+        if not self.log_path:
+            return
+        with open(self.log_path, "w") as f:
+            f.write("fusion_threshold_bytes,score_bytes_per_s\n")
+            for thr, score in self._samples:
+                f.write(f"{thr},{score}\n")
+            f.write(f"# best,{self._best}\n")

@@ -1,0 +1,71 @@
+"""Gradient compression (``hvd.Compression`` parity).
+
+Reference: ``horovod/torch/compression.py`` -- ``Compression.none`` and
+``Compression.fp16`` cast the tensor down before the allreduce and back up
+after.  On TPU the natural low-precision wire format is bfloat16 (same
+exponent range as fp32 -- no loss scaling needed, and the MXU/ICI path is
+optimized for it), so ``bf16`` is provided alongside ``fp16``; both halve
+bytes-on-the-wire for fp32 gradients.
+
+The cast is emitted inside the traced step, so XLA fuses it with the
+fusion-buffer pack and the collective kernel -- the "compression" costs no
+extra HBM round trip.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Compress/decompress around a collective."""
+
+    @staticmethod
+    def compress(tensor):
+        """Return (compressed_tensor, context_for_decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype = None  # set by subclasses
+
+    @classmethod
+    def compress(cls, tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating) and \
+                jnp.dtype(dtype).itemsize > jnp.dtype(cls.wire_dtype).itemsize:
+            return tensor.astype(cls.wire_dtype), dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else tensor.astype(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression.{none,fp16}`` plus TPU ``bf16``."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

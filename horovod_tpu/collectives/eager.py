@@ -1,0 +1,265 @@
+"""Host-level (eager) collective API with async handles.
+
+This is the analogue of the reference's enqueue surface
+(``horovod/common/operations.cc::EnqueueTensorAllreduce`` + the
+``handle``/``synchronize``/``poll`` machinery of
+``horovod/torch/mpi_ops.py``) for code running *outside* a traced step --
+parameter broadcasts, metric averaging, tests.
+
+Data model ("rank-stacked" arrays):
+
+* single process: the input carries a leading axis of length
+  ``process_set.size()`` -- element ``i`` is rank ``i``'s tensor.  The
+  result has the same shape (every rank's post-collective value).
+* multi-process: each process passes its *local* stack of shape
+  ``[local_ranks_in_set, ...]`` and receives its local stack back; the
+  global array is assembled with ``jax.make_array_from_process_local_data``.
+
+Dispatch path: the request signature (op kind, name, shape, dtype, reduce
+op, process set -- exactly the reference's ``Request`` wire fields) keys the
+:class:`~horovod_tpu.controller.cache.ExecutableCache`; a hit reuses the
+compiled ``shard_map`` program (ResponseCache bitvector fast path
+analogue), a miss traces + compiles one.  JAX dispatch is asynchronous, so
+``*_async`` returns a handle immediately and ``synchronize`` blocks --
+matching the reference's semantics without a background thread.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import ops as _ops
+from .compression import Compression
+from .reduce_op import ReduceOp, Average, Sum
+from ..controller.cache import signature
+from ..core import process_sets as _ps
+from ..core.state import global_state
+from ..parallel.mesh import HVD_AXIS
+
+
+def _is_multiprocess(mesh: Mesh) -> bool:
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def _to_global(x, mesh: Mesh):
+    """Assemble the rank-stacked global array on the eager mesh."""
+    n = int(mesh.devices.size)
+    sharding = NamedSharding(mesh, P(HVD_AXIS))
+    if _is_multiprocess(mesh):
+        local = np.asarray(x)
+        if local.ndim == 0 or local.shape[0] != \
+                sum(1 for d in mesh.devices.flat
+                    if d.process_index == jax.process_index()):
+            local = np.stack([local] * max(1, jax.local_device_count()))
+        global_shape = (n,) + local.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, local, global_shape)
+    x = jnp.asarray(x)
+    if x.ndim == 0 or x.shape[0] != n:
+        raise ValueError(
+            f"eager collectives take rank-stacked input: expected leading "
+            f"axis {n} (process-set size), got shape {x.shape}")
+    return jax.device_put(x, sharding)
+
+
+def _run(kind: str, x, name: Optional[str], ps, per_rank_fn, op_label: str,
+         out_rank_stacked: bool = True):
+    """Shared eager dispatch: cache lookup -> shard_map program -> run."""
+    st = global_state()
+    ps = _ps.get_process_set(ps)
+    mesh = ps.flat_mesh()
+    arr = _to_global(x, mesh)
+    key = signature(kind, name, (tuple(arr.shape), str(arr.dtype)), op_label,
+                    ps.name)
+    timeline = st.timeline
+
+    def build():
+        def spmd(block):
+            # block: [1, ...] -- this device's rank tensor.
+            y = per_rank_fn(block[0])
+            return y[None]
+        f = jax.shard_map(spmd, mesh=mesh, in_specs=P(HVD_AXIS),
+                          out_specs=P(HVD_AXIS))
+        return jax.jit(f)
+
+    if timeline:
+        with timeline.range(name or kind, "NEGOTIATE_" + kind.upper()):
+            fn = st.cache.get_or_build(key, build)
+        with timeline.range(name or kind, kind.upper()):
+            out = fn(arr)
+    else:
+        fn = st.cache.get_or_build(key, build)
+        out = fn(arr)
+    return out
+
+
+def local_result(out) -> np.ndarray:
+    """This process's portion of a rank-stacked result (multi-process), or
+    the whole stack (single process)."""
+    shards = sorted(out.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+# ---------------------------------------------------------------------------
+# Handle table (HandleManager analogue, horovod/torch/handle_manager.cc).
+# ---------------------------------------------------------------------------
+
+_handle_lock = threading.Lock()
+_handle_counter = itertools.count(1)
+_handles: Dict[int, Any] = {}
+
+
+def _alloc_handle(value) -> int:
+    with _handle_lock:
+        h = next(_handle_counter)
+        _handles[h] = value
+        return h
+
+
+def synchronize(handle: int):
+    """Block until the async op completes and return its result."""
+    with _handle_lock:
+        value = _handles.pop(handle)
+    return jax.block_until_ready(value)
+
+
+def poll(handle: int) -> bool:
+    """True when the async op has finished (result ready to fetch)."""
+    with _handle_lock:
+        value = _handles.get(handle)
+    if value is None:
+        return True
+    try:
+        return all(not a.is_deleted() and a.is_ready()
+                   for a in jax.tree.leaves(value))
+    except AttributeError:  # pragma: no cover - older jax
+        jax.block_until_ready(value)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Public eager collectives.
+# ---------------------------------------------------------------------------
+
+def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
+              process_set=None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0, compression=Compression.none):
+    def per_rank(t):
+        c, ctx = compression.compress(t)
+        r = _ops.allreduce(c, op, axes=(HVD_AXIS,),
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+        return compression.decompress(r, ctx)
+    # Every parameter that changes the compiled program must be in the
+    # cache key (the reference's Request carries the same distinctions).
+    label = (f"{op}|pre={prescale_factor}|post={postscale_factor}|"
+             f"{compression.__name__}")
+    return _run("allreduce", x, name, process_set, per_rank, label)
+
+
+def allreduce_async(x, op: ReduceOp = Average, *, name=None, process_set=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    compression=Compression.none) -> int:
+    out = allreduce(x, op, name=name, process_set=process_set,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor, compression=compression)
+    return _alloc_handle(out)
+
+
+def grouped_allreduce(xs: Sequence, op: ReduceOp = Average, *, name=None,
+                      process_set=None, compression=Compression.none):
+    """Fused multi-tensor eager allreduce (grouped_allreduce parity).
+
+    Tensors are fused per dtype (concatenating mixed dtypes would silently
+    promote); each dtype bucket dispatches one collective.
+    """
+    xs = [jnp.asarray(x) for x in xs]
+    if not xs:
+        return []
+    ps = _ps.get_process_set(process_set)
+    n = ps.size()
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, x in enumerate(xs):
+        by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
+    out: List[Any] = [None] * len(xs)
+    for dt, idxs in by_dtype.items():
+        flats = [xs[i].reshape(n, -1) for i in idxs]
+        widths = [f.shape[1] for f in flats]
+        fused = flats[0] if len(flats) == 1 else jnp.concatenate(flats, axis=1)
+        red = allreduce(fused, op,
+                        name=f"{name or 'grouped_allreduce'}.{dt.name}",
+                        process_set=process_set, compression=compression)
+        off = 0
+        for i, w in zip(idxs, widths):
+            out[i] = red[:, off:off + w].reshape(xs[i].shape)
+            off += w
+    return out
+
+
+def allgather(x, *, name=None, process_set=None):
+    """Each rank contributes its slice; all receive the concatenation.
+
+    Rank-stacked input ``[n, d0, ...]`` -> output ``[n, n*d0, ...]``."""
+    def per_rank(t):
+        return _ops.allgather(t, axes=(HVD_AXIS,), axis=0)
+    return _run("allgather", x, name, process_set, per_rank, "gather")
+
+
+def broadcast(x, root_rank: int = 0, *, name=None, process_set=None):
+    ps = _ps.get_process_set(process_set)
+    # root_rank is a global rank (reference semantics); on the member-only
+    # eager mesh it maps to the root's position within the set.
+    if ps.is_global():
+        root_pos = root_rank
+        if not 0 <= root_rank < ps.size():
+            raise ValueError(f"broadcast root_rank {root_rank} out of range "
+                             f"for world size {ps.size()}")
+    else:
+        if root_rank not in ps.ranks:
+            raise ValueError(f"broadcast root_rank {root_rank} is not a "
+                             f"member of process set {ps.name!r} "
+                             f"(ranks {ps.ranks})")
+        root_pos = ps.ranks.index(root_rank)
+
+    def per_rank(t):
+        return _ops.broadcast(t, root_pos, axes=(HVD_AXIS,))
+    return _run("broadcast", x, name, ps, per_rank, f"root{root_rank}")
+
+
+def reducescatter(x, op: ReduceOp = Average, *, name=None, process_set=None):
+    def per_rank(t):
+        return _ops.reducescatter(t, op, axes=(HVD_AXIS,))
+    return _run("reducescatter", x, name, process_set, per_rank, str(op))
+
+
+def alltoall(x, *, name=None, process_set=None):
+    def per_rank(t):
+        return _ops.alltoall(t, axes=(HVD_AXIS,))
+    return _run("alltoall", x, name, process_set, per_rank, "a2a")
+
+
+def barrier(*, process_set=None) -> None:
+    """Block until every member device reaches the barrier."""
+    ps = _ps.get_process_set(process_set)
+    n = ps.size()
+    out = _run("barrier", jnp.ones((n, 1), jnp.int32), "barrier", ps,
+               lambda t: _ops.barrier(axes=(HVD_AXIS,)) * t, "barrier")
+    jax.block_until_ready(out)
+
+
+def join() -> int:
+    """SPMD parity stub for ``hvd.join()``.
+
+    Under SPMD every device executes every step, so there are no stragglers
+    to drain; join degenerates to a barrier.  Returns -1 ("no rank joined
+    last"), matching the reference's return convention.
+    """
+    barrier()
+    return -1

@@ -1,0 +1,291 @@
+"""In-step collective ops: the XLA-collective re-implementation of the
+reference's op layer.
+
+This is the TPU-native replacement for ``horovod/common/ops/nccl_operations.cc``
+(``NCCLAllreduce``, ``NCCLAllgather``, ``NCCLBroadcast``, ``NCCLAlltoall``,
+``NCCLReducescatter``) and ``mpi_operations.cc``: every collective is a
+``jax.lax`` primitive emitted *inside* a ``jax.shard_map``-traced program
+over the ICI/DCN mesh, so XLA schedules the DMA over the physical links --
+there is no user-level comm library, no streams, no fusion-buffer memcpy
+kernels.  Pre/post-scaling (the reference's CUDA ``ScaleBuffer`` kernels)
+become fused elementwise multiplies.
+
+All functions here must be called inside a traced context that binds the
+mesh axis names (``shard_map`` over ``hvd.mesh()``); the eager wrappers in
+``horovod_tpu.collectives.eager`` do that wrapping for host-level use.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .reduce_op import ReduceOp, Average, Sum, Min, Max, Product, Adasum
+from ..core.state import global_state
+from ..core import process_sets as _ps
+
+AxisSpec = Union[str, Tuple[str, ...]]
+
+
+def _default_axes() -> Tuple[str, ...]:
+    st = global_state()
+    if st.mesh is None:
+        raise RuntimeError("horovod_tpu.init() must run before collectives")
+    return tuple(st.mesh.axis_names)
+
+
+def _resolve(axes: Optional[AxisSpec],
+             process_set=None) -> Tuple[Tuple[str, ...], Optional[Tuple[int, ...]]]:
+    """Resolve (axis names, member ranks) for a collective.
+
+    ``members`` is ``None`` for the global set.  In-step process-set
+    collectives are implemented with *masked* full-mesh collectives
+    (non-members contribute the op's identity and keep their own value):
+    JAX 0.9's shard_map does not lower ``axis_index_groups``, and on the
+    ICI torus a full-ring reduction is usually as fast as a subgroup one
+    anyway -- the masking costs one fused elementwise select.
+    """
+    if axes is None:
+        axes = _default_axes()
+    elif isinstance(axes, str):
+        axes = (axes,)
+    members = None
+    if process_set is not None:
+        ps = _ps.get_process_set(process_set)
+        if not ps.is_global():
+            members = ps.ranks
+    return tuple(axes), members
+
+
+def _member_mask(axes: Tuple[str, ...], members: Tuple[int, ...]):
+    return jnp.isin(axis_index(axes), jnp.asarray(members))
+
+
+def axis_size(axes: Optional[AxisSpec] = None) -> int:
+    axes, _ = _resolve(axes)
+    return math.prod(lax.axis_size(a) for a in axes)
+
+
+def axis_index(axes: Optional[AxisSpec] = None):
+    """Flattened device index along the reduce axes (row-major)."""
+    axes, _ = _resolve(axes)
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def allreduce(x,
+              op: ReduceOp = Average,
+              *,
+              axes: Optional[AxisSpec] = None,
+              process_set=None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    """Allreduce one array across the mesh (NCCLAllreduce analogue).
+
+    With a process set, members reduce among themselves and non-members
+    receive their input unchanged (they would not have called the op in
+    the reference's per-rank model).
+    """
+    axes, members = _resolve(axes, process_set)
+    x_orig = x
+    mask = None
+    if members is not None:
+        mask = _member_mask(axes, members)
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+
+    if op in (Sum, Average):
+        contrib = x if mask is None else jnp.where(mask, x,
+                                                   jnp.zeros((), x.dtype))
+        y = lax.psum(contrib, axes)
+        if op is Average:
+            n = len(members) if members is not None else \
+                math.prod(lax.axis_size(a) for a in axes)
+            y = y / jnp.asarray(n, dtype=y.dtype)
+    elif op in (Min, Max):
+        if mask is not None:
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                info = jnp.iinfo(x.dtype)
+                ident = info.max if op is Min else info.min
+            else:
+                ident = jnp.inf if op is Min else -jnp.inf
+            x = jnp.where(mask, x, jnp.asarray(ident, x.dtype))
+        y = lax.pmin(x, axes) if op is Min else lax.pmax(x, axes)
+    elif op is Product:
+        # No pprod primitive: gather then reduce (small tensors only; XLA
+        # fuses the reduction with the gather output).
+        if mask is not None:
+            x = jnp.where(mask, x, jnp.ones((), x.dtype))
+        g = lax.all_gather(x, axes, axis=0)
+        y = jnp.prod(g, axis=0)
+    elif op is Adasum:
+        from ..adasum.xla import adasum_allreduce
+        if len(axes) != 1 or members is not None:
+            raise NotImplementedError(
+                "Adasum currently requires a flat mesh and the global set")
+        y = adasum_allreduce(x, axis=axes[0])
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    if postscale_factor != 1.0:
+        y = y * jnp.asarray(postscale_factor, dtype=y.dtype)
+    if mask is not None:
+        y = jnp.where(mask, y, x_orig)
+    return y
+
+
+def grouped_allreduce(xs: Sequence,
+                      op: ReduceOp = Average,
+                      *,
+                      axes: Optional[AxisSpec] = None,
+                      process_set=None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    """Allreduce a list of arrays as one fused unit (GroupTable analogue).
+
+    The arrays are flattened into a single buffer (the HBM-resident
+    fusion-buffer analogue -- reference ``fusion_buffer_manager.cc``), one
+    collective is emitted, and the results are split back out.  Mixed dtypes
+    are grouped per dtype.
+    """
+    from ..controller.fusion import fuse_flat, unfuse_flat
+    xs = list(xs)
+    if not xs:
+        return []
+    fused, spec = fuse_flat(xs)
+    reduced = [
+        allreduce(buf, op, axes=axes, process_set=process_set,
+                  prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor)
+        for buf in fused
+    ]
+    return unfuse_flat(reduced, spec)
+
+
+def allgather(x,
+              *,
+              axes: Optional[AxisSpec] = None,
+              process_set=None,
+              axis: int = 0,
+              tiled: bool = True):
+    """Concatenate each worker's array along ``axis`` (NCCLAllgather).
+
+    Like the reference, workers may differ only in dimension ``axis`` --
+    but XLA requires static equal shapes, so unequal first dims must go
+    through :func:`allgatherv` (padding-based) instead.
+    """
+    axes, members = _resolve(axes, process_set)
+    if members is not None:
+        raise NotImplementedError(
+            "in-step allgather over a process set is not supported (shape "
+            "would differ per device); use the eager API, which runs on the "
+            "member-only sub-mesh")
+    y = x
+    for a in reversed(axes):
+        y = lax.all_gather(y, a, axis=axis, tiled=tiled)
+    return y
+
+
+def broadcast(x,
+              root_rank: int = 0,
+              *,
+              axes: Optional[AxisSpec] = None,
+              process_set=None):
+    """Every worker receives root's value (NCCLBroadcast analogue).
+
+    Implemented as a masked psum: ``sum_i (i == root ? x_i : 0)``.  XLA
+    lowers this to the same ring traffic a broadcast would use, and it
+    composes with axis_index_groups for process sets.
+    """
+    axes, members = _resolve(axes, process_set)
+    idx = axis_index(axes)
+    member_mask = None
+    if members is not None:
+        # root_rank is a *global* rank; it must be a member of the set.
+        if root_rank not in members:
+            raise ValueError(
+                f"broadcast root_rank {root_rank} is not a member of the "
+                f"process set (ranks {tuple(members)})")
+        # Non-members keep their own value (identity).
+        member_mask = _member_mask(axes, members)
+    mask = (idx == root_rank)
+    if jnp.issubdtype(x.dtype, jnp.bool_):
+        xi = jnp.where(mask, x, False).astype(jnp.int8)
+        out = lax.psum(xi, axes).astype(jnp.bool_)
+    else:
+        masked = jnp.where(mask, x, jnp.zeros((), x.dtype))
+        out = lax.psum(masked, axes)
+    if member_mask is not None:
+        out = jnp.where(member_mask, out, x)
+    return out
+
+
+def reducescatter(x,
+                  op: ReduceOp = Average,
+                  *,
+                  axes: Optional[AxisSpec] = None,
+                  process_set=None,
+                  scatter_axis: int = 0):
+    """Reduce then scatter shards along ``scatter_axis`` (NCCLReducescatter)."""
+    axes, members = _resolve(axes, process_set)
+    if members is not None:
+        raise NotImplementedError(
+            "in-step reducescatter over a process set is not supported "
+            "(shape would differ per device); use the eager API")
+    if op not in (Sum, Average):
+        raise NotImplementedError("reducescatter supports Sum/Average")
+    y = x
+    for a in axes:
+        y = lax.psum_scatter(y, a, scatter_dimension=scatter_axis, tiled=True)
+    if op is Average:
+        n = math.prod(lax.axis_size(a) for a in axes)
+        y = y / jnp.asarray(n, dtype=y.dtype)
+    return y
+
+
+def alltoall(x,
+             *,
+             axes: Optional[AxisSpec] = None,
+             process_set=None,
+             split_axis: int = 0,
+             concat_axis: int = 0):
+    """Exchange equal splits with every worker (NCCLAlltoall analogue).
+
+    The reference supports uneven ``splits``; XLA's static shapes require
+    equal splits -- uneven exchange is provided by ``alltoallv`` (padded).
+    This is the expert-parallel / Ulysses building block (SURVEY.md 5.7).
+    """
+    axes, members = _resolve(axes, process_set)
+    if members is not None:
+        raise NotImplementedError(
+            "in-step alltoall over a process set is not supported; use the "
+            "eager API, which runs on the member-only sub-mesh")
+    if len(axes) != 1:
+        raise NotImplementedError("alltoall requires a flat mesh axis")
+    return lax.all_to_all(x, axes[0], split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def barrier(*, axes: Optional[AxisSpec] = None, process_set=None):
+    """Synchronization barrier (BarrierOp analogue).
+
+    Returns a scalar that data-depends on every worker having reached this
+    point; consume it (e.g. ``jax.block_until_ready``) to enforce ordering.
+    Under SPMD every device executes the program, so a process-set barrier
+    synchronizes the full mesh.
+    """
+    axes, _ = _resolve(axes, process_set)
+    return lax.psum(jnp.ones((), jnp.int32), axes)
+
+
+def ppermute(x, perm, *, axes: Optional[AxisSpec] = None):
+    """Point-to-point permutation over the flat axis (ring building block)."""
+    axes, _ = _resolve(axes)
+    if len(axes) != 1:
+        raise NotImplementedError("ppermute requires a flat mesh axis")
+    return lax.ppermute(x, axes[0], perm)

@@ -1,0 +1,30 @@
+"""Reduction-op constants (``hvd.Sum / Average / Adasum / Min / Max / Product``).
+
+Parity with the reference's ``ReduceOp`` surface exposed from
+``horovod/torch/mpi_ops.py`` / ``horovod/common/message.h::RequestType``.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    AVERAGE = "average"
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    PRODUCT = "product"
+    ADASUM = "adasum"
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return self.value
+
+
+# Module-level aliases matching the hvd.* names.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+Adasum = ReduceOp.ADASUM

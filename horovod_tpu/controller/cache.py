@@ -1,0 +1,76 @@
+"""Executable cache: the ResponseCache analogue.
+
+The reference's ``response_cache.cc`` caches negotiated responses so that
+steady-state cycles skip the full rank-0 gather/broadcast and instead
+allreduce a small bit vector.  Under SPMD the negotiation result for a
+given request signature is fully determined at trace time, so the analogue
+is a bounded LRU of *compiled executables* keyed by the request signature
+(names, shapes, dtypes, op, process set): a hit dispatches a pre-compiled
+fused program with zero Python re-trace cost; a miss traces + compiles
+(the "negotiation").
+
+``HOROVOD_CACHE_CAPACITY`` (default 1024) bounds the table as in the
+reference.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Hashable, Optional, Tuple
+
+
+class ExecutableCache:
+    """Bounded LRU mapping request signatures -> compiled callables."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = max(1, int(capacity))
+        self._od: "collections.OrderedDict[Hashable, Any]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_build(self, key: Hashable, build: Callable[[], Any]) -> Any:
+        with self._lock:
+            if key in self._od:
+                self._od.move_to_end(key)
+                self.hits += 1
+                return self._od[key]
+        # Build outside the lock: tracing/compiling can be slow and build()
+        # must not deadlock against other cache users.
+        value = build()
+        with self._lock:
+            if key in self._od:
+                self._od.move_to_end(key)
+                self.hits += 1
+                return self._od[key]
+            self.misses += 1
+            self._od[key] = value
+            while len(self._od) > self.capacity:
+                self._od.popitem(last=False)
+                self.evictions += 1
+            return value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._od.clear()
+
+    def stats(self) -> Tuple[int, int, int]:
+        return self.hits, self.misses, self.evictions
+
+    def __len__(self) -> int:
+        return len(self._od)
+
+
+def signature(kind: str,
+              name: Optional[str],
+              shapes_dtypes: Tuple,
+              op: Optional[str],
+              process_set: str,
+              extra: Tuple = ()) -> Tuple:
+    """Build a request-signature key (Request wire-format analogue --
+    reference ``horovod/common/message.h::Request`` carries exactly these
+    fields: op type, tensor name, dtype, shape, process set)."""
+    return (kind, name, shapes_dtypes, op, process_set) + tuple(extra)

@@ -1,0 +1,229 @@
+"""Core lifecycle + identity API: ``init/shutdown/rank/size/...``.
+
+TPU-native analogue of the reference's ctypes surface
+(``horovod/common/basics.py::HorovodBasics`` -> ``horovod/common/operations.cc``
+C API).  The reference's ``InitializeHorovodOnce`` spawns a background
+coordinator thread and boots MPI/Gloo; here ``init()`` (optionally) boots
+the JAX distributed runtime, builds the communicator :class:`Mesh` over the
+ICI/DCN fabric and registers the global process set.  No background thread
+exists -- SPMD makes runtime tensor negotiation unnecessary.
+
+Rank semantics under SPMD (documented divergence from the reference, where
+one process == one GPU == one rank):
+
+* ``size()``   -- total number of *devices* (data-parallel workers).
+* ``rank()``   -- this controller process's index (``jax.process_index()``).
+  In the launcher's one-device-per-process mode this equals the Horovod
+  rank exactly; in single-process multi-device mode it is 0 and per-device
+  identity is available in-step via ``axis_index()``.
+* ``local_rank()/local_size()`` -- position among processes on this host /
+  devices owned by this process.
+* ``cross_rank()/cross_size()`` -- host (slice) index / count.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+from typing import Optional, Sequence
+
+import jax
+
+from .config import Config, load_config
+from .exceptions import NotInitializedError
+from .state import global_state
+from . import process_sets as _ps
+from ..parallel import mesh as _mesh
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def _setup_logging(level: str) -> None:
+    lvl = {"trace": logging.DEBUG, "debug": logging.DEBUG,
+           "info": logging.INFO, "warning": logging.WARNING,
+           "error": logging.ERROR, "fatal": logging.CRITICAL}.get(
+               level.lower(), logging.WARNING)
+    logging.basicConfig(level=lvl)
+    logger.setLevel(lvl)
+
+
+def init(
+    devices: Optional[Sequence[jax.Device]] = None,
+    hierarchical: Optional[bool] = None,
+    process_sets: Optional[Sequence[Sequence[int]]] = None,
+    config: Optional[Config] = None,
+) -> None:
+    """Initialize the framework (``hvd.init()`` parity).
+
+    Args:
+      devices: devices forming the world communicator; default all devices.
+      hierarchical: force the 2-D ``(dcn, ici)`` mesh; default: on when
+        multiple processes are present or ``HOROVOD_HIERARCHICAL_ALLREDUCE``
+        is set.
+      process_sets: extra process sets to register, as lists of ranks
+        (``hvd.init(process_sets=...)`` parity).
+      config: explicit config (tests); default: parsed from environment.
+    """
+    st = global_state()
+    with st.lock:
+        if st.initialized:
+            return
+        cfg = config if config is not None else load_config()
+        _setup_logging(cfg.log_level)
+
+        # Multi-process bootstrap: the launcher hands us a coordinator
+        # address (HOROVOD_GLOO_RENDEZVOUS_ADDR analogue) and our process
+        # identity; jax.distributed is the rendezvous+control plane.
+        if cfg.coordinator_addr and not jax._src.distributed.global_state.client:
+            addr = cfg.coordinator_addr
+            if cfg.coordinator_port:
+                addr = f"{addr}:{cfg.coordinator_port}"
+            kwargs = {}
+            if cfg.env_size > 0:
+                kwargs["num_processes"] = cfg.env_size
+            if cfg.env_rank >= 0:
+                kwargs["process_id"] = cfg.env_rank
+            logger.info("jax.distributed.initialize(%s, %s)", addr, kwargs)
+            jax.distributed.initialize(addr, **kwargs)
+            st.owns_distributed = True
+
+        if hierarchical is None:
+            hierarchical = cfg.hierarchical_allreduce or jax.process_count() > 1
+        if devices is None:
+            devices = jax.devices()
+        st.config = cfg
+        st.mesh = _mesh.build_mesh(devices, hierarchical=hierarchical)
+        st.initialized = True
+        _ps._install_global_set()
+        if process_sets:
+            for ranks in process_sets:
+                _ps.add_process_set(ranks)
+
+        from ..controller.cache import ExecutableCache
+        st.cache = ExecutableCache(capacity=cfg.cache_capacity)
+        if cfg.timeline:
+            from ..timeline import Timeline
+            st.timeline = Timeline(cfg.timeline,
+                                   mark_cycles=cfg.timeline_mark_cycles)
+        if cfg.autotune:
+            from ..autotune import Autotuner
+            st.autotuner = Autotuner(cfg)
+        global _atexit_registered
+        if not _atexit_registered:
+            atexit.register(_atexit_shutdown)
+            _atexit_registered = True
+        logger.info(
+            "horovod_tpu initialized: %d device(s), mesh axes %s, "
+            "process %d/%d", int(st.mesh.devices.size), st.mesh.axis_names,
+            jax.process_index(), jax.process_count())
+
+
+_atexit_registered = False
+
+
+def _atexit_shutdown() -> None:
+    st = global_state()
+    if st.initialized:
+        try:
+            shutdown()
+        except Exception:  # pragma: no cover - best effort at interpreter exit
+            pass
+
+
+def shutdown() -> None:
+    """Tear down framework state (``hvd.shutdown()`` parity)."""
+    st = global_state()
+    with st.lock:
+        if not st.initialized:
+            return
+        owns = st.owns_distributed
+        st.reset()
+    if owns:
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # pragma: no cover
+            logger.warning("jax.distributed.shutdown failed", exc_info=True)
+
+
+def is_initialized() -> bool:
+    return global_state().initialized
+
+
+def _require_init() -> "GlobalStateT":
+    st = global_state()
+    if not st.initialized:
+        raise NotInitializedError()
+    return st
+
+
+def mesh():
+    """The world communicator mesh."""
+    return _require_init().mesh
+
+
+def reduce_axes():
+    """Axis name(s) collectives reduce over, innermost last."""
+    return tuple(_require_init().mesh.axis_names)
+
+
+def size() -> int:
+    """Total number of data-parallel workers (devices)."""
+    return int(_require_init().mesh.devices.size)
+
+
+def rank() -> int:
+    _require_init()
+    return jax.process_index()
+
+
+def local_size() -> int:
+    _require_init()
+    return jax.local_device_count()
+
+
+def local_rank() -> int:
+    st = _require_init()
+    if st.config.env_local_rank >= 0:
+        return st.config.env_local_rank
+    return 0
+
+
+def cross_size() -> int:
+    st = _require_init()
+    if st.config.env_cross_size >= 0:
+        return st.config.env_cross_size
+    return jax.process_count()
+
+
+def cross_rank() -> int:
+    st = _require_init()
+    if st.config.env_cross_rank >= 0:
+        return st.config.env_cross_rank
+    return jax.process_index()
+
+
+def is_homogeneous() -> bool:
+    """True when every process owns the same device count."""
+    _require_init()
+    return True
+
+
+# Build-capability probes (parity with HorovodBasics.{nccl,mpi,...}_built).
+def nccl_built() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def tpu_built() -> bool:
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    return False

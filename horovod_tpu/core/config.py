@@ -1,0 +1,136 @@
+"""Environment-driven configuration.
+
+TPU-native analogue of the reference's env parser
+(``horovod/common/utils/env_parser.cc`` -- translates ``HOROVOD_*`` env vars
+into global-state flags).  We honour both the historical ``HOROVOD_*`` names
+(for drop-in parity) and ``HVD_TPU_*`` overrides (which win when both are
+set).
+
+Unlike the reference there is no C++ GlobalState to populate: the config is a
+frozen dataclass read once at ``hvd.init()`` time and stored on the
+:class:`horovod_tpu.core.state.GlobalState` singleton.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_MiB = 1024 * 1024
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Look up ``HVD_TPU_<name>`` then ``HOROVOD_<name>``."""
+    for prefix in ("HVD_TPU_", "HOROVOD_"):
+        v = os.environ.get(prefix + name)
+        if v is not None:
+            return v
+    return default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = _env(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = _env(name)
+    return float(v) if v not in (None, "") else default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = _env(name)
+    if v in (None, ""):
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Runtime knobs.
+
+    Mirrors the de-facto public config API of the reference (SURVEY.md
+    section 5.6).  Fields that only make sense for a CUDA runtime (NCCL
+    stream counts, D2D memcpy batching) are intentionally absent: XLA owns
+    scheduling on TPU.
+    """
+
+    # Fusion-buffer analogue: gradient bucketing threshold in bytes.
+    # Reference: HOROVOD_FUSION_THRESHOLD (default 64 MiB).
+    fusion_threshold: int = 64 * _MiB
+
+    # Executable-cache capacity (ResponseCache analogue).
+    # Reference: HOROVOD_CACHE_CAPACITY (default 1024).
+    cache_capacity: int = 1024
+
+    # Two-level DCN x ICI reduction (NCCLHierarchicalAllreduce analogue).
+    hierarchical_allreduce: bool = False
+
+    # Chrome-trace timeline output path (HOROVOD_TIMELINE).
+    timeline: Optional[str] = None
+    timeline_mark_cycles: bool = False
+
+    # Autotune (HOROVOD_AUTOTUNE / HOROVOD_AUTOTUNE_LOG).
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+
+    # Stall/heartbeat inspector for the launcher/elastic plane.
+    stall_check_disable: bool = False
+    stall_check_time: float = 60.0
+    stall_shutdown_time: float = 0.0
+
+    # Elastic.
+    elastic_timeout: float = 600.0
+
+    # Logging (HOROVOD_LOG_LEVEL).
+    log_level: str = "warning"
+
+    # Launcher-provided identity (HOROVOD_RANK/SIZE/... parity); -1 = unset.
+    env_rank: int = -1
+    env_size: int = -1
+    env_local_rank: int = -1
+    env_local_size: int = -1
+    env_cross_rank: int = -1
+    env_cross_size: int = -1
+
+    # Coordinator/rendezvous (HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT analogue):
+    # address handed to jax.distributed.initialize.
+    coordinator_addr: Optional[str] = None
+    coordinator_port: int = 0
+
+    # Debug-mode desync checksums (no reference equivalent; SURVEY.md 5.2).
+    check_desync: bool = False
+
+
+def load_config() -> Config:
+    """Parse the environment into a :class:`Config`."""
+    addr = _env("COORDINATOR_ADDR") or _env("GLOO_RENDEZVOUS_ADDR")
+    port = _env_int("COORDINATOR_PORT", _env_int("GLOO_RENDEZVOUS_PORT", 0))
+    return Config(
+        fusion_threshold=_env_int("FUSION_THRESHOLD", 64 * _MiB),
+        cache_capacity=_env_int("CACHE_CAPACITY", 1024),
+        hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE"),
+        timeline=_env("TIMELINE"),
+        timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES"),
+        autotune=_env_bool("AUTOTUNE"),
+        autotune_log=_env("AUTOTUNE_LOG"),
+        stall_check_disable=_env_bool("STALL_CHECK_DISABLE"),
+        # Upstream spells these *_TIME_SECONDS; accept both spellings.
+        stall_check_time=_env_float(
+            "STALL_CHECK_TIME_SECONDS", _env_float("STALL_CHECK_TIME", 60.0)),
+        stall_shutdown_time=_env_float(
+            "STALL_SHUTDOWN_TIME_SECONDS",
+            _env_float("STALL_SHUTDOWN_TIME", 0.0)),
+        elastic_timeout=_env_float("ELASTIC_TIMEOUT", 600.0),
+        log_level=_env("LOG_LEVEL", "warning") or "warning",
+        env_rank=_env_int("RANK", -1),
+        env_size=_env_int("SIZE", -1),
+        env_local_rank=_env_int("LOCAL_RANK", -1),
+        env_local_size=_env_int("LOCAL_SIZE", -1),
+        env_cross_rank=_env_int("CROSS_RANK", -1),
+        env_cross_size=_env_int("CROSS_SIZE", -1),
+        coordinator_addr=addr,
+        coordinator_port=port,
+        check_desync=_env_bool("CHECK_DESYNC"),
+    )

@@ -1,0 +1,47 @@
+"""Exception types.
+
+Parity with the reference's ``horovod/common/exceptions.py``: the two
+exception classes are the *control-flow protocol* of elastic training
+(SURVEY.md section 4.5) -- a failed collective raises
+:class:`HorovodInternalError` (roll back to last commit), a topology change
+pushed by the driver raises :class:`HostsUpdatedInterrupt` (graceful
+re-rendezvous at the next commit boundary).
+"""
+
+from __future__ import annotations
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """A collective or runtime operation failed (e.g. a peer vanished).
+
+    Elastic training catches this and restores from the last committed
+    state.  Reference: ``horovod/common/exceptions.py::HorovodInternalError``.
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """The set of hosts/slices changed; re-rendezvous at next commit.
+
+    Reference: ``horovod/common/exceptions.py::HostsUpdatedInterrupt``.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API was called before ``hvd.init()``."""
+
+    def __init__(self, what: str = "Horovod-TPU"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class ProcessSetError(HorovodTpuError):
+    """Invalid process-set operation (unknown set, bad ranks, ...)."""

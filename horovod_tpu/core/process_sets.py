@@ -1,0 +1,130 @@
+"""Process sets: named subsets of ranks with their own communicator.
+
+Analogue of ``horovod/common/process_set.cc`` + ``horovod/common/process_sets.py``
+(each set owns a controller/communicator; dynamic registration via
+``hvd.add_process_set``).  Here a "rank" is a *device index* in the global
+mesh order and the per-set communicator is either
+
+* a sub-:class:`jax.sharding.Mesh` over the member devices (for the eager
+  collective path), or
+* a masked full-mesh collective for in-step use (every device executes the
+  same SPMD program; non-members contribute the op's identity and keep
+  their own value -- see ``collectives.ops._resolve``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+from .exceptions import ProcessSetError
+from .state import global_state
+from ..parallel.mesh import HVD_AXIS
+
+GLOBAL_PROCESS_SET_NAME = "global"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessSet:
+    """A named subset of device ranks."""
+
+    name: str
+    ranks: Tuple[int, ...]  # global device indices, sorted
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def included(self, rank: int) -> bool:
+        return rank in self.ranks
+
+    def is_global(self) -> bool:
+        return self.name == GLOBAL_PROCESS_SET_NAME
+
+    def mesh(self) -> Mesh:
+        """The set's communicator mesh: the global mesh for the world set
+        (possibly hierarchical), a flat sub-mesh otherwise."""
+        st = global_state()
+        if st.mesh is None:
+            raise ProcessSetError("not initialized")
+        if self.is_global():
+            return st.mesh
+        return self.flat_mesh()
+
+    def flat_mesh(self) -> Mesh:
+        """1-D ``hvd``-axis mesh over the member devices (eager path)."""
+        st = global_state()
+        if st.mesh is None:
+            raise ProcessSetError("not initialized")
+        import numpy as np
+        flat = list(st.mesh.devices.flat)
+        devs = np.asarray([flat[r] for r in self.ranks], dtype=object)
+        return Mesh(devs, (HVD_AXIS,))
+
+def _require_init() -> None:
+    if not global_state().initialized:
+        raise ProcessSetError("call horovod_tpu.init() before using process sets")
+
+
+def add_process_set(ranks: Sequence[int], name: Optional[str] = None) -> ProcessSet:
+    """Register a new process set (``hvd.add_process_set`` parity)."""
+    _require_init()
+    st = global_state()
+    ranks = tuple(sorted(int(r) for r in ranks))
+    n = int(st.mesh.devices.size)
+    if len(set(ranks)) != len(ranks):
+        raise ProcessSetError(f"duplicate ranks in {ranks}")
+    if not ranks or ranks[0] < 0 or ranks[-1] >= n:
+        raise ProcessSetError(f"ranks {ranks} out of range for world size {n}")
+    if name is None:
+        name = "ps_" + "_".join(map(str, ranks))
+    with st.lock:
+        if name in st.process_sets:
+            existing = st.process_sets[name]
+            if existing.ranks != ranks:
+                raise ProcessSetError(
+                    f"process set {name!r} already exists with ranks "
+                    f"{existing.ranks}")
+            return existing
+        ps = ProcessSet(name=name, ranks=ranks)
+        st.process_sets[name] = ps
+        return ps
+
+
+def remove_process_set(name: str) -> None:
+    _require_init()
+    if name == GLOBAL_PROCESS_SET_NAME:
+        raise ProcessSetError("cannot remove the global process set")
+    st = global_state()
+    with st.lock:
+        st.process_sets.pop(name, None)
+
+
+def get_process_set(name_or_set=None) -> ProcessSet:
+    """Resolve ``None`` | name | ProcessSet to a registered ProcessSet."""
+    _require_init()
+    st = global_state()
+    if name_or_set is None:
+        return st.process_sets[GLOBAL_PROCESS_SET_NAME]
+    if isinstance(name_or_set, ProcessSet):
+        return name_or_set
+    try:
+        return st.process_sets[name_or_set]
+    except KeyError:
+        raise ProcessSetError(f"unknown process set {name_or_set!r}") from None
+
+
+def process_set_names() -> List[str]:
+    _require_init()
+    return sorted(global_state().process_sets)
+
+
+def _install_global_set() -> ProcessSet:
+    """Called by ``init()``: register the world set."""
+    st = global_state()
+    n = int(st.mesh.devices.size)
+    ps = ProcessSet(name=GLOBAL_PROCESS_SET_NAME, ranks=tuple(range(n)))
+    st.process_sets[GLOBAL_PROCESS_SET_NAME] = ps
+    return ps

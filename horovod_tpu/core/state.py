@@ -1,0 +1,62 @@
+"""Process-global framework state.
+
+Analogue of the reference's ``horovod/common/global_state.h::HorovodGlobalState``
+singleton (controller, op manager, process-set table, fusion buffer,
+parameter manager, timeline, flags).  Here the members are: the device mesh
+(the communicator), the process-set table, the executable cache
+(ResponseCache analogue), the timeline writer and the parsed config.
+
+There is deliberately no background thread: under SPMD every process
+compiles the same fused program, so the negotiation machine the reference's
+background loop exists for has no work to do (SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, TYPE_CHECKING
+
+from .config import Config
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guards
+    from jax.sharding import Mesh
+    from .process_sets import ProcessSet
+    from ..controller.cache import ExecutableCache
+    from ..timeline import Timeline
+    from ..autotune import Autotuner
+
+
+class GlobalState:
+    """Mutable singleton holding everything ``init()`` sets up."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.initialized: bool = False
+        self.config: Optional[Config] = None
+        self.mesh: Optional["Mesh"] = None
+        self.process_sets: Dict[str, "ProcessSet"] = {}
+        self.cache: Optional["ExecutableCache"] = None
+        self.timeline: Optional["Timeline"] = None
+        self.autotuner: Optional["Autotuner"] = None
+        # True when this process called jax.distributed.initialize and owns
+        # a shutdown obligation.
+        self.owns_distributed: bool = False
+
+    def reset(self) -> None:
+        self.initialized = False
+        self.config = None
+        self.mesh = None
+        self.process_sets = {}
+        self.cache = None
+        if self.timeline is not None:
+            self.timeline.close()
+        self.timeline = None
+        self.autotuner = None
+        self.owns_distributed = False
+
+
+_state = GlobalState()
+
+
+def global_state() -> GlobalState:
+    return _state

@@ -1,0 +1,133 @@
+"""DistributedOptimizer: gradient allreduce fused into the update.
+
+JAX-native analogue of ``horovod/torch/optimizer.py::DistributedOptimizer``
+(grad-hook allreduce + ``synchronize()`` before ``step()``) and
+``horovod/tensorflow/__init__.py::DistributedGradientTape``.  Because the
+whole step is traced, the "hook + background negotiation + synchronize"
+machinery collapses into a pure function: gradients are bucketed through
+the fusion planner, one ``psum`` per bucket is emitted inside the step, and
+XLA overlaps those collectives with the backward pass automatically (the
+latency-hiding the reference needs its async enqueue machinery for).
+
+Supports the reference's knobs: reduce op (Average/Sum/Adasum), fp16/bf16
+compression, process sets, prescale/postscale,
+``backward_passes_per_step`` (local gradient accumulation: N-1 steps
+accumulate locally, the Nth allreduces the running sum -- same traffic
+saving as the reference's ``backward_passes_per_step``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..collectives import ops as _ops
+from ..collectives.compression import Compression
+from ..collectives.reduce_op import ReduceOp, Average
+from ..controller.fusion import fused_tree_collective
+
+
+def allreduce_gradients(grads,
+                        op: ReduceOp = Average,
+                        *,
+                        compression=Compression.none,
+                        fusion_threshold: Optional[int] = None,
+                        axes=None,
+                        process_set=None,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0):
+    """Fused in-step allreduce of a gradient pytree (the hot path)."""
+
+    def collective(buf):
+        c, ctx = compression.compress(buf)
+        r = _ops.allreduce(c, op, axes=axes, process_set=process_set,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+        return compression.decompress(r, ctx)
+
+    return fused_tree_collective(grads, collective, fusion_threshold)
+
+
+class _AccumState(NamedTuple):
+    counter: jnp.ndarray          # int32 scalar
+    accum: Any                    # gradient-shaped pytree
+    inner: Any                    # wrapped optimizer state
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         *,
+                         op: ReduceOp = Average,
+                         compression=Compression.none,
+                         fusion_threshold: Optional[int] = None,
+                         axes=None,
+                         process_set=None,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0,
+                         backward_passes_per_step: int = 1
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so updates see globally-reduced gradients.
+
+    Use inside a step traced over the mesh (``shard_map`` or the
+    :func:`horovod_tpu.training.train_step` helper)::
+
+        opt = hvd.DistributedOptimizer(optax.adamw(1e-3),
+                                       compression=hvd.Compression.bf16)
+    """
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    def _reduce(grads):
+        return allreduce_gradients(
+            grads, op, compression=compression,
+            fusion_threshold=fusion_threshold, axes=axes,
+            process_set=process_set, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+
+    if backward_passes_per_step == 1:
+        def init(params):
+            return optimizer.init(params)
+
+        def update(grads, state, params=None, **extra):
+            return optimizer.update(_reduce(grads), state, params, **extra)
+
+        return optax.GradientTransformation(init, update)
+
+    n = backward_passes_per_step
+
+    def init(params):
+        return _AccumState(
+            counter=jnp.zeros((), jnp.int32),
+            accum=jax.tree.map(jnp.zeros_like, params),
+            inner=optimizer.init(params))
+
+    def update(grads, state, params=None, **extra):
+        accum = jax.tree.map(lambda a, g: a + g, state.accum, grads)
+        is_sync = state.counter == n - 1
+
+        def do_sync(_):
+            mean_grads = jax.tree.map(lambda a: a / n, accum)
+            reduced = _reduce(mean_grads)
+            updates, inner = optimizer.update(reduced, state.inner, params,
+                                              **extra)
+            zeroed = jax.tree.map(jnp.zeros_like, accum)
+            return updates, _AccumState(jnp.zeros((), jnp.int32), zeroed,
+                                        inner)
+
+        def skip(_):
+            updates = jax.tree.map(jnp.zeros_like, grads)
+            return updates, _AccumState(state.counter + 1, accum, state.inner)
+
+        return jax.lax.cond(is_sync, do_sync, skip, None)
+
+    return optax.GradientTransformation(init, update)
+
+
+def DistributedAdasumOptimizer(optimizer: optax.GradientTransformation,
+                               **kwargs) -> optax.GradientTransformation:
+    """Adasum variant (``_DistributedAdasumOptimizer`` parity)."""
+    from ..collectives.reduce_op import Adasum
+    kwargs["op"] = Adasum
+    return DistributedOptimizer(optimizer, **kwargs)

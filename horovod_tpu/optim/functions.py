@@ -1,0 +1,77 @@
+"""State-synchronization helpers.
+
+Parity with ``horovod/torch/functions.py``: ``broadcast_parameters``,
+``broadcast_optimizer_state``, ``broadcast_object`` -- the rank-0-saves /
+everyone-restores idiom used on (re)start and by elastic ``state.sync()``.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..collectives import eager as _eager
+from ..core import process_sets as _ps
+
+
+def _stacked(leaf, n: int):
+    x = np.asarray(leaf)
+    return np.broadcast_to(x[None], (n,) + x.shape)
+
+
+def broadcast_(tree: Any, root_rank: int = 0, *, process_set=None) -> Any:
+    """Broadcast every array leaf of a pytree from ``root_rank``.
+
+    Works on replicated host-side values: each worker contributes its copy,
+    everyone leaves with root's.  Non-array leaves (ints, None, ...) pass
+    through :func:`broadcast_object`.
+    """
+    ps = _ps.get_process_set(process_set)
+    n = ps.size()
+
+    def bcast_leaf(leaf):
+        if isinstance(leaf, (jax.Array, np.ndarray)) or \
+                isinstance(leaf, (jnp.bfloat16,)) or hasattr(leaf, "dtype"):
+            out = _eager.broadcast(_stacked(leaf, n), root_rank,
+                                   process_set=ps)
+            return jnp.asarray(out)[0]
+        return broadcast_object(leaf, root_rank, process_set=ps)
+
+    return jax.tree.map(bcast_leaf, tree)
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0, *,
+                         process_set=None) -> Any:
+    """``hvd.broadcast_parameters`` parity: sync model params from root."""
+    return broadcast_(params, root_rank, process_set=process_set)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0, *,
+                              process_set=None) -> Any:
+    """``hvd.broadcast_optimizer_state`` parity."""
+    return broadcast_(opt_state, root_rank, process_set=process_set)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, *,
+                     process_set=None) -> Any:
+    """Pickle-broadcast an arbitrary Python object from ``root_rank``.
+
+    Two-phase (size then padded payload) so processes with different local
+    values agree on buffer shape, as the reference does with its
+    size-prefixed byte stream.
+    """
+    ps = _ps.get_process_set(process_set)
+    n = ps.size()
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    size = np.array([len(payload)], dtype=np.int32)
+    gsize = np.asarray(_eager.broadcast(_stacked(size, n), root_rank,
+                                        process_set=ps))[0, 0]
+    buf = np.zeros(int(gsize), dtype=np.uint8)
+    buf[:min(len(payload), int(gsize))] = payload[:int(gsize)]
+    out = np.asarray(_eager.broadcast(_stacked(buf, n), root_rank,
+                                      process_set=ps))[0]
+    return pickle.loads(out.tobytes())

@@ -1,0 +1,75 @@
+"""Device-mesh construction for the ICI/DCN fabric.
+
+TPU-native replacement for the reference's communicator setup
+(``horovod/common/mpi/mpi_context.cc`` -- global, local-node and cross-node
+MPI communicators).  On TPU the communicator *is* the mesh: a
+:class:`jax.sharding.Mesh` whose axes map onto physical links.
+
+* Flat mode: one axis ``"hvd"`` over every addressable device.  XLA routes
+  the collective over ICI within a slice (and DCN between slices if the
+  runtime spans them).
+* Hierarchical mode (``NCCLHierarchicalAllreduce`` analogue): a 2-D mesh
+  ``("dcn", "ici")`` -- the outer axis spans processes/slices over DCN, the
+  inner axis spans each process's local chips over ICI.  A hierarchical
+  allreduce is then ``psum`` over ``("ici", "dcn")`` which XLA lowers to
+  reduce-scatter(ICI) -> allreduce(DCN) -> all-gather(ICI), exactly the
+  NCCL+MPI sandwich the reference hand-codes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names.
+HVD_AXIS = "hvd"      # flat data-parallel axis
+DCN_AXIS = "dcn"      # cross-slice (data-center network) axis
+ICI_AXIS = "ici"      # intra-slice (inter-chip interconnect) axis
+
+# The axis (or axes, innermost-last) a collective reduces over for a mesh
+# built by :func:`build_mesh`.
+FLAT_AXES: Tuple[str, ...] = (HVD_AXIS,)
+HIER_AXES: Tuple[str, ...] = (DCN_AXIS, ICI_AXIS)
+
+
+def build_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    hierarchical: bool = False,
+) -> Mesh:
+    """Build the global communicator mesh.
+
+    Args:
+      devices: devices to include; defaults to ``jax.devices()`` (all
+        devices across all processes -- the MPI_COMM_WORLD analogue).
+      hierarchical: build the 2-D ``(dcn, ici)`` mesh.  Requires the device
+        count to factor as ``num_processes * devices_per_process``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if not hierarchical:
+        return Mesh(np.asarray(devices, dtype=object).reshape(n), (HVD_AXIS,))
+
+    # Group by owning process: DCN axis = processes, ICI axis = local chips.
+    procs = sorted({d.process_index for d in devices})
+    per_proc = [sorted((d for d in devices if d.process_index == p),
+                       key=lambda d: d.id) for p in procs]
+    counts = {len(ds) for ds in per_proc}
+    if len(counts) != 1:
+        raise ValueError(
+            f"hierarchical mesh needs equal devices per process, got {counts}")
+    grid = np.asarray(per_proc, dtype=object)
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+
+
+def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The reduction axes for a mesh produced by :func:`build_mesh`."""
+    return tuple(mesh.axis_names)
+
+
+def mesh_size(mesh: Mesh) -> int:
+    return int(np.prod([mesh.shape[a] for a in mesh.axis_names]))

@@ -1,0 +1,127 @@
+"""Chrome-tracing timeline (``HOROVOD_TIMELINE`` parity).
+
+Analogue of the reference's ``horovod/common/timeline.cc``: a JSON writer
+producing ``chrome://tracing`` / Perfetto-loadable output with per-tensor
+phase events.  The reference's phases (NEGOTIATE_ALLREDUCE, QUEUE,
+MEMCPY_IN_FUSION_BUFFER, NCCL_ALLREDUCE, MEMCPY_OUT_FUSION_BUFFER) map to
+this runtime's phases: NEGOTIATE_* = trace+compile (executable-cache miss),
+CACHE_HIT, and the collective execution itself.  Device-side timing is the
+profiler's job (``jax.profiler`` emits XPlane/Perfetto); this timeline
+captures the *semantic* host-side lifecycle, as SURVEY.md section 5.1
+prescribes.
+
+Events are buffered and flushed by a writer thread like the reference's,
+so the hot path only appends to a deque.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional
+
+
+class Timeline:
+    """Append-only Chrome-trace event stream with a background writer."""
+
+    def __init__(self, path: str, mark_cycles: bool = False,
+                 flush_interval: float = 1.0):
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self._events: Deque[dict] = deque()
+        self._pids: Dict[str, int] = {}
+        self._next_pid = 1
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._t0 = time.perf_counter()
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._wrote_any = False
+        self._flush_interval = flush_interval
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        name="hvd-tpu-timeline", daemon=True)
+        self._writer.start()
+        atexit.register(self.close)
+
+    # -- event emission ---------------------------------------------------
+    def _us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _pid(self, track: str) -> int:
+        pid = self._pids.get(track)
+        if pid is None:
+            pid = self._next_pid
+            self._next_pid += 1
+            self._pids[track] = pid
+            self._events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": track}})
+        return pid
+
+    def begin(self, tensor: str, phase: str) -> None:
+        with self._lock:
+            self._events.append({"name": phase, "ph": "B",
+                                 "pid": self._pid(tensor), "tid": 0,
+                                 "ts": self._us()})
+
+    def end(self, tensor: str, phase: str) -> None:
+        with self._lock:
+            self._events.append({"name": phase, "ph": "E",
+                                 "pid": self._pid(tensor), "tid": 0,
+                                 "ts": self._us()})
+
+    def instant(self, name: str, track: str = "cycle") -> None:
+        with self._lock:
+            self._events.append({"name": name, "ph": "i", "s": "g",
+                                 "pid": self._pid(track), "tid": 0,
+                                 "ts": self._us()})
+
+    def mark_cycle(self) -> None:
+        if self.mark_cycles:
+            self.instant("CYCLE")
+
+    @contextlib.contextmanager
+    def range(self, tensor: str, phase: str):
+        self.begin(tensor, phase)
+        try:
+            yield
+        finally:
+            self.end(tensor, phase)
+
+    # -- writer thread ----------------------------------------------------
+    def _drain(self) -> None:
+        batch = []
+        with self._lock:
+            while self._events:
+                batch.append(self._events.popleft())
+        if not batch or self._file.closed:
+            return
+        chunks = []
+        for ev in batch:
+            prefix = ",\n" if self._wrote_any else ""
+            self._wrote_any = True
+            chunks.append(prefix + json.dumps(ev))
+        self._file.write("".join(chunks))
+        self._file.flush()
+
+    def _writer_loop(self) -> None:
+        while not self._stop.wait(self._flush_interval):
+            try:
+                self._drain()
+            except ValueError:  # file closed under us at exit
+                return
+
+    def close(self) -> None:
+        if self._file.closed:
+            return
+        self._stop.set()
+        self._writer.join(timeout=5)
+        self._drain()
+        self._file.write("\n]\n")
+        self._file.close()
+        atexit.unregister(self.close)

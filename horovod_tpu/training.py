@@ -1,0 +1,136 @@
+"""High-level data-parallel training-step builder.
+
+The reference's end-user recipe (wrap optimizer, hook gradients, launch one
+process per accelerator) becomes, TPU-natively: trace ONE step function
+over the mesh with ``jax.shard_map``; the batch is sharded over the mesh
+axes, parameters are replicated, and the wrapped optimizer emits fused
+``psum`` collectives that XLA overlaps with the backward pass.
+
+This module is the "DistributedOptimizer user experience" glue: given a
+loss function and a (Distributed)optax optimizer it returns a jitted step
+with donated params/opt-state (in-place HBM update, fusion-buffer style).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .collectives import ops as _ops
+from .collectives.reduce_op import Average
+from .core import basics as _basics
+
+
+def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Sharding that splits the leading (batch) dim over every mesh axis."""
+    mesh = mesh or _basics.mesh()
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+
+def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Place a host-global batch onto the mesh, sharded along dim 0."""
+    sharding = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or _basics.mesh()
+    return NamedSharding(mesh, P())
+
+
+def replicate(tree: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Replicate parameters/optimizer state across the mesh."""
+    sharding = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Any], jnp.ndarray],
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+    loss_has_aux: bool = False,
+) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
+    """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    ``loss_fn(params, local_batch)`` is evaluated on each device's batch
+    shard; gradients flow through ``optimizer`` (wrap it with
+    :func:`horovod_tpu.DistributedOptimizer` for the fused allreduce) and
+    the returned loss is the global mean.
+    """
+    mesh = mesh or _basics.mesh()
+    axes = tuple(mesh.axis_names)
+
+    def local_step(params, opt_state, batch):
+        if loss_has_aux:
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            aux = None
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = _ops.allreduce(loss, Average, axes=axes)
+        if loss_has_aux:
+            return params, opt_state, loss, aux
+        return params, opt_state, loss
+
+    shard = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), P(axes)),
+        out_specs=(P(), P(), P()) + ((P(axes),) if loss_has_aux else ()),
+        check_vma=False)
+    donate_argnums = (0, 1) if donate else ()
+
+    from .core.state import global_state
+    tuner = global_state().autotuner
+    if tuner is None:
+        return jax.jit(shard, donate_argnums=donate_argnums)
+
+    # Autotune mode (HOROVOD_AUTOTUNE=1): the fusion threshold is read at
+    # trace time, so each candidate needs its own trace -- keep one
+    # compiled step per candidate and feed observed step time back to the
+    # tuner (ParameterManager's score loop, minus the background thread).
+    import time as _time
+    compiled = {}
+    grad_nbytes = [0]
+
+    def tuned_step(params, opt_state, batch):
+        thr = tuner.fusion_threshold()
+        fn = compiled.get(thr)
+        if fn is None:
+            fn = jax.jit(shard, donate_argnums=donate_argnums)
+            compiled[thr] = fn
+        if tuner.done:
+            return fn(params, opt_state, batch)
+        if not grad_nbytes[0]:
+            grad_nbytes[0] = sum(
+                x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+        t0 = _time.perf_counter()
+        out = fn(params, opt_state, batch)
+        jax.block_until_ready(out[2])
+        tuner.record_step(_time.perf_counter() - t0, grad_nbytes[0])
+        return out
+
+    return tuned_step
+
+
+def make_eval_step(metric_fn: Callable[[Any, Any], Any],
+                   mesh: Optional[Mesh] = None):
+    """Build an eval step that averages ``metric_fn`` over the mesh."""
+    mesh = mesh or _basics.mesh()
+    axes = tuple(mesh.axis_names)
+
+    def local_eval(params, batch):
+        m = metric_fn(params, batch)
+        return jax.tree.map(
+            lambda v: _ops.allreduce(v, Average, axes=axes), m)
+
+    shard = jax.shard_map(local_eval, mesh=mesh, in_specs=(P(), P(axes)),
+                          out_specs=P(), check_vma=False)
+    return jax.jit(shard)

@@ -1,0 +1,35 @@
+"""Test harness: 8 virtual CPU devices, mirroring the reference's
+``mpirun -np N`` localhost test strategy (SURVEY.md section 4/7)."""
+
+import os
+
+# Must be set before jax initializes its backends.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The environment pre-configures jax_platforms="axon,cpu" (TPU plugin), which
+# overrides the env var; force the CPU backend explicitly so tests get the
+# 8-device virtual mesh.
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, jax.devices()
+
+
+@pytest.fixture(scope="session")
+def n_devices():
+    return len(jax.devices())
+
+
+@pytest.fixture()
+def hvd():
+    """Fresh-initialized framework per test."""
+    import horovod_tpu as hvd_mod
+    hvd_mod.shutdown()
+    hvd_mod.init()
+    yield hvd_mod
+    hvd_mod.shutdown()

@@ -1,0 +1,69 @@
+"""Core lifecycle/identity tests (parity: reference test_torch.py basics)."""
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu
+from horovod_tpu.core.config import Config, load_config
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()  # second call is a no-op
+    assert hvd.is_initialized()
+
+
+def test_sizes(hvd, n_devices):
+    assert hvd.size() == n_devices
+    assert hvd.rank() == 0
+    assert hvd.local_size() == n_devices
+    assert hvd.local_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_build_probes(hvd):
+    assert hvd.tpu_built()
+    assert not hvd.nccl_built()
+    assert not hvd.mpi_built()
+
+
+def test_not_initialized_raises():
+    horovod_tpu.shutdown()
+    with pytest.raises(horovod_tpu.NotInitializedError):
+        horovod_tpu.size()
+
+
+def test_mesh_shape(hvd, n_devices):
+    m = hvd.mesh()
+    assert int(np.prod([m.shape[a] for a in m.axis_names])) == n_devices
+
+
+def test_config_env_parsing(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 20))
+    monkeypatch.setenv("HVD_TPU_CACHE_CAPACITY", "7")
+    monkeypatch.setenv("HOROVOD_LOG_LEVEL", "info")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    cfg = load_config()
+    assert cfg.fusion_threshold == 1 << 20
+    assert cfg.cache_capacity == 7
+    assert cfg.log_level == "info"
+    assert cfg.hierarchical_allreduce
+
+
+def test_hvd_tpu_env_wins(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "111")
+    monkeypatch.setenv("HVD_TPU_FUSION_THRESHOLD", "222")
+    assert load_config().fusion_threshold == 222
+
+
+def test_hierarchical_mesh_single_process(n_devices):
+    horovod_tpu.shutdown()
+    horovod_tpu.init(config=Config(hierarchical_allreduce=True))
+    m = horovod_tpu.mesh()
+    assert m.axis_names == ("dcn", "ici")
+    assert m.shape["dcn"] == 1
+    assert m.shape["ici"] == n_devices
+    horovod_tpu.shutdown()

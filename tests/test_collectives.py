@@ -1,0 +1,197 @@
+"""Eager + in-step collective op tests.
+
+Parity model: reference ``test/parallel/test_torch.py`` exercises every op
+x dtype x device under ``mpirun -np 2``; here every virtual CPU device is a
+rank and the eager API takes rank-stacked arrays.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hv
+
+DTYPES = [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int32]
+
+
+def rank_stacked(n, shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, *shape) * 4
+    if np.issubdtype(np.dtype(jnp.dtype(dtype).name if dtype != jnp.bfloat16
+                              else np.float32), np.integer):
+        x = rng.randint(-10, 10, size=(n,) + tuple(shape))
+    return jnp.asarray(x, dtype=dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_sum(hvd, n_devices, dtype):
+    x = rank_stacked(n_devices, (4, 3), dtype)
+    y = hvd.allreduce(x, hvd.Sum, name=f"ar_{jnp.dtype(dtype).name}")
+    expect = jnp.sum(x.astype(jnp.float32), axis=0)
+    for r in range(n_devices):
+        np.testing.assert_allclose(
+            np.asarray(y[r], dtype=np.float32), np.asarray(expect),
+            rtol=2e-2 if dtype in (jnp.bfloat16, jnp.float16) else 1e-5)
+
+
+def test_allreduce_average(hvd, n_devices):
+    x = rank_stacked(n_devices, (5,), jnp.float32)
+    y = hvd.allreduce(x, hvd.Average)
+    expect = np.mean(np.asarray(x), axis=0)
+    np.testing.assert_allclose(np.asarray(y[0]), expect, rtol=1e-5)
+
+
+def test_allreduce_min_max(hvd, n_devices):
+    x = rank_stacked(n_devices, (7,), jnp.float32)
+    np.testing.assert_allclose(np.asarray(hvd.allreduce(x, hvd.Min)[2]),
+                               np.min(np.asarray(x), axis=0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(hvd.allreduce(x, hvd.Max)[5]),
+                               np.max(np.asarray(x), axis=0), rtol=1e-6)
+
+
+def test_allreduce_product(hvd, n_devices):
+    x = jnp.ones((n_devices, 3)) * 1.1
+    y = hvd.allreduce(x, hvd.Product)
+    np.testing.assert_allclose(np.asarray(y[0]), 1.1 ** n_devices, rtol=1e-4)
+
+
+def test_allreduce_prescale_postscale(hvd, n_devices):
+    x = rank_stacked(n_devices, (4,), jnp.float32)
+    y = hvd.allreduce(x, hvd.Sum, prescale_factor=0.5, postscale_factor=2.0)
+    expect = np.sum(np.asarray(x), axis=0)  # 0.5 * sum * 2
+    np.testing.assert_allclose(np.asarray(y[0]), expect, rtol=1e-5)
+
+
+def test_allreduce_fp16_compression(hvd, n_devices):
+    x = rank_stacked(n_devices, (64,), jnp.float32)
+    y = hvd.allreduce(x, hvd.Average, compression=hv.Compression.fp16)
+    assert y.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(y[0]),
+                               np.mean(np.asarray(x), axis=0), rtol=1e-2,
+                               atol=1e-2)
+
+
+def test_allgather(hvd, n_devices):
+    x = rank_stacked(n_devices, (2, 3), jnp.float32)
+    y = hvd.allgather(x)
+    assert y.shape == (n_devices, n_devices * 2, 3)
+    expect = np.asarray(x).reshape(n_devices * 2, 3)
+    for r in range(n_devices):
+        np.testing.assert_allclose(np.asarray(y[r]), expect, rtol=1e-6)
+
+
+def test_broadcast(hvd, n_devices):
+    for root in (0, n_devices - 1):
+        x = rank_stacked(n_devices, (3, 2), jnp.float32, seed=root)
+        y = hvd.broadcast(x, root_rank=root)
+        for r in range(n_devices):
+            np.testing.assert_allclose(np.asarray(y[r]),
+                                       np.asarray(x[root]), rtol=1e-6)
+
+
+def test_broadcast_bool(hvd, n_devices):
+    x = jnp.asarray(np.arange(n_devices * 4).reshape(n_devices, 4) % 2 == 0)
+    y = hvd.broadcast(x, root_rank=1)
+    assert y.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(y[3]), np.asarray(x[1]))
+
+
+def test_reducescatter(hvd, n_devices):
+    x = rank_stacked(n_devices, (n_devices * 2, 3), jnp.float32)
+    y = hvd.reducescatter(x, hvd.Sum)
+    assert y.shape == (n_devices, 2, 3)
+    full = np.sum(np.asarray(x), axis=0)
+    for r in range(n_devices):
+        np.testing.assert_allclose(np.asarray(y[r]),
+                                   full[r * 2:(r + 1) * 2], rtol=1e-5)
+
+
+def test_alltoall(hvd, n_devices):
+    x = rank_stacked(n_devices, (n_devices * 2, 2), jnp.float32)
+    y = hvd.alltoall(x)
+    assert y.shape == x.shape
+    xs = np.asarray(x)
+    for r in range(n_devices):
+        expect = np.concatenate(
+            [xs[s, r * 2:(r + 1) * 2] for s in range(n_devices)])
+        np.testing.assert_allclose(np.asarray(y[r]), expect, rtol=1e-6)
+
+
+def test_grouped_allreduce(hvd, n_devices):
+    xs = [rank_stacked(n_devices, shape, jnp.float32, seed=i)
+          for i, shape in enumerate([(4,), (2, 3), (5, 1)])]
+    ys = hvd.grouped_allreduce(xs, hvd.Sum)
+    for x, y in zip(xs, ys):
+        np.testing.assert_allclose(np.asarray(y[0]),
+                                   np.sum(np.asarray(x), axis=0), rtol=1e-5)
+
+
+def test_async_handles(hvd, n_devices):
+    x = rank_stacked(n_devices, (16,), jnp.float32)
+    h = hvd.allreduce_async(x, hvd.Sum, name="async1")
+    assert hvd.poll(h) in (True, False)
+    y = hvd.synchronize(h)
+    np.testing.assert_allclose(np.asarray(y[0]),
+                               np.sum(np.asarray(x), axis=0), rtol=1e-5)
+
+
+def test_barrier_and_join(hvd):
+    hvd.barrier()
+    assert hvd.join() == -1
+
+
+def test_executable_cache_hits(hvd, n_devices):
+    from horovod_tpu.core.state import global_state
+    cache = global_state().cache
+    x = rank_stacked(n_devices, (8,), jnp.float32)
+    hvd.allreduce(x, hvd.Sum, name="cached")
+    h0, m0, _ = cache.stats()
+    hvd.allreduce(x + 1, hvd.Sum, name="cached")
+    h1, m1, _ = cache.stats()
+    assert h1 == h0 + 1 and m1 == m0
+
+
+def test_process_set_allreduce(hvd, n_devices):
+    ps = hv.add_process_set(list(range(n_devices // 2)), name="half")
+    x = rank_stacked(n_devices // 2, (4,), jnp.float32)
+    y = hvd.allreduce(x, hvd.Sum, process_set=ps)
+    np.testing.assert_allclose(np.asarray(y[0]),
+                               np.sum(np.asarray(x), axis=0), rtol=1e-5)
+    hv.remove_process_set("half")
+
+
+def test_process_set_registry(hvd, n_devices):
+    ps = hv.add_process_set([0, 1], name="pair")
+    assert "pair" in hv.process_set_names()
+    assert hv.get_process_set("pair").ranks == (0, 1)
+    with pytest.raises(hv.ProcessSetError):
+        hv.add_process_set([0, 2], name="pair")  # conflicting redefinition
+    with pytest.raises(hv.ProcessSetError):
+        hv.add_process_set([0, n_devices + 5])
+    hv.remove_process_set("pair")
+    assert "pair" not in hv.process_set_names()
+
+
+def test_in_step_collectives_inside_shard_map(hvd, n_devices):
+    """In-step ops compose inside a user shard_map (the hot path)."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+
+    def f(x):
+        local = x[0]
+        s = cops.allreduce(local, hv.Sum, axes=axes)
+        i = cops.axis_index(axes)
+        b = cops.broadcast(local, root_rank=2, axes=axes)
+        return (s + 0 * i)[None], b[None]
+
+    x = rank_stacked(n_devices, (4,), jnp.float32)
+    fs = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axes),
+                               out_specs=(P(axes), P(axes))))
+    s, b = fs(x)
+    np.testing.assert_allclose(np.asarray(s[0]),
+                               np.sum(np.asarray(x), axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(b[4]), np.asarray(x[2]), rtol=1e-6)

@@ -1,0 +1,99 @@
+"""Regression tests for review findings (cache keys, process-set edge
+cases, mixed-dtype grouping, autotune effectiveness)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hv
+from horovod_tpu.core.config import Config
+
+
+def test_cache_key_distinguishes_scale_and_compression(hvd, n_devices):
+    x = jnp.ones((n_devices, 8), jnp.float32)
+    y1 = hvd.allreduce(x, hvd.Sum, name="k")
+    y2 = hvd.allreduce(x, hvd.Sum, name="k", prescale_factor=0.5)
+    y3 = hvd.allreduce(x, hvd.Sum, name="k", compression=hv.Compression.fp16)
+    np.testing.assert_allclose(np.asarray(y1[0]), n_devices)
+    np.testing.assert_allclose(np.asarray(y2[0]), n_devices * 0.5)
+    np.testing.assert_allclose(np.asarray(y3[0]), n_devices, rtol=1e-3)
+
+
+def test_grouped_allreduce_mixed_dtypes(hvd, n_devices):
+    f = jnp.ones((n_devices, 4), jnp.float32) * 1.5
+    i = jnp.ones((n_devices, 3), jnp.int32) * 2
+    yf, yi = hvd.grouped_allreduce([f, i], hvd.Sum)
+    assert yf.dtype == jnp.float32 and yi.dtype == jnp.int32
+    np.testing.assert_allclose(np.asarray(yf[0]), 1.5 * n_devices)
+    np.testing.assert_array_equal(np.asarray(yi[0]), 2 * n_devices)
+
+
+def test_process_set_broadcast_root_and_nonmember_identity(hvd, n_devices):
+    ps = hv.add_process_set([0, 1], name="bc_pair")
+    x = jnp.arange(2 * 3, dtype=jnp.float32).reshape(2, 3)
+    y = hvd.broadcast(x, root_rank=1, process_set=ps)
+    for r in range(2):
+        np.testing.assert_allclose(np.asarray(y[r]), np.asarray(x[1]))
+    with pytest.raises(ValueError, match="not a member"):
+        hvd.broadcast(x, root_rank=5, process_set=ps)
+    hv.remove_process_set("bc_pair")
+
+
+def test_process_set_nonmember_identity_in_step(hvd, n_devices):
+    """Inside the global SPMD program, non-members keep their own value."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+    ps = hv.add_process_set([0, 1], name="step_pair")
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+
+    def f(x):
+        return cops.broadcast(x[0], root_rank=0, axes=axes,
+                              process_set=ps)[None]
+
+    x = jnp.arange(n_devices * 2, dtype=jnp.float32).reshape(n_devices, 2)
+    y = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axes),
+                              out_specs=P(axes)))(x)
+    np.testing.assert_allclose(np.asarray(y[1]), np.asarray(x[0]))  # member
+    for r in range(2, n_devices):  # non-members: identity
+        np.testing.assert_allclose(np.asarray(y[r]), np.asarray(x[r]))
+    hv.remove_process_set("step_pair")
+
+
+def test_scalar_input_raises_value_error(hvd):
+    with pytest.raises(ValueError, match="rank-stacked"):
+        hvd.allreduce(jnp.float32(3.0), hvd.Sum)
+
+
+def test_init_hierarchical_arg_wins(n_devices):
+    hv.shutdown()
+    hv.init(hierarchical=True)
+    assert hv.reduce_axes() == ("dcn", "ici")
+    hv.shutdown()
+
+
+def test_autotuner_sweeps_and_locks_in(n_devices, tmp_path):
+    import optax
+    hv.shutdown()
+    log = tmp_path / "autotune.csv"
+    hv.init(config=Config(autotune=True, autotune_log=str(log)))
+    from horovod_tpu.core.state import global_state
+    tuner = global_state().autotuner
+    tuner.steps_per_sample = 2
+
+    params = {"w": jnp.ones((16, 16)), "b": jnp.zeros((16,))}
+    opt = hv.DistributedOptimizer(optax.sgd(0.01))
+    params = hv.replicate(params)
+    opt_state = hv.replicate(opt.init(params))
+    step = hv.make_train_step(
+        lambda p, b: jnp.mean((b[0] @ p["w"] + p["b"] - b[1]) ** 2), opt)
+    batch = hv.shard_batch((np.ones((n_devices * 2, 16), np.float32),
+                            np.ones((n_devices * 2, 16), np.float32)))
+    n_steps = 2 * len(tuner.candidates) + 2
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    assert tuner.done
+    assert tuner.fusion_threshold() in tuner.candidates
+    assert log.exists() and "best" in log.read_text()
+    hv.shutdown()
