@@ -1,0 +1,94 @@
+"""Headline benchmark: ResNet-50 data-parallel training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Mirrors the reference's synthetic benchmark recipe (``tf_cnn_benchmarks`` /
+``*_synthetic_benchmark.py``, SURVEY.md section 6): synthetic ImageNet-shaped
+data resident on device, fwd+bwd+update per step through the full framework
+path (DistributedOptimizer fused allreduce, bf16 compute).
+
+``vs_baseline`` is 1.0 by definition: BASELINE.json.published is empty (the
+driver recorded no reference numbers), so the first recorded run *is* the
+baseline.  A watchdog guards against the axon TPU tunnel wedging (observed:
+computations can hang indefinitely when the pooled chip's grant is lost).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", "900"))
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+
+
+def _watchdog():
+    time.sleep(WATCHDOG_S)
+    print(json.dumps({"metric": "resnet50_images_per_sec_per_chip",
+                      "value": 0.0, "unit": "images/s/chip",
+                      "vs_baseline": 0.0,
+                      "error": f"watchdog: no result in {WATCHDOG_S}s "
+                               "(TPU tunnel wedged?)"}), flush=True)
+    os._exit(2)
+
+
+def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50
+    from horovod_tpu.training import make_flax_train_step
+
+    hvd.init()
+    n = hvd.size()
+    print(f"# devices: {n} x {jax.devices()[0].device_kind}", file=sys.stderr)
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    global_batch = BATCH * n
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (global_batch, 224, 224, 3), jnp.bfloat16)
+    y = jax.random.randint(key, (global_batch,), 0, 1000, jnp.int32)
+    variables = model.init(key, x[:2].astype(jnp.float32), train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    params = hvd.replicate(params)
+    batch_stats = hvd.replicate(batch_stats)
+    opt_state = hvd.replicate(opt.init(params))
+    step = make_flax_train_step(model.apply, opt)
+    batch = hvd.shard_batch((x, y))
+
+    # Warmup (compile + cache).
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(params, batch_stats,
+                                                    opt_state, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, batch_stats, opt_state, loss = step(params, batch_stats,
+                                                    opt_state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    ips_per_chip = STEPS * global_batch / dt / n
+    # Effective allreduce payload per step: fp32 grads of every param.
+    grad_bytes = sum(v.size * 4 for v in jax.tree.leaves(params))
+    print(f"# {STEPS} steps in {dt:.2f}s; grad payload "
+          f"{grad_bytes/2**20:.1f} MiB/step", file=sys.stderr)
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/s/chip",
+        "vs_baseline": 1.0,
+    }), flush=True)
+    os._exit(0)  # skip slow atexit teardown; result is already printed
+
+
+if __name__ == "__main__":
+    main()

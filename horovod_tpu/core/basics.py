@@ -52,6 +52,7 @@ def init(
     hierarchical: Optional[bool] = None,
     process_sets: Optional[Sequence[Sequence[int]]] = None,
     config: Optional[Config] = None,
+    mesh=None,
 ) -> None:
     """Initialize the framework (``hvd.init()`` parity).
 
@@ -92,7 +93,8 @@ def init(
         if devices is None:
             devices = jax.devices()
         st.config = cfg
-        st.mesh = _mesh.build_mesh(devices, hierarchical=hierarchical)
+        st.mesh = mesh if mesh is not None else \
+            _mesh.build_mesh(devices, hierarchical=hierarchical)
         st.initialized = True
         _ps._install_global_set()
         if process_sets:

@@ -1,0 +1,6 @@
+"""Benchmark/parity model zoo (reference workloads, TPU-first builds)."""
+
+from .lenet import LeNet  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
+)

@@ -38,6 +38,7 @@ HIER_AXES: Tuple[str, ...] = (DCN_AXIS, ICI_AXIS)
 def build_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     hierarchical: bool = False,
+    dcn_size: Optional[int] = None,
 ) -> Mesh:
     """Build the global communicator mesh.
 
@@ -46,6 +47,9 @@ def build_mesh(
         devices across all processes -- the MPI_COMM_WORLD analogue).
       hierarchical: build the 2-D ``(dcn, ici)`` mesh.  Requires the device
         count to factor as ``num_processes * devices_per_process``.
+      dcn_size: explicit outer-axis extent for the hierarchical mesh
+        (overrides the process grouping; used to emulate a multi-slice
+        topology on a single process, e.g. in multi-chip dry runs).
     """
     if devices is None:
         devices = jax.devices()
@@ -53,6 +57,12 @@ def build_mesh(
     n = len(devices)
     if not hierarchical:
         return Mesh(np.asarray(devices, dtype=object).reshape(n), (HVD_AXIS,))
+    if dcn_size is not None:
+        if n % dcn_size:
+            raise ValueError(f"{n} devices do not factor into dcn={dcn_size}")
+        grid = np.asarray(devices, dtype=object).reshape(dcn_size,
+                                                         n // dcn_size)
+        return Mesh(grid, (DCN_AXIS, ICI_AXIS))
 
     # Group by owning process: DCN axis = processes, ICI axis = local chips.
     procs = sorted({d.process_index for d in devices})

@@ -55,6 +55,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     donate: bool = True,
     loss_has_aux: bool = False,
+    aux_mode: str = "stacked",
 ) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
 
@@ -62,7 +63,17 @@ def make_train_step(
     shard; gradients flow through ``optimizer`` (wrap it with
     :func:`horovod_tpu.DistributedOptimizer` for the fused allreduce) and
     the returned loss is the global mean.
+
+    With ``loss_has_aux``, ``loss_fn`` returns ``(loss, aux)``.
+    ``aux_mode`` controls how aux crosses the mesh: ``"stacked"`` returns
+    the per-device values stacked on a leading axis; ``"averaged"``
+    mean-allreduces every aux leaf and returns it replicated -- use this
+    for mutated model state such as BatchNorm running statistics (the
+    cross-device averaging mirrors the reference's SyncBatchNorm stats
+    exchange, ``horovod/torch/sync_batch_norm.py``).
     """
+    if aux_mode not in ("stacked", "averaged"):
+        raise ValueError(f"unknown aux_mode {aux_mode!r}")
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
 
@@ -77,13 +88,19 @@ def make_train_step(
         params = optax.apply_updates(params, updates)
         loss = _ops.allreduce(loss, Average, axes=axes)
         if loss_has_aux:
+            if aux_mode == "averaged":
+                aux = jax.tree.map(
+                    lambda v: _ops.allreduce(v, Average, axes=axes)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v, aux)
             return params, opt_state, loss, aux
         return params, opt_state, loss
 
+    aux_spec = () if not loss_has_aux else \
+        ((P(),) if aux_mode == "averaged" else (P(axes),))
     shard = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(), P(axes)),
-        out_specs=(P(), P(), P()) + ((P(axes),) if loss_has_aux else ()),
+        out_specs=(P(), P(), P()) + aux_spec,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
@@ -118,6 +135,62 @@ def make_train_step(
         return out
 
     return tuned_step
+
+
+def make_flax_train_step(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    loss_fn: Optional[Callable] = None,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+):
+    """Data-parallel train step for flax modules with mutable batch stats.
+
+    Returns ``step(params, batch_stats, opt_state, (x, y)) ->
+    (params, batch_stats, opt_state, loss)``.  BatchNorm running statistics
+    are mean-allreduced each step (the reference's SyncBatchNorm stats
+    exchange); gradients flow through ``optimizer`` (wrap with
+    :func:`DistributedOptimizer`).  ``loss_fn(logits, y)`` defaults to
+    softmax cross-entropy with integer labels.
+    """
+    mesh = mesh or _basics.mesh()
+    axes = tuple(mesh.axis_names)
+    if loss_fn is None:
+        def loss_fn(logits, y):
+            return _softmax_xent(logits, y)
+
+    def local_step(params, batch_stats, opt_state, batch):
+        x, y = batch
+
+        def lf(p):
+            variables = {"params": p}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+                logits, mutated = apply_fn(variables, x, train=True,
+                                           mutable=["batch_stats"])
+                return loss_fn(logits, y), mutated.get("batch_stats", {})
+            logits = apply_fn(variables, x, train=True)
+            return loss_fn(logits, y), {}
+
+        (loss, new_stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        new_stats = jax.tree.map(
+            lambda v: _ops.allreduce(v, Average, axes=axes), new_stats)
+        loss = _ops.allreduce(loss, Average, axes=axes)
+        return params, new_stats, opt_state, loss
+
+    shard = jax.shard_map(local_step, mesh=mesh,
+                          in_specs=(P(), P(), P(), P(axes)),
+                          out_specs=(P(), P(), P(), P()),
+                          check_vma=False)
+    donate_argnums = (0, 1, 2) if donate else ()
+    return jax.jit(shard, donate_argnums=donate_argnums)
+
+
+def _softmax_xent(logits, y):
+    import optax as _optax
+    return _optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
 
 def make_eval_step(metric_fn: Callable[[Any, Any], Any],
