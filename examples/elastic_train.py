@@ -1,0 +1,56 @@
+"""Elastic training demo/integration workload.
+
+Counts "batches" with a tiny matmul train step, committing every batch;
+tolerates rescale (HostsUpdatedInterrupt) and peer failure (rollback).
+Used by the elastic integration tests with a mutating discovery script,
+mirroring the reference's ``test_elastic_torch.py`` localhost harness.
+"""
+
+import os
+import sys
+import time
+
+
+def main():
+    target = int(os.environ.get("ELASTIC_TARGET_BATCHES", "20"))
+    delay = float(os.environ.get("ELASTIC_BATCH_DELAY_S", "0.2"))
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    hvd.init()
+
+    @elastic.run
+    def train(state):
+        import horovod_tpu as hvd  # re-read size after potential re-init
+        opt = hvd.DistributedOptimizer(optax.sgd(0.01))
+        step_fn = hvd.make_train_step(
+            lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2), opt)
+        params = hvd.replicate(jax.tree.map(jnp.asarray, state.params))
+        opt_state = opt.init(params)
+        n = hvd.size()
+        while state.batch < target:
+            x = jnp.ones((2 * n, 4), jnp.float32)
+            y = jnp.zeros((2 * n, 4), jnp.float32)
+            batch = hvd.shard_batch((x, y))
+            params, opt_state, loss = step_fn(params, opt_state, batch)
+            state.params = jax.device_get(params)
+            state.batch += 1
+            print(f"rank {hvd.rank()}/{n} batch {state.batch} "
+                  f"loss {float(loss):.4f}", flush=True)
+            time.sleep(delay)
+            state.commit()
+        return state.batch
+
+    state = elastic.JaxState(
+        params={"w": jnp.zeros((4, 4), jnp.float32)}, batch=0)
+    done = train(state)
+    print(f"rank {hvd.rank()}: finished at batch {done} "
+          f"(final size {hvd.size()})", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,7 @@ from .collectives import ops  # noqa: F401  (in-step collectives)
 from .collectives.eager import (  # noqa: F401
     allreduce, allreduce_async, grouped_allreduce, allgather, broadcast,
     reducescatter, alltoall, barrier, join, synchronize, poll, local_result,
+    replicated_stack, local_rank_count,
 )
 from .optim.distributed import (  # noqa: F401
     DistributedOptimizer, DistributedAdasumOptimizer, allreduce_gradients,
@@ -45,6 +46,7 @@ from .optim.distributed import (  # noqa: F401
 from .optim.functions import (  # noqa: F401
     broadcast_parameters, broadcast_optimizer_state, broadcast_object,
 )
+from . import elastic  # noqa: F401
 from .training import (  # noqa: F401
     make_train_step, make_eval_step, shard_batch, replicate,
     batch_sharding, replicated_sharding,

@@ -48,16 +48,39 @@ def _is_multiprocess(mesh: Mesh) -> bool:
     return len({d.process_index for d in mesh.devices.flat}) > 1
 
 
+def local_rank_count(ps=None) -> int:
+    """Number of this process's devices in the set (= rows this process
+    contributes to a rank-stacked eager input in multi-process mode)."""
+    ps = _ps.get_process_set(ps)
+    mesh = ps.flat_mesh()
+    if not _is_multiprocess(mesh):
+        return int(mesh.devices.size)
+    me = jax.process_index()
+    return sum(1 for d in mesh.devices.flat if d.process_index == me)
+
+
+def replicated_stack(leaf, ps=None) -> np.ndarray:
+    """Stack one host value into the correctly-sized rank-stacked input for
+    the current mode (all ranks in single-process; local ranks otherwise)."""
+    x = np.asarray(leaf)
+    k = local_rank_count(ps)
+    return np.broadcast_to(x[None], (k,) + x.shape)
+
+
 def _to_global(x, mesh: Mesh):
     """Assemble the rank-stacked global array on the eager mesh."""
     n = int(mesh.devices.size)
     sharding = NamedSharding(mesh, P(HVD_AXIS))
     if _is_multiprocess(mesh):
         local = np.asarray(x)
-        if local.ndim == 0 or local.shape[0] != \
-                sum(1 for d in mesh.devices.flat
-                    if d.process_index == jax.process_index()):
-            local = np.stack([local] * max(1, jax.local_device_count()))
+        me = jax.process_index()
+        k = sum(1 for d in mesh.devices.flat if d.process_index == me)
+        if local.ndim == 0 or local.shape[0] != k:
+            raise ValueError(
+                f"multi-process eager collectives take this process's local "
+                f"rank stack: expected leading axis {k}, got shape "
+                f"{local.shape} (use horovod_tpu.replicated_stack for "
+                f"replicated host values)")
         global_shape = (n,) + local.shape[1:]
         return jax.make_array_from_process_local_data(
             sharding, local, global_shape)
@@ -248,8 +271,8 @@ def alltoall(x, *, name=None, process_set=None):
 def barrier(*, process_set=None) -> None:
     """Block until every member device reaches the barrier."""
     ps = _ps.get_process_set(process_set)
-    n = ps.size()
-    out = _run("barrier", jnp.ones((n, 1), jnp.int32), "barrier", ps,
+    ones = replicated_stack(np.ones((1,), np.int32), ps)
+    out = _run("barrier", ones, "barrier", ps,
                lambda t: _ops.barrier(axes=(HVD_AXIS,)) * t, "barrier")
     jax.block_until_ready(out)
 

@@ -72,6 +72,17 @@ def init(
         cfg = config if config is not None else load_config()
         _setup_logging(cfg.log_level)
 
+        if cfg.force_cpu:
+            # Must run before any backend initialization; the TPU plugin's
+            # sitecustomize pre-sets jax_platforms, so the env var alone is
+            # not enough.
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                logger.warning("force_cpu set but backends already "
+                               "initialized; continuing on %s",
+                               jax.default_backend())
+
         # Multi-process bootstrap: the launcher hands us a coordinator
         # address (HOROVOD_GLOO_RENDEZVOUS_ADDR analogue) and our process
         # identity; jax.distributed is the rendezvous+control plane.

@@ -102,6 +102,10 @@ class Config:
     # Debug-mode desync checksums (no reference equivalent; SURVEY.md 5.2).
     check_desync: bool = False
 
+    # Force the XLA:CPU backend before first device use (the launcher's
+    # --cpu test mode; the Gloo-CPU-backend analogue).
+    force_cpu: bool = False
+
 
 def load_config() -> Config:
     """Parse the environment into a :class:`Config`."""
@@ -133,4 +137,5 @@ def load_config() -> Config:
         coordinator_addr=addr,
         coordinator_port=port,
         check_desync=_env_bool("CHECK_DESYNC"),
+        force_cpu=_env_bool("FORCE_CPU"),
     )

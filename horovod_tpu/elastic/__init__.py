@@ -1,0 +1,13 @@
+"""Elastic (fault-tolerant, rescalable) training.
+
+Reference layer: ``horovod/runner/elastic/`` + framework ``elastic``
+modules (SURVEY.md sections 3.5, 4.5, 5.3): state commit/restore/sync,
+the ``@hvd.elastic.run`` rollback loop, host discovery, and a driver that
+re-rendezvouses workers through fresh JAX-coordination epochs instead of
+Gloo rendezvous rounds.
+"""
+
+from .state import State, ObjectState, JaxState  # noqa: F401
+from .run_loop import run, check_for_host_updates  # noqa: F401
+from .sampler import ElasticSampler  # noqa: F401
+from .discovery import HostDiscoveryScript  # noqa: F401

@@ -1,0 +1,63 @@
+"""Host discovery for elastic jobs.
+
+Reference: ``horovod/runner/elastic/discovery.py`` -- the driver polls a
+user-supplied ``--host-discovery-script`` whose stdout lists one
+``host[:slots]`` per line; the set may change at any time (scale-up,
+scale-down, preemption).  On TPU, "host" is a pod-slice worker VM (or a
+whole slice in multi-slice jobs); locally it is an alias for test worker
+processes.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Dict
+
+
+class HostDiscoveryScript:
+    def __init__(self, script: str, default_slots: int = 1,
+                 timeout: float = 10.0):
+        self.script = script
+        self.default_slots = default_slots
+        self.timeout = timeout
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        """Run the script; returns {host: slots}.  Failures -> empty set
+        (treated as 'no hosts currently available')."""
+        try:
+            out = subprocess.run([self.script], capture_output=True,
+                                 text=True, timeout=self.timeout)
+        except (OSError, subprocess.TimeoutExpired):
+            return {}
+        if out.returncode != 0:
+            return {}
+        hosts: Dict[str, int] = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            host, slots = self._parse_line(line)
+            hosts[host] = slots
+        return hosts
+
+    def _parse_line(self, line: str):
+        # Accepted forms: "host", "host:slots", "[ipv6]", "[ipv6]:slots".
+        # A bare IPv6 address ("::1") is a host with default slots; only a
+        # single-colon "host:int" (or bracketed form) carries a slot count.
+        if line.startswith("["):
+            addr, _, rest = line.partition("]")
+            host = addr[1:] or line
+            if rest.startswith(":"):
+                try:
+                    return host, int(rest[1:])
+                except ValueError:
+                    pass
+            return host, self.default_slots
+        if line.count(":") == 1:
+            host, _, slots = line.partition(":")
+            if host:
+                try:
+                    return host, int(slots)
+                except ValueError:
+                    pass
+        return line, self.default_slots

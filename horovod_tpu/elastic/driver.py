@@ -1,0 +1,155 @@
+"""Elastic driver: discovery polling, worker supervision, re-rendezvous.
+
+Reference: ``horovod/runner/elastic/driver.py`` (+ ``registration.py``
+blacklisting): poll the discovery script; on host-set change notify
+workers (-> ``HostsUpdatedInterrupt``), spawn workers on new hosts,
+blacklist failing slots, gate on ``--min-np``, and re-rendezvous.
+
+TPU-native differences: the rendezvous is the JAX coordination service --
+each membership epoch gets a fresh coordinator port published through the
+assignment file (see ``notify.py``); workers rebuild their comm plane
+against it without being respawned.  Worker processes are spawned locally
+(on a pod slice the per-VM agent plays this role; locally this doubles as
+the reference's localhost elastic test harness).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..run.exec_util import TaggedProcess
+from ..run.launch import free_port, worker_env
+from .discovery import HostDiscoveryScript
+from .notify import ASSIGNMENT_ENV, WORKER_ID_ENV, write_assignment
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+
+class ElasticDriver:
+    def __init__(self, command: List[str], discovery_script: str,
+                 min_np: int = 1, max_np: Optional[int] = None,
+                 cpu: bool = False, slots: int = 1, verbose: int = 0,
+                 poll_interval_s: float = 1.0,
+                 elastic_timeout_s: float = 600.0):
+        self.command = list(command)
+        self.discovery = HostDiscoveryScript(discovery_script,
+                                             default_slots=slots)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.cpu = cpu
+        self.slots = slots
+        self.verbose = verbose
+        self.poll_interval_s = poll_interval_s
+        self.elastic_timeout_s = elastic_timeout_s
+        self.epoch = -1
+        self.blacklist: set = set()
+        self.workers: Dict[str, TaggedProcess] = {}  # worker_id -> proc
+        self._assignment_dir = tempfile.mkdtemp(prefix="hvd_tpu_elastic_")
+        self.assignment_path = os.path.join(self._assignment_dir,
+                                            "assignment.json")
+        self._lock = threading.Lock()
+
+    # -- membership -------------------------------------------------------
+    def _desired_workers(self) -> List[str]:
+        hosts = self.discovery.find_available_hosts_and_slots()
+        ids = []
+        for host in sorted(hosts):
+            for slot in range(hosts[host]):
+                wid = f"{host}:{slot}"
+                if wid not in self.blacklist:
+                    ids.append(wid)
+        if self.max_np is not None:
+            ids = ids[:self.max_np]
+        return ids
+
+    def _publish(self, worker_ids: List[str], port: int) -> Dict[str, int]:
+        self.epoch += 1
+        ranks = {wid: i for i, wid in enumerate(sorted(worker_ids))}
+        write_assignment(self.assignment_path, self.epoch,
+                         len(worker_ids), port, ranks)
+        logger.info("elastic epoch %d: %d worker(s), port %d",
+                    self.epoch, len(worker_ids), port)
+        return ranks
+
+    def _spawn(self, wid: str, rank: int, size: int, port: int) -> None:
+        env = dict(os.environ)
+        env.update(worker_env(rank=rank, size=size, coordinator="127.0.0.1",
+                              port=port, cpu=self.cpu, slots=1,
+                              local_rank=rank, local_size=size))
+        env[ASSIGNMENT_ENV] = self.assignment_path
+        env[WORKER_ID_ENV] = wid
+        if self.verbose:
+            env["HOROVOD_LOG_LEVEL"] = "info"
+        self.workers[wid] = TaggedProcess(rank, self.command, env,
+                                          lock=self._lock)
+
+    # -- main loop --------------------------------------------------------
+    def run(self) -> int:
+        deadline = time.monotonic() + self.elastic_timeout_s
+        desired: List[str] = []
+        while len(desired) < self.min_np:
+            desired = self._desired_workers()
+            if len(desired) >= self.min_np:
+                break
+            if time.monotonic() > deadline:
+                logger.error("min-np=%d not reached before elastic timeout",
+                             self.min_np)
+                return 1
+            time.sleep(self.poll_interval_s)
+
+        port = free_port()
+        ranks = self._publish(desired, port)
+        for wid in desired:
+            self._spawn(wid, ranks[wid], len(desired), port)
+
+        while True:
+            time.sleep(self.poll_interval_s)
+            # 1. Reap exits.
+            finished_ok = []
+            failed = []
+            for wid, proc in list(self.workers.items()):
+                code = proc.poll()
+                if code is None:
+                    continue
+                proc.wait()
+                del self.workers[wid]
+                (finished_ok if code == 0 else failed).append((wid, code))
+            for wid, code in failed:
+                logger.warning("worker %s failed (exit %d); blacklisting",
+                               wid, code)
+                self.blacklist.add(wid)
+            if not self.workers and (finished_ok or failed):
+                # Everyone exited: success only if nothing failed.
+                return failed[0][1] if failed else 0
+            if finished_ok and self.workers:
+                # Graceful finish is collective; stragglers follow shortly.
+                continue
+
+            # 2. Discover the desired set.
+            desired = self._desired_workers()
+            current = set(self.workers)
+            if failed or set(desired) != current:
+                alive = [wid for wid in desired if wid in current]
+                newcomers = [wid for wid in desired if wid not in current]
+                removed = [wid for wid in current if wid not in desired]
+                next_set = alive + newcomers
+                if len(next_set) < self.min_np:
+                    logger.error("%d worker(s) < min-np=%d; aborting",
+                                 len(next_set), self.min_np)
+                    for proc in self.workers.values():
+                        proc.terminate()
+                    return 1
+                port = free_port()
+                ranks = self._publish(next_set, port)
+                for wid in removed:
+                    self.workers[wid].terminate()
+                    self.workers.pop(wid, None)
+                for wid in newcomers:
+                    self._spawn(wid, ranks[wid], len(next_set), port)
+                # Survivors pick the new epoch up from the assignment file
+                # at their next commit boundary.

@@ -1,0 +1,79 @@
+"""Worker <-> driver signalling for elastic runs.
+
+Reference: ``horovod/runner/elastic/worker.py`` (WorkerNotificationService:
+the driver pushes a HostsUpdated ping over HTTP; workers raise
+``HostsUpdatedInterrupt`` at the next commit boundary).
+
+This runtime uses an *assignment file* per job: the driver atomically
+rewrites a JSON document ``{"epoch": N, "size": S, "port": P,
+"ranks": {worker_id: rank}}`` whenever membership changes; workers poll
+its epoch (cheap stat+read) inside ``state.commit()``/the run loop.  A
+file works both for localhost tests and for TPU pod slices with a shared
+staging volume; a TCP push channel can replace it without touching the
+worker-side API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+ASSIGNMENT_ENV = "HVD_TPU_ELASTIC_ASSIGNMENT"
+WORKER_ID_ENV = "HVD_TPU_ELASTIC_WORKER_ID"
+
+
+def write_assignment(path: str, epoch: int, size: int, port: int,
+                     ranks: Dict[str, int]) -> None:
+    """Atomically publish a new membership epoch (driver side)."""
+    doc = {"epoch": epoch, "size": size, "port": port, "ranks": ranks}
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_assignment(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class Notifier:
+    """Worker-side epoch watcher."""
+
+    def __init__(self, path: Optional[str] = None,
+                 worker_id: Optional[str] = None):
+        self.path = path or os.environ.get(ASSIGNMENT_ENV)
+        self.worker_id = worker_id or os.environ.get(WORKER_ID_ENV)
+        self.current_epoch = -1
+        doc = self.read()
+        if doc:
+            self.current_epoch = doc["epoch"]
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None
+
+    def read(self) -> Optional[dict]:
+        return read_assignment(self.path) if self.path else None
+
+    def updated(self) -> Optional[dict]:
+        """The new assignment doc if the epoch advanced, else None."""
+        doc = self.read()
+        if doc and doc["epoch"] > self.current_epoch:
+            return doc
+        return None
+
+    def accept(self, doc: dict) -> None:
+        self.current_epoch = doc["epoch"]

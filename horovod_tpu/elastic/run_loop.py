@@ -1,0 +1,134 @@
+"""The elastic run decorator: rollback + re-rendezvous control flow.
+
+Parity with the reference's worker loop (``hvd.elastic.run``, SURVEY.md
+section 4.5)::
+
+    loop:
+      state.sync()            # broadcast from rank 0 after any reset
+      try: func(state, ...)   # user training, commits at batch boundaries
+      except HorovodInternalError:   -> state.restore()  (peer died)
+      except HostsUpdatedInterrupt:  -> pass             (topology changed)
+      shutdown; re-rendezvous; init  # full comm-plane rebuild
+
+The comm-plane rebuild is TPU-native: tear down the JAX distributed client
+and re-initialize against the coordinator/port published in the driver's
+assignment file (epoch N+1), then rebuild the mesh.  A failed collective
+surfaces as a jax RuntimeError/XlaRuntimeError -- the loop converts any
+error carrying a distributed-runtime signature into the rollback path.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import time
+from typing import Any, Callable
+
+import jax
+
+from ..core import basics as _basics
+from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .notify import Notifier
+from .state import State
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+
+def _looks_like_comm_failure(err: BaseException) -> bool:
+    text = f"{type(err).__name__}: {err}"
+    needles = ("DEADLINE_EXCEEDED", "UNAVAILABLE", "connection",
+               "Connection", "gloo", "Gloo", "distributed", "heartbeat",
+               "coordinator", "barrier timed out", "preempt")
+    return any(n in text for n in needles)
+
+
+def check_for_host_updates(state: State) -> None:
+    """Raise ``HostsUpdatedInterrupt`` when the driver advanced the epoch.
+
+    Call at commit boundaries (``JaxState.commit`` callers do this via the
+    run loop; explicit calls are allowed anywhere in user code).
+    """
+    notifier: Notifier = getattr(state, "_hvd_notifier", None)
+    if notifier is None or not notifier.enabled:
+        return
+    doc = notifier.updated()
+    if doc:
+        state.on_hosts_updated()
+        raise HostsUpdatedInterrupt()
+
+
+def _reinitialize(notifier: Notifier) -> None:
+    """Full comm-plane rebuild against the latest assignment."""
+    _basics.shutdown()
+    doc = None
+    found = False
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        doc = notifier.read()
+        if doc and doc["epoch"] > notifier.current_epoch and \
+                notifier.worker_id in doc["ranks"]:
+            found = True
+            break
+        time.sleep(0.5)
+    if not found:
+        raise HorovodInternalError(
+            "no new elastic assignment including this worker was published "
+            "before the deadline (driver gone, or this worker scaled out)")
+    notifier.accept(doc)
+    rank, size = doc["ranks"][notifier.worker_id], doc["size"]
+    os.environ["HOROVOD_RANK"] = str(rank)
+    os.environ["HOROVOD_SIZE"] = str(size)
+    # Single-host driver: local == global (matches run.launch.worker_env).
+    os.environ["HOROVOD_LOCAL_RANK"] = str(rank)
+    os.environ["HOROVOD_LOCAL_SIZE"] = str(size)
+    os.environ["HVD_TPU_COORDINATOR_PORT"] = str(doc["port"])
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # pragma: no cover - client may already be gone
+        pass
+    # Tear the XLA backends down so jax.distributed can re-initialize in
+    # process -- the TPU-native equivalent of the reference's full
+    # shutdown/re-init comm-plane rebuild.
+    from jax._src import xla_bridge
+    xla_bridge._clear_backends()
+    jax.clear_caches()
+    _basics.init()
+
+
+def run(func: Callable[..., Any]) -> Callable[..., Any]:
+    """``@hvd.elastic.run`` decorator: ``run(train)(state, *args)``."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        notifier = Notifier()
+        state._hvd_notifier = notifier
+        reset_required = False
+        while True:
+            if reset_required:
+                _reinitialize(notifier)
+                state.on_reset()
+                reset_required = False
+            try:
+                # sync() ends in commit(), which may itself raise
+                # HostsUpdatedInterrupt -- keep it inside the catch.
+                state.sync()
+                return func(state, *args, **kwargs)
+            except HostsUpdatedInterrupt:
+                logger.info("hosts updated; re-rendezvousing")
+                reset_required = True
+            except HorovodInternalError:
+                logger.warning("collective failed; rolling back to last "
+                               "commit")
+                state.restore()
+                reset_required = True
+            except Exception as e:  # noqa: BLE001
+                if _looks_like_comm_failure(e):
+                    logger.warning("comm-plane failure (%s); rolling back",
+                                   type(e).__name__)
+                    state.restore()
+                    reset_required = True
+                else:
+                    raise
+
+    return wrapper

@@ -1,0 +1,137 @@
+"""Elastic state objects: commit / restore / sync.
+
+Parity with ``horovod/torch/elastic/state.py`` (``TorchState``) and
+``horovod/common/elastic``: a :class:`State` snapshots registered values in
+host memory on ``commit()`` (cheap, no disk), rolls back on ``restore()``
+(after a failed collective), and ``sync()``s from rank 0 after any
+rendezvous so new/restarted workers adopt the survivors' progress.
+
+``JaxState`` holds arbitrary pytrees (params, optimizer state) plus python
+scalars; pytree leaves are snapshotted with ``jax.device_get`` (host RAM,
+preemption-safe) and synced with
+:func:`horovod_tpu.optim.functions.broadcast_`.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class State:
+    """Base elastic state: commit/restore/sync + reset listeners."""
+
+    def __init__(self):
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp=None, update_res=None) -> None:
+        """Hook invoked when the driver announces a topology change."""
+
+    def _check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt at the commit boundary if the driver
+        advanced the membership epoch (reference: commit is the interrupt
+        point).  The snapshot is taken before the check, so no progress is
+        lost."""
+        from .run_loop import check_for_host_updates
+        check_for_host_updates(self)
+
+    def commit(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """Elastic state over plain python attributes (pickle-synced).
+
+    Reference: ``horovod/common/elastic.py::ObjectState``.
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._known = list(kwargs)
+        self.commit()
+
+    def commit(self) -> None:
+        self._saved = {k: copy.deepcopy(getattr(self, k))
+                       for k in self._known}
+        self._check_host_updates()
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        from ..optim.functions import broadcast_object
+        values = {k: getattr(self, k) for k in self._known}
+        values = broadcast_object(values, root_rank=0)
+        for k, v in values.items():
+            setattr(self, k, v)
+        self.commit()
+
+
+class JaxState(State):
+    """Elastic state holding pytrees (params/opt state) + scalar counters.
+
+    Usage::
+
+        state = hvd.elastic.JaxState(params=params, opt_state=opt_state,
+                                     batch=0, epoch=0)
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._tree_keys: List[str] = []
+        self._scalar_keys: List[str] = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+            if isinstance(v, (int, float, str, bool)) or v is None:
+                self._scalar_keys.append(k)
+            else:
+                self._tree_keys.append(k)
+        self._saved_trees: Dict[str, Any] = {}
+        self._saved_scalars: Dict[str, Any] = {}
+        self.commit()
+
+    def commit(self) -> None:
+        # Host-RAM snapshot (device_get): survives device-state loss on
+        # preemption/rescale, the whole point of elastic commit.
+        self._saved_trees = {
+            k: jax.device_get(getattr(self, k)) for k in self._tree_keys}
+        self._saved_scalars = {
+            k: copy.deepcopy(getattr(self, k)) for k in self._scalar_keys}
+        self._check_host_updates()
+
+    def restore(self) -> None:
+        for k, v in self._saved_trees.items():
+            setattr(self, k, jax.tree.map(jnp.asarray, v))
+        for k, v in self._saved_scalars.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        from ..optim.functions import broadcast_, broadcast_object
+        for k in self._tree_keys:
+            setattr(self, k, broadcast_(jax.device_get(getattr(self, k)),
+                                        root_rank=0))
+        scalars = broadcast_object(
+            {k: getattr(self, k) for k in self._scalar_keys}, root_rank=0)
+        for k, v in scalars.items():
+            setattr(self, k, v)
+        self.commit()

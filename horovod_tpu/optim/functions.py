@@ -18,9 +18,14 @@ from ..collectives import eager as _eager
 from ..core import process_sets as _ps
 
 
-def _stacked(leaf, n: int):
-    x = np.asarray(leaf)
-    return np.broadcast_to(x[None], (n,) + x.shape)
+def _one_row(out) -> np.ndarray:
+    """One rank's row of a rank-stacked result.
+
+    After a broadcast every row is identical, so any locally-addressable
+    shard will do -- this also works in multi-process mode, where the
+    global array spans non-addressable devices.
+    """
+    return np.asarray(out.addressable_shards[0].data)[0]
 
 
 def broadcast_(tree: Any, root_rank: int = 0, *, process_set=None) -> Any:
@@ -31,14 +36,13 @@ def broadcast_(tree: Any, root_rank: int = 0, *, process_set=None) -> Any:
     through :func:`broadcast_object`.
     """
     ps = _ps.get_process_set(process_set)
-    n = ps.size()
 
     def bcast_leaf(leaf):
         if isinstance(leaf, (jax.Array, np.ndarray)) or \
                 isinstance(leaf, (jnp.bfloat16,)) or hasattr(leaf, "dtype"):
-            out = _eager.broadcast(_stacked(leaf, n), root_rank,
-                                   process_set=ps)
-            return jnp.asarray(out)[0]
+            out = _eager.broadcast(_eager.replicated_stack(leaf, ps),
+                                   root_rank, process_set=ps)
+            return jnp.asarray(_one_row(out))
         return broadcast_object(leaf, root_rank, process_set=ps)
 
     return jax.tree.map(bcast_leaf, tree)
@@ -65,13 +69,12 @@ def broadcast_object(obj: Any, root_rank: int = 0, *,
     size-prefixed byte stream.
     """
     ps = _ps.get_process_set(process_set)
-    n = ps.size()
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     size = np.array([len(payload)], dtype=np.int32)
-    gsize = np.asarray(_eager.broadcast(_stacked(size, n), root_rank,
-                                        process_set=ps))[0, 0]
-    buf = np.zeros(int(gsize), dtype=np.uint8)
-    buf[:min(len(payload), int(gsize))] = payload[:int(gsize)]
-    out = np.asarray(_eager.broadcast(_stacked(buf, n), root_rank,
-                                      process_set=ps))[0]
+    gsize = int(_one_row(_eager.broadcast(
+        _eager.replicated_stack(size, ps), root_rank, process_set=ps))[0])
+    buf = np.zeros(gsize, dtype=np.uint8)
+    buf[:min(len(payload), gsize)] = payload[:gsize]
+    out = _one_row(_eager.broadcast(
+        _eager.replicated_stack(buf, ps), root_rank, process_set=ps))
     return pickle.loads(out.tobytes())

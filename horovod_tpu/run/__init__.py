@@ -1,0 +1,3 @@
+"""Launcher (horovodrun analogue): see horovod_tpu/run/launch.py."""
+
+from .launch import run_command, worker_env, check_build, free_port  # noqa: F401

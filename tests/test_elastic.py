@@ -1,0 +1,156 @@
+"""Elastic subsystem tests: state objects, sampler, notifier, discovery,
+and a live rescale integration run with a mutating discovery script
+(reference ``test/integration/test_elastic_torch.py`` pattern)."""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hv
+from horovod_tpu import elastic
+from horovod_tpu.elastic.notify import (Notifier, read_assignment,
+                                        write_assignment)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_object_state_commit_restore(hvd):
+    s = elastic.ObjectState(count=1, name="a")
+    s.count = 5
+    s.restore()
+    assert s.count == 1
+    s.count = 7
+    s.commit()
+    s.count = 9
+    s.restore()
+    assert s.count == 7
+
+
+def test_jax_state_commit_restore_sync(hvd):
+    s = elastic.JaxState(params={"w": jnp.ones((3,))}, batch=0)
+    s.params = {"w": jnp.zeros((3,))}
+    s.batch = 4
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.params["w"]), 1.0)
+    assert s.batch == 0
+    s.params = {"w": jnp.full((3,), 2.0)}
+    s.batch = 2
+    s.commit()
+    s.sync()  # single process: broadcast from rank 0 is identity
+    np.testing.assert_allclose(np.asarray(s.params["w"]), 2.0)
+    assert s.batch == 2
+
+
+def test_elastic_sampler_reshards_remaining():
+    s = elastic.ElasticSampler(num_samples=10, shuffle=False)
+    s.set_rank_and_size(0, 2)
+    first = list(s)[:2]
+    s.record_batch(first)
+    # Rescale 2 -> 1: remaining indices exclude processed ones.
+    s.set_rank_and_size(0, 1)
+    rest = list(s)
+    assert set(first).isdisjoint(rest)
+    assert set(first) | set(rest) == set(range(10))
+    state = s.state_dict()
+    s2 = elastic.ElasticSampler(num_samples=10, shuffle=False)
+    s2.load_state_dict(state)
+    assert set(s2.remaining) == set(rest)
+
+
+def test_notifier_epoch_tracking(tmp_path):
+    path = str(tmp_path / "assign.json")
+    write_assignment(path, epoch=0, size=2, port=1000,
+                     ranks={"h:0": 0, "h:1": 1})
+    n = Notifier(path=path, worker_id="h:0")
+    assert n.current_epoch == 0
+    assert n.updated() is None
+    write_assignment(path, epoch=1, size=1, port=1001, ranks={"h:0": 0})
+    doc = n.updated()
+    assert doc and doc["size"] == 1
+    n.accept(doc)
+    assert n.updated() is None
+    assert read_assignment(str(tmp_path / "missing.json")) is None
+
+
+def test_discovery_script_parsing(tmp_path):
+    script = tmp_path / "disc.sh"
+    script.write_text("#!/bin/sh\necho host1:2\necho host2\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    d = elastic.HostDiscoveryScript(str(script), default_slots=3)
+    assert d.find_available_hosts_and_slots() == {"host1": 2, "host2": 3}
+    bad = elastic.HostDiscoveryScript(str(tmp_path / "nope.sh"))
+    assert bad.find_available_hosts_and_slots() == {}
+
+
+def test_discovery_parser_edge_cases(tmp_path):
+    d = elastic.HostDiscoveryScript("unused", default_slots=2)
+    assert d._parse_line("host:4") == ("host", 4)
+    assert d._parse_line("host") == ("host", 2)
+    assert d._parse_line("::1") == ("::1", 2)          # bare IPv6
+    assert d._parse_line("[::1]") == ("::1", 2)
+    assert d._parse_line("[::1]:8") == ("::1", 8)
+    assert d._parse_line("host:gpu") == ("host:gpu", 2)  # non-int suffix
+
+
+def test_commit_raises_hosts_updated(tmp_path, hvd):
+    path = str(tmp_path / "assign.json")
+    write_assignment(path, epoch=0, size=1, port=1, ranks={"h:0": 0})
+    s = elastic.ObjectState(x=1)
+    s._hvd_notifier = Notifier(path=path, worker_id="h:0")
+    s.commit()  # no change: fine
+    write_assignment(path, epoch=1, size=2, port=2,
+                     ranks={"h:0": 0, "h:1": 1})
+    s.x = 42
+    with pytest.raises(hv.HostsUpdatedInterrupt):
+        s.commit()
+    s.restore()
+    assert s.x == 42  # commit snapshots BEFORE the interrupt check
+
+
+@pytest.mark.integration
+def test_elastic_scale_down_live(tmp_path):
+    """3 workers -> discovery drops one -> survivors re-rendezvous at size
+    2 and finish."""
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("a\nb\nc\n")
+    disc = tmp_path / "disc.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hosts}\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TARGET_BATCHES"] = "60"
+    env["ELASTIC_BATCH_DELAY_S"] = "0.4"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run",
+         "--host-discovery-script", str(disc), "--min-np", "2", "--cpu",
+         sys.executable, os.path.join(REPO, "examples", "elastic_train.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    lines = []
+    try:
+        # Mutate discovery only once training demonstrably progresses, so
+        # the rescale lands mid-run regardless of machine load.
+        deadline = time.time() + 240
+        mutated = False
+        for line in proc.stdout:
+            lines.append(line)
+            if not mutated and " batch 5 " in line:
+                hosts.write_text("a\nb\n")  # drop host c mid-run
+                mutated = True
+            if time.time() > deadline:
+                raise TimeoutError("no progress")
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = "".join(lines)
+    assert mutated, out[-4000:]
+    assert proc.returncode == 0, out[-4000:]
+    assert "final size 2" in out, out[-4000:]

@@ -1,0 +1,73 @@
+"""Launcher tests (reference ``test/single/test_run.py`` analogue) plus a
+real 2-process integration run (``test_static_run.py`` analogue)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.run import check_build, free_port, worker_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_check_build_lists_capabilities():
+    text = check_build()
+    assert "XLA:TPU collectives" in text
+    assert "Adasum" in text
+    assert "elastic" in text
+
+
+def test_free_port_is_bindable():
+    import socket
+    p = free_port()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", p))
+
+
+def test_worker_env_contents():
+    env = worker_env(rank=1, size=4, coordinator="127.0.0.1", port=1234,
+                     cpu=True, slots=2)
+    assert env["HOROVOD_RANK"] == "1"
+    assert env["HOROVOD_SIZE"] == "4"
+    assert env["HVD_TPU_COORDINATOR_PORT"] == "1234"
+    assert env["HVD_TPU_FORCE_CPU"] == "1"
+    assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+
+
+def test_cli_requires_command():
+    from horovod_tpu.run import run_command
+    with pytest.raises(SystemExit):
+        run_command(["-np", "2"])
+
+
+@pytest.mark.integration
+def test_two_process_static_run():
+    """Spawn a real 2-process job through the CLI (slow: ~30s)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Workers must not inherit the test session's forced-cpu XLA flags in a
+    # way that conflicts; launcher sets its own.
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--cpu",
+         sys.executable, os.path.join(REPO, "examples",
+                                      "allreduce_check.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "[0]<stdout>" in out.stdout
+    assert "rank 0: barrier OK" in out.stdout
+    assert "rank 1: barrier OK" in out.stdout
+
+
+@pytest.mark.integration
+def test_failing_worker_propagates_exit_code(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--cpu",
+         sys.executable, str(bad)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 3
