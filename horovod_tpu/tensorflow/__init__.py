@@ -1,0 +1,201 @@
+"""``horovod_tpu.tensorflow``: drop-in ``horovod.tensorflow`` API.
+
+Parity surface (reference ``horovod/tensorflow/__init__.py`` +
+``mpi_ops.py``): ``init/rank/size/...``, eager tensor collectives
+(``allreduce``, ``allgather``, ``broadcast``, ``alltoall``,
+``grouped_allreduce``), **``DistributedGradientTape``** (wraps
+``tf.GradientTape``; ``gradient()`` returns globally-reduced gradients),
+``broadcast_variables``, and ``DistributedOptimizer`` for Keras.
+
+TF stays the user-facing autograd engine on host CPU; collectives stage
+through numpy onto the XLA mesh (same bridge as the torch shim).  TF2
+eager only -- the reference's TF1 session hooks
+(``BroadcastGlobalVariablesHook``) are intentionally out of scope.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import tensorflow as tf
+
+from ..core.basics import (  # noqa: F401
+    init, shutdown, is_initialized, size, rank, local_size, local_rank,
+    cross_size, cross_rank, is_homogeneous, nccl_built, mpi_built,
+    gloo_built, tpu_built, mpi_threads_supported,
+)
+from ..core.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..core.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, get_process_set,
+)
+from ..collectives.reduce_op import (  # noqa: F401
+    ReduceOp, Average, Sum, Min, Max, Product, Adasum,
+)
+from ..collectives.compression import Compression  # noqa: F401
+from ..collectives import eager as _eager
+
+
+def _to_stack(t) -> np.ndarray:
+    return _eager.replicated_stack(np.asarray(t))
+
+
+def _from_row(out, like) -> tf.Tensor:
+    row = np.array(np.asarray(out.addressable_shards[0].data)[0])
+    return tf.convert_to_tensor(row, dtype=like.dtype if
+                                hasattr(like, "dtype") else None)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=Compression.none,
+              op: Optional[ReduceOp] = None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0, process_set=None) -> tf.Tensor:
+    if op is None:
+        op = Sum if average is False else Average
+    out = _eager.allreduce(_to_stack(tensor), op, name=name,
+                           process_set=process_set,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           compression=compression)
+    return _from_row(out, tensor)
+
+
+def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
+                      process_set=None) -> List[tf.Tensor]:
+    if op is None:
+        op = Sum if average is False else Average
+    tensors = list(tensors)
+    if not tf.executing_eagerly():
+        # Inside a tf.function graph (keras fit): hop out via py_function
+        # so the XLA-mesh collective runs eagerly (the reference registers
+        # custom TF kernels for this; the bridge cost is equivalent).
+        def _reduce(*ts):
+            outs = _eager.grouped_allreduce([_to_stack(t) for t in ts], op,
+                                            name=name,
+                                            process_set=process_set)
+            return [_from_row(o, t) for o, t in zip(outs, ts)]
+
+        reduced = tf.py_function(_reduce, tensors,
+                                 [t.dtype for t in tensors])
+        for r, t in zip(reduced, tensors):
+            r.set_shape(t.shape)
+        return reduced
+    outs = _eager.grouped_allreduce([_to_stack(t) for t in tensors], op,
+                                    name=name, process_set=process_set)
+    return [_from_row(o, t) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set=None) -> tf.Tensor:
+    out = _eager.allgather(_to_stack(tensor), name=name,
+                           process_set=process_set)
+    return _from_row(out, tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              process_set=None) -> tf.Tensor:
+    out = _eager.broadcast(_to_stack(tensor), root_rank, name=name,
+                           process_set=process_set)
+    return _from_row(out, tensor)
+
+
+def alltoall(tensor, name: Optional[str] = None, process_set=None):
+    out = _eager.alltoall(_to_stack(tensor), name=name,
+                          process_set=process_set)
+    return _from_row(out, tensor)
+
+
+def reducescatter(tensor, op: ReduceOp = Average, name=None,
+                  process_set=None):
+    out = _eager.reducescatter(_to_stack(tensor), op, name=name,
+                               process_set=process_set)
+    return _from_row(out, tensor)
+
+
+def barrier(process_set=None) -> None:
+    _eager.barrier(process_set=process_set)
+
+
+def join() -> int:
+    return _eager.join()
+
+
+def broadcast_variables(variables, root_rank: int = 0,
+                        process_set=None) -> None:
+    """Assign every variable its root-rank value (``hvd.broadcast_variables``)."""
+    for v in variables:
+        v.assign(broadcast(v, root_rank,
+                           name=f"broadcast.{getattr(v, 'name', 'var')}",
+                           process_set=process_set))
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
+    from ..optim.functions import broadcast_object as _bo
+    return _bo(obj, root_rank, process_set=process_set)
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """``tf.GradientTape`` whose ``gradient()`` allreduces the result.
+
+    Reference: ``horovod/tensorflow/__init__.py::DistributedGradientTape``
+    (the TF2 hot path in SURVEY.md 4.3).  Gradients are fused through
+    ``grouped_allreduce`` -- one collective per dtype bucket rather than
+    one per tensor.
+    """
+
+    def __init__(self, tape: tf.GradientTape,
+                 compression=Compression.none, op: ReduceOp = Average,
+                 process_set=None, sparse_as_dense: bool = True):
+        # Adopt the wrapped tape's recording state.
+        self.__dict__.update(tape.__dict__)
+        self._hvd_compression = compression
+        self._hvd_op = op
+        self._hvd_process_set = process_set
+
+    def gradient(self, target, sources, output_gradients=None,
+                 unconnected_gradients=tf.UnconnectedGradients.NONE):
+        grads = super().gradient(target, sources, output_gradients,
+                                 unconnected_gradients)
+        flat = tf.nest.flatten(grads)
+        idx = [i for i, g in enumerate(flat) if g is not None]
+        if idx:
+            reduced = grouped_allreduce(
+                [tf.convert_to_tensor(flat[i]) for i in idx],
+                op=self._hvd_op, name="gradtape",
+                process_set=self._hvd_process_set)
+            for i, g in zip(idx, reduced):
+                flat[i] = g
+        return tf.nest.pack_sequence_as(grads, flat)
+
+
+def DistributedOptimizer(optimizer, compression=Compression.none,
+                         op: ReduceOp = Average, process_set=None):
+    """Keras-3 optimizer wrapper: allreduce grads in ``apply_gradients``.
+
+    Reference: ``horovod/tensorflow/__init__.py::DistributedOptimizer``
+    (wrap ``compute_gradients``); Keras 3 funnels everything through
+    ``apply_gradients``, so the reduction hooks there.
+    """
+    base = optimizer.__class__
+
+    class _Distributed(base):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            tvars = [v for _, v in grads_and_vars]
+            idx = [i for i, g in enumerate(grads) if g is not None]
+            if idx:
+                reduced = grouped_allreduce(
+                    [tf.convert_to_tensor(grads[i]) for i in idx],
+                    op=op, name="opt", process_set=process_set)
+                for i, g in zip(idx, reduced):
+                    grads[i] = g
+            return super().apply_gradients(zip(grads, tvars), *args,
+                                           **kwargs)
+
+    optimizer.__class__ = _Distributed
+    return optimizer

@@ -1,0 +1,12 @@
+"""Alias module: ``import horovod_tpu.torch as hvd`` (reference-style name).
+
+The implementation lives in ``horovod_tpu.torch_api`` (the package cannot
+contain a subpackage literally named ``torch`` without shadowing the real
+torch inside its own modules).
+"""
+
+import sys
+
+from . import torch_api as _impl
+
+sys.modules[__name__] = _impl

@@ -1,0 +1,245 @@
+"""``horovod_tpu.torch``: drop-in ``horovod.torch`` API over the TPU core.
+
+Parity surface (reference ``horovod/torch/__init__.py`` + ``mpi_ops.py`` +
+``optimizer.py`` + ``functions.py``): ``init/rank/size/...``, tensor
+collectives with async handles (``allreduce[_async][_]``, ``allgather``,
+``broadcast``, ``alltoall``, ``grouped_allreduce``, ``synchronize``,
+``poll``), ``DistributedOptimizer`` with per-gradient hooks and
+``backward_passes_per_step``, ``broadcast_parameters`` /
+``broadcast_optimizer_state``, and ``Compression``.
+
+Execution model: torch stays the user-facing autograd/optimizer engine on
+host CPU; every collective stages the tensor to the XLA mesh through the
+eager path (``torch -> numpy -> jax -> numpy -> torch``, zero-copy on the
+torch side) and is asynchronous exactly like the reference's enqueue --
+JAX's async dispatch replaces the background thread, and the handle table
+replaces ``HandleManager`` (``horovod/torch/handle_manager.cc``).
+
+One controller process == one Horovod rank (launch with
+``python -m horovod_tpu.run -np N``); a single process with multiple local
+devices treats each device as a rank for the collective math, matching the
+core's semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import torch
+
+from ..core.basics import (  # noqa: F401
+    init, shutdown, is_initialized, size, rank, local_size, local_rank,
+    cross_size, cross_rank, is_homogeneous, nccl_built, mpi_built,
+    gloo_built, tpu_built, mpi_threads_supported,
+)
+from ..core.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from ..core.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, remove_process_set, get_process_set,
+)
+from ..collectives.reduce_op import (  # noqa: F401
+    ReduceOp, Average, Sum, Min, Max, Product, Adasum,
+)
+from ..collectives.compression import Compression  # noqa: F401
+from ..collectives import eager as _eager
+
+
+def _to_stack(t: torch.Tensor) -> np.ndarray:
+    return _eager.replicated_stack(t.detach().cpu().numpy())
+
+
+def _from_row(out, like: torch.Tensor) -> torch.Tensor:
+    row = np.asarray(out.addressable_shards[0].data)[0]
+    # Copy: the buffer is jax-owned (and may be non-writable).
+    return torch.from_numpy(np.array(row)).to(like.dtype)
+
+
+# -- tensor collectives ------------------------------------------------------
+
+def allreduce(tensor: torch.Tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, compression=Compression.none,
+              op: Optional[ReduceOp] = None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              process_set=None) -> torch.Tensor:
+    op = _resolve_op(average, op)
+    out = _eager.allreduce(_to_stack(tensor), op, name=name,
+                           process_set=process_set,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           compression=compression)
+    return _from_row(out, tensor)
+
+
+def allreduce_(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
+    result = allreduce(tensor, **kwargs)
+    tensor.copy_(result)
+    return tensor
+
+
+def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[ReduceOp] = None,
+                    compression=Compression.none, process_set=None) -> int:
+    op = _resolve_op(average, op)
+    out = _eager.allreduce(_to_stack(tensor), op, name=name,
+                           process_set=process_set, compression=compression)
+    return _handles.alloc(out, tensor, inplace=False)
+
+
+def allreduce_async_(tensor: torch.Tensor, **kwargs) -> int:
+    h = allreduce_async(tensor, **kwargs)
+    _handles.mark_inplace(h)
+    return h
+
+
+def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
+                      op=None, process_set=None) -> List[torch.Tensor]:
+    op = _resolve_op(average, op)
+    outs = _eager.grouped_allreduce([_to_stack(t) for t in tensors], op,
+                                    name=name, process_set=process_set)
+    return [_from_row(o, t) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None,
+              process_set=None) -> torch.Tensor:
+    out = _eager.allgather(_to_stack(tensor), name=name,
+                           process_set=process_set)
+    return _from_row(out, tensor)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None, process_set=None) -> torch.Tensor:
+    out = _eager.broadcast(_to_stack(tensor), root_rank, name=name,
+                           process_set=process_set)
+    return _from_row(out, tensor)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int, **kwargs):
+    tensor.copy_(broadcast(tensor, root_rank, **kwargs))
+    return tensor
+
+
+def alltoall(tensor: torch.Tensor, name: Optional[str] = None,
+             process_set=None) -> torch.Tensor:
+    out = _eager.alltoall(_to_stack(tensor), name=name,
+                          process_set=process_set)
+    return _from_row(out, tensor)
+
+
+def reducescatter(tensor: torch.Tensor, op: ReduceOp = Average,
+                  name: Optional[str] = None,
+                  process_set=None) -> torch.Tensor:
+    out = _eager.reducescatter(_to_stack(tensor), op, name=name,
+                               process_set=process_set)
+    return _from_row(out, tensor)
+
+
+def barrier(process_set=None) -> None:
+    _eager.barrier(process_set=process_set)
+
+
+def join(device=None) -> int:
+    return _eager.join()
+
+
+def _resolve_op(average: Optional[bool], op: Optional[ReduceOp]) -> ReduceOp:
+    if op is not None and average is not None:
+        raise ValueError("specify either op or average, not both")
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average
+
+
+# -- handle table ------------------------------------------------------------
+
+class _HandleTable:
+    """HandleManager analogue for the torch surface."""
+
+    def __init__(self):
+        self._entries: Dict[int, Tuple[Any, torch.Tensor, bool]] = {}
+
+    def alloc(self, out, like: torch.Tensor, inplace: bool) -> int:
+        h = _eager._alloc_handle(out)
+        self._entries[h] = (out, like, inplace)
+        return h
+
+    def mark_inplace(self, h: int) -> None:
+        out, like, _ = self._entries[h]
+        self._entries[h] = (out, like, True)
+
+    def synchronize(self, h: int) -> torch.Tensor:
+        out, like, inplace = self._entries.pop(h)
+        result = _eager.synchronize(h)
+        value = _from_row(result, like)
+        if inplace:
+            like.copy_(value)
+            return like
+        return value
+
+    def poll(self, h: int) -> bool:
+        return _eager.poll(h)
+
+
+_handles = _HandleTable()
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    return _handles.synchronize(handle)
+
+
+def poll(handle: int) -> bool:
+    return _handles.poll(handle)
+
+
+# -- parameter/optimizer broadcast ------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set=None) -> None:
+    """In-place broadcast of a ``state_dict`` or ``named_parameters``."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = sorted(params)
+    for name, p in items:
+        if isinstance(p, torch.Tensor):
+            broadcast_(p.data if p.requires_grad else p, root_rank,
+                       name=f"broadcast.{name}", process_set=process_set)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0, process_set=None) -> None:
+    """Broadcast optimizer hyperparameters and per-param state tensors."""
+    from ..optim.functions import broadcast_object
+    state = optimizer.state_dict()
+
+    def enc(obj):
+        if isinstance(obj, torch.Tensor):
+            return obj.cpu().numpy()
+        if isinstance(obj, dict):
+            return {k: enc(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [enc(v) for v in obj]
+        return obj
+
+    def dec(obj):
+        if isinstance(obj, np.ndarray):
+            return torch.from_numpy(obj.copy())
+        if isinstance(obj, dict):
+            return {k: dec(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [dec(v) for v in obj]
+        return obj
+
+    synced = broadcast_object(enc(state), root_rank, process_set=process_set)
+    optimizer.load_state_dict(dec(synced))
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
+    from ..optim.functions import broadcast_object as _bo
+    return _bo(obj, root_rank, process_set=process_set)
+
+
+from .optimizer import DistributedOptimizer  # noqa: E402,F401

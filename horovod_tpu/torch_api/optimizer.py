@@ -1,0 +1,130 @@
+"""``horovod_tpu.torch.DistributedOptimizer``: hook-based gradient sync.
+
+Parity with ``horovod/torch/optimizer.py::_DistributedOptimizer``: wraps a
+``torch.optim.Optimizer``; an autograd hook per parameter enqueues an
+asynchronous allreduce the moment its gradient is produced (overlap with
+the rest of backward), ``synchronize()`` drains the handles before
+``step()``, and ``backward_passes_per_step`` accumulates locally between
+syncs.  The enqueue lands on the XLA mesh via the eager collective path
+instead of a background NCCL thread, and the dynamic-subclass technique
+(instance ``__class__`` rebound to ``(_Mixin, OriginalOptimizer)``)
+preserves the wrapped optimizer's ``step``/``state_dict`` behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+import torch
+
+from ..collectives.compression import Compression
+from ..collectives.reduce_op import Average, ReduceOp
+from . import _handles, allreduce_async_
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin providing hooks + synchronize; never instantiated directly."""
+
+    def _init_distributed(self, named_parameters, compression, op,
+                          backward_passes_per_step, process_set) -> None:
+        if named_parameters:
+            self._param_names = {v: k for k, v in named_parameters}
+        else:
+            self._param_names = {
+                v: f"allreduce.noname.{i}.{j}"
+                for i, group in enumerate(self.param_groups)
+                for j, v in enumerate(group["params"])}
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        self.backward_passes_per_step = backward_passes_per_step
+        self._counter: Dict[torch.Tensor, int] = {}
+        self._pending: Dict[torch.Tensor, int] = {}
+        self._grad_accs = []
+        self._should_synchronize = True
+        self._register_hooks()
+
+    # -- hooks ------------------------------------------------------------
+    def _register_hooks(self) -> None:
+        for group in self.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                if p.grad is None:
+                    p.grad = p.data.new_zeros(p.shape)
+                # Hook the grad accumulator so it fires once per backward,
+                # after autograd finished accumulating into p.grad (same
+                # trick as the reference's _make_hook).
+                tmp = p.expand_as(p)
+                acc = tmp.grad_fn.next_functions[0][0]
+                acc.register_hook(self._make_hook(p))
+                self._grad_accs.append(acc)
+
+    def _make_hook(self, p: torch.Tensor):
+        def hook(*ignore):
+            if p in self._pending:
+                raise AssertionError(
+                    "gradient produced twice without synchronize(); call "
+                    "optimizer.synchronize() (or step()) every "
+                    "backward_passes_per_step backwards")
+            self._counter[p] = self._counter.get(p, 0) + 1
+            if self._counter[p] < self.backward_passes_per_step:
+                return  # local accumulation pass: no comm
+            self._counter[p] = 0
+            if self.backward_passes_per_step > 1:
+                p.grad.div_(self.backward_passes_per_step)
+            self._pending[p] = allreduce_async_(
+                p.grad, op=self._op,
+                name=self._param_names.get(p, "allreduce.noname"),
+                compression=self._compression,
+                process_set=self._process_set)
+        return hook
+
+    # -- sync -------------------------------------------------------------
+    def synchronize(self) -> None:
+        """Drain outstanding allreduce handles (grads updated in place)."""
+        for p, h in list(self._pending.items()):
+            _handles.synchronize(h)
+            del self._pending[p]
+
+    class _DisableSync:
+        def __init__(self, opt):
+            self._opt = opt
+
+        def __enter__(self):
+            self._opt._should_synchronize = False
+
+        def __exit__(self, *args):
+            self._opt._should_synchronize = True
+
+    def skip_synchronize(self):
+        """Context manager: tell ``step()`` synchronize() already ran."""
+        return self._DisableSync(self)
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            self.synchronize()
+        return super().step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._pending:
+            raise AssertionError(
+                "zero_grad() called with pending allreduce handles; call "
+                "synchronize() or step() first")
+        return super().zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[Iterable] = None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op: ReduceOp = Average,
+                         process_set=None) -> torch.optim.Optimizer:
+    """Wrap a torch optimizer so ``step()`` sees globally-reduced grads."""
+    named = list(named_parameters) if named_parameters is not None else None
+    optimizer.__class__ = type(
+        "Distributed" + optimizer.__class__.__name__,
+        (_DistributedOptimizer, optimizer.__class__), {})
+    optimizer._init_distributed(named, compression, op,
+                                backward_passes_per_step, process_set)
+    return optimizer

@@ -1,0 +1,76 @@
+"""TF/Keras shim tests (reference ``test_tensorflow.py``/``test_keras.py``
+model, single-process: Average == identity, Sum == value * size)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+import horovod_tpu.tensorflow as tfhvd  # noqa: E402
+import horovod_tpu.keras as khvd  # noqa: E402
+
+
+@pytest.fixture()
+def hvd_tf(hvd):
+    yield tfhvd
+
+
+def test_allreduce_sum(hvd_tf, n_devices):
+    t = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    out = hvd_tf.allreduce(t, op=tfhvd.Sum)
+    np.testing.assert_allclose(out.numpy(), t.numpy() * n_devices)
+
+
+def test_allreduce_average_identity(hvd_tf):
+    t = tf.constant([1.5, -2.5])
+    np.testing.assert_allclose(hvd_tf.allreduce(t).numpy(), t.numpy(),
+                               rtol=1e-6)
+
+
+def test_allgather_broadcast(hvd_tf, n_devices):
+    g = hvd_tf.allgather(tf.ones((2, 3)))
+    assert g.shape == (2 * n_devices, 3)
+    b = hvd_tf.broadcast(tf.constant([7.0]), root_rank=0)
+    np.testing.assert_allclose(b.numpy(), [7.0])
+
+
+def test_broadcast_variables(hvd_tf):
+    v = tf.Variable([1.0, 2.0, 3.0])
+    hvd_tf.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_distributed_gradient_tape(hvd_tf):
+    w = tf.Variable([2.0])
+    with tf.GradientTape() as tape:
+        loss = w * w
+    tape = hvd_tf.DistributedGradientTape(tape)
+    (grad,) = tape.gradient(loss, [w])
+    np.testing.assert_allclose(grad.numpy(), [4.0], rtol=1e-6)
+
+
+def test_distributed_optimizer_trains(hvd_tf):
+    model = keras.Sequential([keras.layers.Dense(1, input_shape=(4,))])
+    opt = hvd_tf.DistributedOptimizer(keras.optimizers.SGD(0.1))
+    model.compile(optimizer=opt, loss="mse")
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = (x @ np.ones((4, 1))).astype(np.float32)
+    h = model.fit(x, y, epochs=3, batch_size=8, verbose=0)
+    assert h.history["loss"][-1] < h.history["loss"][0]
+
+
+def test_keras_callbacks(hvd_tf):
+    model = keras.Sequential([keras.layers.Dense(1, input_shape=(2,))])
+    model.compile(optimizer=keras.optimizers.SGD(0.2), loss="mse")
+    x = np.zeros((8, 2), np.float32)
+    y = np.zeros((8, 1), np.float32)
+    cbs = [khvd.BroadcastGlobalVariablesCallback(0),
+           khvd.MetricAverageCallback(),
+           khvd.LearningRateWarmupCallback(initial_lr=0.2, warmup_epochs=1,
+                                           steps_per_epoch=2),
+           khvd.LearningRateScheduleCallback(initial_lr=0.2,
+                                             multiplier=0.5, start_epoch=1)]
+    model.fit(x, y, epochs=2, batch_size=4, verbose=0, callbacks=cbs)
+    lr = float(model.optimizer.learning_rate.numpy())
+    assert lr == pytest.approx(0.1)

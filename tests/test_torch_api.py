@@ -1,0 +1,145 @@
+"""Torch-shim tests (reference ``test/parallel/test_torch.py`` model).
+
+Single-process mode: every device is a rank and eager inputs are
+replicated, so Average == identity and Sum == value * size; optimizer
+behavior must match plain torch exactly.  Multi-process behavior is
+covered by the launcher integration test running pytorch_mnist.py.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as thvd
+
+
+@pytest.fixture()
+def hvd_t(hvd):
+    # Core initialized by the `hvd` fixture; the torch shim shares it.
+    yield thvd
+
+
+def test_identity_and_size(hvd_t, n_devices):
+    assert hvd_t.is_initialized()
+    assert hvd_t.size() == n_devices
+    assert hvd_t.tpu_built() and not hvd_t.nccl_built()
+
+
+@pytest.mark.parametrize("dtype", [torch.float32, torch.float16,
+                                   torch.int32, torch.int64])
+def test_allreduce_dtypes(hvd_t, n_devices, dtype):
+    t = torch.arange(6).reshape(2, 3).to(dtype)
+    out = hvd_t.allreduce(t, op=thvd.Sum)
+    assert out.dtype == dtype
+    np.testing.assert_allclose(out.to(torch.float32).numpy(),
+                               t.to(torch.float32).numpy() * n_devices)
+
+
+def test_allreduce_average_is_identity_single_proc(hvd_t):
+    t = torch.randn(4, 4)
+    out = hvd_t.allreduce(t)  # Average over identical replicas
+    np.testing.assert_allclose(out.numpy(), t.numpy(), rtol=1e-6)
+
+
+def test_allreduce_inplace(hvd_t, n_devices):
+    t = torch.ones(3)
+    ret = hvd_t.allreduce_(t, op=thvd.Sum)
+    assert ret is t
+    np.testing.assert_allclose(t.numpy(), n_devices)
+
+
+def test_async_handle_roundtrip(hvd_t, n_devices):
+    t = torch.full((5,), 2.0)
+    h = hvd_t.allreduce_async_(t, op=thvd.Sum)
+    out = hvd_t.synchronize(h)
+    assert out is t
+    np.testing.assert_allclose(t.numpy(), 2.0 * n_devices)
+
+
+def test_broadcast_and_allgather(hvd_t, n_devices):
+    t = torch.randn(2, 2)
+    out = hvd_t.broadcast(t, root_rank=0)
+    np.testing.assert_allclose(out.numpy(), t.numpy(), rtol=1e-6)
+    g = hvd_t.allgather(torch.ones(2, 3))
+    assert g.shape == (2 * n_devices, 3)
+
+
+def test_grouped_allreduce(hvd_t, n_devices):
+    ts = [torch.ones(3), torch.full((2, 2), 2.0)]
+    outs = hvd_t.grouped_allreduce(ts, op=thvd.Sum)
+    np.testing.assert_allclose(outs[0].numpy(), n_devices)
+    np.testing.assert_allclose(outs[1].numpy(), 2.0 * n_devices)
+
+
+def test_optimizer_matches_plain_sgd(hvd_t):
+    torch.manual_seed(0)
+    m = torch.nn.Linear(8, 4)
+    ref = torch.nn.Linear(8, 4)
+    ref.load_state_dict(m.state_dict())
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=0.1, momentum=0.9),
+        named_parameters=m.named_parameters())
+    ropt = torch.optim.SGD(ref.parameters(), lr=0.1, momentum=0.9)
+    x, y = torch.randn(16, 8), torch.randint(0, 4, (16,))
+    for _ in range(5):
+        opt.zero_grad()
+        F.cross_entropy(m(x), y).backward()
+        opt.step()
+        ropt.zero_grad()
+        F.cross_entropy(ref(x), y).backward()
+        ropt.step()
+    np.testing.assert_allclose(m.weight.detach().numpy(),
+                               ref.weight.detach().numpy(), atol=1e-6)
+
+
+def test_optimizer_backward_passes_per_step(hvd_t):
+    torch.manual_seed(0)
+    m = torch.nn.Linear(4, 2)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=0.1),
+        named_parameters=m.named_parameters(),
+        backward_passes_per_step=2)
+    x, y = torch.randn(8, 4), torch.randint(0, 2, (8,))
+    opt.zero_grad()
+    F.cross_entropy(m(x), y).backward()   # pass 1: local only
+    assert not opt._pending
+    F.cross_entropy(m(x), y).backward()   # pass 2: triggers allreduce
+    assert opt._pending
+    opt.step()
+    assert not opt._pending
+
+
+def test_zero_grad_with_pending_raises(hvd_t):
+    m = torch.nn.Linear(4, 2)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=0.1),
+        named_parameters=m.named_parameters())
+    F.cross_entropy(m(torch.randn(4, 4)), torch.randint(0, 2, (4,))).backward()
+    with pytest.raises(AssertionError, match="pending"):
+        opt.zero_grad()
+    opt.synchronize()
+    opt.zero_grad()
+
+
+def test_broadcast_parameters_state_dict(hvd_t):
+    m = torch.nn.Linear(3, 3)
+    before = {k: v.clone() for k, v in m.state_dict().items()}
+    hvd_t.broadcast_parameters(m.state_dict(), root_rank=0)
+    for k, v in m.state_dict().items():
+        np.testing.assert_allclose(v.numpy(), before[k].numpy(), rtol=1e-6)
+
+
+def test_broadcast_optimizer_state(hvd_t):
+    m = torch.nn.Linear(3, 3)
+    opt = torch.optim.SGD(m.parameters(), lr=0.5, momentum=0.9)
+    F.mse_loss(m(torch.randn(2, 3)), torch.randn(2, 3)).backward()
+    opt.step()
+    hvd_t.broadcast_optimizer_state(opt, root_rank=0)
+    assert opt.param_groups[0]["lr"] == 0.5
+
+
+def test_compression_namespace(hvd_t):
+    t = torch.randn(16)
+    out = hvd_t.allreduce(t, compression=thvd.Compression.fp16)
+    np.testing.assert_allclose(out.numpy(), t.numpy(), rtol=1e-2, atol=1e-2)
