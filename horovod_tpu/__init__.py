@@ -34,7 +34,8 @@ from .collectives.reduce_op import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
 )
 from .collectives.compression import Compression  # noqa: F401
-from .collectives import ops  # noqa: F401  (in-step collectives)
+from .collectives import ops as collective_ops  # noqa: F401  (in-step)
+from . import ops  # noqa: F401  (pallas kernels: hvd.ops.flash_attention)
 from .collectives.eager import (  # noqa: F401
     allreduce, allreduce_async, grouped_allreduce, allgather, broadcast,
     reducescatter, alltoall, barrier, join, synchronize, poll, local_result,

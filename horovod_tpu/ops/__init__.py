@@ -1,0 +1,14 @@
+"""Pallas TPU kernels and fused ops (attention, etc.).
+
+The reference has no op-kernel library of its own (it delegates matmuls and
+attention to the host framework and ships only CUDA memcpy/scale kernels,
+``horovod/common/ops/cuda/cuda_kernels.cu``); on TPU the hot ops are
+first-class here.
+"""
+
+from .attention import (  # noqa: F401
+    attention_reference,
+    flash_attention,
+)
+
+__all__ = ["attention_reference", "flash_attention"]

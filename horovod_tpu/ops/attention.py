@@ -1,0 +1,411 @@
+"""Fused multi-head attention: Pallas TPU kernels + XLA reference.
+
+The reference framework (Horovod) ships no attention kernels -- its BERT /
+Llama workloads (BASELINE.json configs) lean on the host framework's fused
+attention (torch SDPA / cuDNN flash attention).  The TPU-native equivalent
+of that dependency is a Pallas flash-attention kernel pair (forward +
+backward, FlashAttention-2 schedule) tiled for the MXU, with an XLA
+reference implementation for CPU tests and as numerical ground truth.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+
+* Grid ``(batch, heads, q_blocks, kv_blocks)`` -- the last grid dimension
+  is sequential on TPU, so VMEM scratch (running max ``m``, normaliser
+  ``l``, accumulator ``acc``) carries the online-softmax state across kv
+  blocks; output and logsumexp are written on the final kv step.
+* Softmax statistics cross the kernel boundary as ``(block, 128)``
+  lane-broadcast tiles (the layout jax's own TPU flash attention uses for
+  its l/m residuals); the persistent VJP residual is sliced to ``(b,h,t)``
+  so only transient kernel I/O pays the lane broadcast.
+* Backward is the standard two-kernel FA2 split: ``dq`` accumulates over
+  kv blocks, ``dk/dv`` accumulate over q blocks; ``delta = rowsum(dO*O)``
+  is precomputed by XLA (a trivially fused elementwise reduce).
+* Causal masking is bottom-right aligned (query ``i`` sits at absolute
+  position ``tk - tq + i``, the KV-cache/decode convention, matching
+  ``attention_reference``); whole blocks above the diagonal are predicated
+  off with ``@pl.when``.
+* Grouped-query attention broadcasts kv heads through the BlockSpec
+  ``index_map`` (query head ``h`` reads kv head ``h // rep``) instead of
+  materializing repeated K/V in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128          # TPU lane count: last-dim tile granularity.
+_MIN_BLOCK = 8        # f32 sublane tile; smallest sane seq block.
+_NEG_INF = -1e30      # Softmax mask value (finite: avoids NaN on empty rows).
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_KV = 256
+
+
+def _use_pallas() -> bool:
+    flag = os.environ.get("HVD_TPU_FLASH", "auto")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(seq: int, preferred: int) -> int:
+    """Largest 8-multiple block <= preferred dividing seq, else 0.
+
+    Kernels assume blocks tile the sequence evenly and respect the f32
+    8-sublane tile; sequences with no such divisor fall back to the
+    reference path (dispatcher checks for 0).
+    """
+    b = min(preferred, seq) // _MIN_BLOCK * _MIN_BLOCK
+    while b >= _MIN_BLOCK and seq % b:
+        b -= _MIN_BLOCK
+    return max(b, 0)
+
+
+# ---------------------------------------------------------------------------
+# Reference (XLA) implementation -- ground truth + CPU fallback.
+# ---------------------------------------------------------------------------
+
+def attention_reference(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Plain XLA attention. q,k,v: (batch, heads, seq, head_dim).
+
+    Causal masking is bottom-right aligned: with ``tq < tk`` (decode with a
+    KV cache), query ``i`` attends keys ``0 .. tk - tq + i``.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(v.dtype)
+
+
+def _causal_mask(s, qi, ki, bq, bk, off):
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + off
+    cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel.
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk, off):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: block is live unless it lies entirely above the diagonal.
+    live = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, bq, bk, off)
+
+        m_prev = m_scr[:, :1]                        # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)    # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)              # (bq, 1)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(l_safe)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[-2:])
+
+
+def _flash_fwd(q, k, v, *, scale, causal, bq, bk):
+    batch, heads, tq, d = q.shape
+    tk = k.shape[2]
+    rep = heads // k.shape[1]
+    bq = _block(tq, bq)
+    bk = _block(tk, bk)
+    nq, nk = tq // bq, tk // bk
+    off = tk - tq
+    grid = (batch, heads, nq, nk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk, off=off)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, i, j: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, i, j: (b, h // rep, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, _LANES), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, tq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels.
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, bq, bk, nk, off):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, bq, bk, off)
+        p = jnp.exp(s - lse)                               # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, bq, bk, nq, off):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = True if not causal else (qi * bq + bq - 1 + off >= ki * bk)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, bq, bk, off)
+        p = jnp.exp(s - lse)                               # (bq, bk)
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                      # (bq, bk)
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:]
+        dv_ref[0, 0] = dv_scr[:]
+
+
+def _flash_bwd(res, g, *, scale, causal, bq, bk):
+    q, k, v, o, lse = res
+    batch, heads, tq, d = q.shape
+    h_kv, tk = k.shape[1], k.shape[2]
+    rep = heads // h_kv
+    bq = _block(tq, bq)
+    bk = _block(tk, bk)
+    nq, nk = tq // bq, tk // bk
+    off = tk - tq
+
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse_t = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANES))
+    delta_t = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
+
+    stat_spec_q = pl.BlockSpec((1, 1, bq, _LANES),
+                               lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, off=off),
+        grid=(batch, heads, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, i, j: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, i, j: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+            stat_spec_q,
+            stat_spec_q,
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, g, lse_t, delta_t)
+
+    # dk/dv at *query*-head granularity in f32 (per-group partials), group-
+    # summed outside the kernel; transient only -- forward K/V are never
+    # materialized per query head.
+    stat_spec_kq = pl.BlockSpec((1, 1, bq, _LANES),
+                                lambda b, h, j, i: (b, h, i, 0))
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, off=off),
+        grid=(batch, heads, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, j, i: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b, h, j, i: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),
+            stat_spec_kq,
+            stat_spec_kq,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, heads, tk, d), jnp.float32),
+            jax.ShapeDtypeStruct((batch, heads, tk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, g, lse_t, delta_t)
+    if rep > 1:
+        dk_h = dk_h.reshape(batch, h_kv, rep, tk, d).sum(axis=2)
+        dv_h = dv_h.reshape(batch, h_kv, rep, tk, d).sum(axis=2)
+    return dq, dk_h.astype(k.dtype), dv_h.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper + public API.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, bq, bk):
+    o, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk):
+    o, lse = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, bq, bk, res, g):
+    return _flash_bwd(res, g, scale=scale, causal=causal, bq=bq, bk=bk)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_kv: int = DEFAULT_BLOCK_KV,
+                    force_reference: bool = False):
+    """Fused attention. q: (b, h, t, d); k, v: (b, h_kv, s, d).
+
+    ``h_kv`` may divide ``h`` (grouped-query attention); kv heads are
+    broadcast to query heads via the kernel block index map (no HBM copy).
+    ``causal=True`` requires ``t <= s`` and masks bottom-right aligned.
+
+    Dispatch: Pallas kernels when running on TPU (or ``HVD_TPU_FLASH=1``,
+    which uses the interpreter off-TPU -- slow, for tests), XLA reference
+    otherwise.  Sequence lengths with no block-divisor >= 8 (e.g. primes)
+    fall back to the reference implementation.
+    """
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(f"query heads {q.shape[1]} not a multiple of "
+                         f"kv heads {k.shape[1]}")
+    if causal and q.shape[2] > k.shape[2]:
+        raise ValueError(
+            f"causal attention requires tq <= tk, got {q.shape[2]} > "
+            f"{k.shape[2]}")
+    if block_q < _MIN_BLOCK or block_kv < _MIN_BLOCK:
+        raise ValueError(f"block_q/block_kv must be >= {_MIN_BLOCK}, got "
+                         f"{block_q}/{block_kv}")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    tq, tk = q.shape[2], k.shape[2]
+    usable_blocks = (_block(tq, block_q) >= _MIN_BLOCK
+                     and _block(tk, block_kv) >= _MIN_BLOCK)
+    if force_reference or not usable_blocks or not _use_pallas():
+        if q.shape[1] != k.shape[1]:
+            rep = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+    return _flash(q, k, v, float(scale), bool(causal),
+                  int(block_q), int(block_kv))

@@ -1,0 +1,131 @@
+"""Flash-attention kernels vs XLA reference (Pallas interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops import attention_reference, flash_attention
+from horovod_tpu.ops.attention import _flash
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("tq,tk", [(64, 64), (64, 128)])
+def test_flash_forward_matches_reference(causal, tq, tk):
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = _rand((2, 2, tq, 32), keys[0])
+    k = _rand((2, 2, tk, 32), keys[1])
+    v = _rand((2, 2, tk, 32), keys[2])
+    ref = attention_reference(q, k, v, causal=causal)
+    got = _flash(q, k, v, q.shape[-1] ** -0.5, causal, 32, 32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_reference(causal):
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = _rand((1, 2, 64, 16), keys[0])
+    k = _rand((1, 2, 64, 16), keys[1])
+    v = _rand((1, 2, 64, 16), keys[2])
+
+    def loss_flash(q, k, v):
+        o = _flash(q, k, v, q.shape[-1] ** -0.5, causal, 32, 32)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        o = attention_reference(q, k, v, causal=causal)
+        return jnp.sum(jnp.sin(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_gqa_kernel_broadcasts_kv_heads():
+    """GQA path through the kernels (index-map broadcast, incl. backward)."""
+    keys = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand((1, 4, 32, 16), keys[0])
+    k = _rand((1, 2, 32, 16), keys[1])
+    v = _rand((1, 2, 32, 16), keys[2])
+    kr, vr = jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1)
+
+    out = _flash(q, k, v, q.shape[-1] ** -0.5, True, 32, 32)
+    ref = attention_reference(q, kr, vr, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(_flash(q, k, v, q.shape[-1] ** -0.5,
+                                      True, 32, 32)))
+
+    def loss_ref(q, k, v):
+        o = attention_reference(q, jnp.repeat(k, 2, axis=1),
+                                jnp.repeat(v, 2, axis=1), causal=True)
+        return jnp.sum(jnp.sin(o))
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_gqa_dispatch_path():
+    keys = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = _rand((1, 4, 32, 16), keys[0])
+    k = _rand((1, 2, 32, 16), keys[1])
+    v = _rand((1, 2, 32, 16), keys[2])
+    out = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, jnp.repeat(k, 2, axis=1),
+                              jnp.repeat(v, 2, axis=1), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_causal_decode_alignment():
+    """tq < tk causal = bottom-right aligned (KV-cache decode semantics)."""
+    keys = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = _rand((1, 1, 8, 16), keys[0])
+    k = _rand((1, 1, 64, 16), keys[1])
+    v = _rand((1, 1, 64, 16), keys[2])
+    got = _flash(q, k, v, q.shape[-1] ** -0.5, True, 8, 32)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_causal_tq_gt_tk_rejected():
+    q = jnp.zeros((1, 1, 64, 16))
+    k = jnp.zeros((1, 1, 32, 16))
+    with pytest.raises(ValueError, match="tq <= tk"):
+        flash_attention(q, k, k, causal=True)
+
+
+def test_uneven_block_sizes_fall_back_to_divisors():
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand((1, 1, 96, 16), keys[0])  # 96 not divisible by 64
+    k = _rand((1, 1, 96, 16), keys[1])
+    v = _rand((1, 1, 96, 16), keys[2])
+    got = _flash(q, k, v, q.shape[-1] ** -0.5, True, 64, 64)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_prime_seq_falls_back_to_reference():
+    keys = jax.random.split(jax.random.PRNGKey(6), 3)
+    q = _rand((1, 1, 127, 16), keys[0])  # prime: no divisor >= 8
+    k = _rand((1, 1, 127, 16), keys[1])
+    v = _rand((1, 1, 127, 16), keys[2])
+    out = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
