@@ -5,6 +5,10 @@ Launched by the runner tests and usable by hand::
     python -m horovod_tpu.run -np 2 --cpu python examples/allreduce_check.py
 """
 
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
+
 import sys
 
 import numpy as np

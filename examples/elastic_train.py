@@ -6,6 +6,10 @@ Used by the elastic integration tests with a mutating discovery script,
 mirroring the reference's ``test_elastic_torch.py`` localhost harness.
 """
 
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
+
 import os
 import sys
 import time

@@ -10,6 +10,10 @@ Run on any device set (TPU chips or virtual CPU mesh)::
     python examples/mnist_lenet.py [--steps 100] [--cpu-devices 8]
 """
 
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
+
 import argparse
 import os
 import sys

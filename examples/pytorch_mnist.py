@@ -10,6 +10,10 @@ Run::
     python -m horovod_tpu.run -np 2 --cpu python examples/pytorch_mnist.py
 """
 
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
+
 import argparse
 import sys
 

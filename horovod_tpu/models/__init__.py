@@ -4,3 +4,7 @@ from .lenet import LeNet  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
 )
+from .transformer import (  # noqa: F401
+    BERT_BASE, BERT_LARGE, BERT_TINY, Bert, BertConfig, LLAMA3_8B,
+    LLAMA_TINY, LlamaConfig, LlamaLM, lora_mask, merge_lora,
+)

@@ -1,0 +1,351 @@
+"""Transformer model family: Llama-style decoder LM and BERT encoder.
+
+Workload parity: BASELINE.json names "BERT-Large pretrain (Adasum + fp16
+grad compression)" and "Llama-3 8B LoRA fine-tune (large bf16 allreduce,
+tensor-fusion stress)" as target configs.  The reference framework itself is
+model-agnostic (it ships examples, not model code), so these are built
+TPU-first rather than ported: bfloat16 activations with float32 parameters,
+head/FFN dims that tile the 128-lane MXU, fused attention via the Pallas
+FlashAttention kernels in ``horovod_tpu.ops.attention``, and static shapes
+throughout so XLA can schedule everything onto the MXU.
+
+LoRA (Hu et al., arXiv:2106.09685) is built into the projection layers
+(``DenseGeneral`` here) rather than monkey-patched: pass ``lora_rank > 0``
+and every attention/MLP projection gains a rank-``r`` adapter pair.
+``lora_mask`` produces the optax mask that freezes base weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.ops.attention import flash_attention
+
+Dtype = Any
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+class Dense(nn.Module):
+    """Linear layer with optional fused LoRA adapter.
+
+    Base kernel is float32 (master weights), compute in ``dtype``.  With
+    ``lora_rank > 0`` adds ``x @ A @ B * (alpha/r)``; A is Gaussian, B is
+    zero-init so the adapter starts as identity (standard LoRA init).
+    """
+
+    features: int
+    use_bias: bool = False
+    dtype: Dtype = jnp.bfloat16
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init,
+                            (in_features, self.features), jnp.float32)
+        y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        if self.lora_rank > 0:
+            a = self.param("lora_a",
+                           nn.initializers.normal(stddev=0.02),
+                           (in_features, self.lora_rank), jnp.float32)
+            b = self.param("lora_b", nn.initializers.zeros,
+                           (self.lora_rank, self.features), jnp.float32)
+            scale = jnp.asarray(self.lora_alpha / self.lora_rank, self.dtype)
+            y = y + (x.astype(self.dtype) @ a.astype(self.dtype)
+                     @ b.astype(self.dtype)) * scale
+        return y
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones,
+                           (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.epsilon)
+        return (norm * scale).astype(self.dtype)
+
+
+def rotary_embedding(x, positions, theta: float = 500000.0):
+    """Apply RoPE. x: (b, h, t, d) with even d; positions: (b, t)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None, :, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class CausalSelfAttention(nn.Module):
+    """GQA causal attention with RoPE, fused via Pallas flash attention."""
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: Dtype = jnp.bfloat16
+    rope_theta: float = 500000.0
+    lora_rank: int = 0
+
+    @nn.compact
+    def __call__(self, x, positions):
+        dense = partial(Dense, dtype=self.dtype, lora_rank=self.lora_rank)
+        b, t, _ = x.shape
+        q = dense(self.num_heads * self.head_dim, name="wq")(x)
+        k = dense(self.num_kv_heads * self.head_dim, name="wk")(x)
+        v = dense(self.num_kv_heads * self.head_dim, name="wv")(x)
+        q = q.reshape(b, t, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, self.num_kv_heads,
+                      self.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, self.num_kv_heads,
+                      self.head_dim).transpose(0, 2, 1, 3)
+        q = rotary_embedding(q, positions, self.rope_theta)
+        k = rotary_embedding(k, positions, self.rope_theta)
+        o = flash_attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
+        return dense(x.shape[-1], name="wo")(o)
+
+
+class SwiGLU(nn.Module):
+    hidden: int
+    dtype: Dtype = jnp.bfloat16
+    lora_rank: int = 0
+
+    @nn.compact
+    def __call__(self, x):
+        dense = partial(Dense, dtype=self.dtype, lora_rank=self.lora_rank)
+        gate = dense(self.hidden, name="w_gate")(x)
+        up = dense(self.hidden, name="w_up")(x)
+        return dense(x.shape[-1], name="w_down")(nn.silu(gate) * up)
+
+
+class DecoderBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    ffn_hidden: int
+    dtype: Dtype = jnp.bfloat16
+    rope_theta: float = 500000.0
+    lora_rank: int = 0
+
+    @nn.compact
+    def __call__(self, x, positions):
+        h = RMSNorm(dtype=self.dtype, name="attn_norm")(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.num_kv_heads, self.head_dim,
+            dtype=self.dtype, rope_theta=self.rope_theta,
+            lora_rank=self.lora_rank, name="attn")(h, positions)
+        h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
+        x = x + SwiGLU(self.ffn_hidden, dtype=self.dtype,
+                       lora_rank=self.lora_rank, name="mlp")(h)
+        return x
+
+
+# ---------------------------------------------------------------------------
+# Llama-style decoder LM
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    d_model: int = 4096
+    ffn_hidden: int = 14336
+    rope_theta: float = 500000.0
+    max_seq_len: int = 8192
+
+
+# Llama-3 8B architecture (public config: 32 layers, 32 heads / 8 KV heads,
+# d_model 4096, FFN 14336, vocab 128256, rope theta 5e5).
+LLAMA3_8B = LlamaConfig()
+LLAMA_TINY = LlamaConfig(vocab_size=256, num_layers=2, num_heads=4,
+                         num_kv_heads=2, head_dim=16, d_model=64,
+                         ffn_hidden=128, max_seq_len=128)
+
+
+class LlamaLM(nn.Module):
+    """Decoder-only LM (Llama-3 family architecture)."""
+
+    config: LlamaConfig
+    dtype: Dtype = jnp.bfloat16
+    lora_rank: int = 0
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape)
+        emb = self.param("tok_embed", nn.initializers.normal(stddev=0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        x = emb[tokens].astype(self.dtype)
+        for i in range(cfg.num_layers):
+            x = DecoderBlock(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                             cfg.ffn_hidden, dtype=self.dtype,
+                             rope_theta=cfg.rope_theta,
+                             lora_rank=self.lora_rank,
+                             name=f"layer_{i}")(x, positions)
+        x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
+        # Tied-embedding readout in f32 for stable softmax.
+        return x.astype(jnp.float32) @ emb.T
+
+
+# ---------------------------------------------------------------------------
+# BERT encoder
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    num_layers: int = 24
+    num_heads: int = 16
+    d_model: int = 1024
+    ffn_hidden: int = 4096
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+
+
+BERT_LARGE = BertConfig()
+BERT_BASE = BertConfig(num_layers=12, num_heads=12, d_model=768,
+                       ffn_hidden=3072)
+BERT_TINY = BertConfig(vocab_size=256, num_layers=2, num_heads=4,
+                       d_model=64, ffn_hidden=128, max_seq_len=128)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    ffn_hidden: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        head_dim = d // self.num_heads
+        dense = partial(Dense, dtype=self.dtype, use_bias=True)
+        ln = partial(nn.LayerNorm, dtype=self.dtype, epsilon=1e-12,
+                     param_dtype=jnp.float32)
+        # Pre-LN (stability at scale; BERT's published post-LN converges
+        # identically with warmup but pre-LN is the TPU-era default).
+        h = ln(name="attn_norm")(x)
+        q = dense(d, name="wq")(h).reshape(b, t, self.num_heads, head_dim)
+        k = dense(d, name="wk")(h).reshape(b, t, self.num_heads, head_dim)
+        v = dense(d, name="wv")(h).reshape(b, t, self.num_heads, head_dim)
+        o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                            v.transpose(0, 2, 1, 3), causal=False)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
+        x = x + dense(d, name="wo")(o)
+        h = ln(name="mlp_norm")(x)
+        h = dense(self.ffn_hidden, name="w_in")(h)
+        h = nn.gelu(h, approximate=True)
+        return x + dense(d, name="w_out")(h)
+
+
+class Bert(nn.Module):
+    """BERT encoder with MLM + NSP heads (pretraining objective)."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None):
+        cfg = self.config
+        b, t = tokens.shape
+        if token_types is None:
+            token_types = jnp.zeros_like(tokens)
+        emb = self.param("tok_embed", nn.initializers.normal(stddev=0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
+                         (cfg.max_seq_len, cfg.d_model), jnp.float32)
+        typ = self.param("type_embed", nn.initializers.normal(stddev=0.02),
+                         (cfg.type_vocab_size, cfg.d_model), jnp.float32)
+        x = (emb[tokens] + pos[None, :t] + typ[token_types]).astype(self.dtype)
+        x = nn.LayerNorm(dtype=self.dtype, epsilon=1e-12,
+                         param_dtype=jnp.float32, name="embed_norm")(x)
+        for i in range(cfg.num_layers):
+            x = EncoderBlock(cfg.num_heads, cfg.ffn_hidden,
+                             dtype=self.dtype, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, epsilon=1e-12,
+                         param_dtype=jnp.float32, name="final_norm")(x)
+        # MLM head: transform + tied-embedding readout (f32 softmax input).
+        h = Dense(cfg.d_model, use_bias=True, dtype=self.dtype,
+                  name="mlm_transform")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(dtype=self.dtype, epsilon=1e-12,
+                         param_dtype=jnp.float32, name="mlm_norm")(h)
+        mlm_logits = h.astype(jnp.float32) @ emb.T
+        # NSP head on [CLS] (position 0).
+        cls = jnp.tanh(Dense(cfg.d_model, use_bias=True, dtype=self.dtype,
+                             name="pooler")(x[:, 0]))
+        nsp_logits = Dense(2, use_bias=True, dtype=self.dtype,
+                           name="nsp")(cls).astype(jnp.float32)
+        return mlm_logits, nsp_logits
+
+
+# ---------------------------------------------------------------------------
+# LoRA utilities
+# ---------------------------------------------------------------------------
+
+
+def lora_mask(params) -> Any:
+    """Pytree of bools: True only on ``lora_a``/``lora_b`` leaves.
+
+    Use with ``optax.multi_transform`` (adapters -> real optimizer, base
+    weights -> ``optax.set_to_zero``) to train only the adapters -- the
+    Llama-LoRA workload in BASELINE.json.  Matching by param name mirrors
+    how torch LoRA wrappers select ``lora_`` attributes.
+    """
+    def is_lora(path) -> bool:
+        return any(getattr(k, "key", None) in ("lora_a", "lora_b")
+                   for k in path)
+
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: is_lora(p), params)
+
+
+def merge_lora(params, alpha: float = 16.0):
+    """Fold trained adapters into base kernels (inference export).
+
+    Returns a new params pytree where every Dense holding ``lora_a/b`` has
+    ``kernel += A @ B * alpha/r`` and the adapter leaves removed.  ``alpha``
+    must match the ``lora_alpha`` the model was built with (flax params
+    don't carry module attributes, so it can't be recovered from the tree).
+    """
+
+    def merge(tree):
+        if not isinstance(tree, dict):
+            return tree
+        if "lora_a" in tree and "kernel" in tree:
+            r = tree["lora_a"].shape[1]
+            delta = (tree["lora_a"] @ tree["lora_b"]) * (alpha / r)
+            out = {k: v for k, v in tree.items()
+                   if k not in ("lora_a", "lora_b")}
+            out["kernel"] = tree["kernel"] + delta
+            return out
+        return {k: merge(v) for k, v in tree.items()}
+
+    return merge(params)

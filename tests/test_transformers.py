@@ -1,0 +1,222 @@
+"""Transformer model family: Llama decoder, BERT encoder, LoRA."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.models import (
+    BERT_TINY, Bert, LLAMA_TINY, LlamaConfig, LlamaLM, lora_mask, merge_lora,
+)
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+def test_llama_forward_shapes(rng):
+    cfg = LLAMA_TINY
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    tokens = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    params = model.init(rng, tokens)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_llama_causality(rng):
+    """Changing a future token must not change past logits."""
+    cfg = LLAMA_TINY
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    tokens = jax.random.randint(rng, (1, 16), 0, cfg.vocab_size)
+    params = model.init(rng, tokens)
+    base = model.apply(params, tokens)
+    perturbed = tokens.at[0, 10].set((tokens[0, 10] + 1) % cfg.vocab_size)
+    out = model.apply(params, perturbed)
+    np.testing.assert_allclose(np.asarray(base[0, :10]),
+                               np.asarray(out[0, :10]), atol=1e-5)
+    assert not np.allclose(np.asarray(base[0, 10:]), np.asarray(out[0, 10:]))
+
+
+def test_llama_trains(rng):
+    cfg = LLAMA_TINY
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    tokens = jax.random.randint(rng, (4, 32), 0, cfg.vocab_size)
+    params = model.init(rng, tokens)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_bert_forward_and_train(rng):
+    cfg = BERT_TINY
+    model = Bert(cfg, dtype=jnp.float32)
+    tokens = jax.random.randint(rng, (2, 24), 0, cfg.vocab_size)
+    params = model.init(rng, tokens)
+    mlm, nsp = model.apply(params, tokens)
+    assert mlm.shape == (2, 24, cfg.vocab_size)
+    assert nsp.shape == (2, 2)
+
+    labels = tokens
+    nsp_labels = jnp.array([0, 1])
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            mlm, nsp = model.apply(p, tokens)
+            l1 = optax.softmax_cross_entropy_with_integer_labels(
+                mlm, labels).mean()
+            l2 = optax.softmax_cross_entropy_with_integer_labels(
+                nsp, nsp_labels).mean()
+            return l1 + l2
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(15):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_lora_init_is_identity(rng):
+    """lora_b zero-init: adapter output starts exactly at base output."""
+    cfg = LLAMA_TINY
+    tokens = jax.random.randint(rng, (1, 8), 0, cfg.vocab_size)
+    base = LlamaLM(cfg, dtype=jnp.float32)
+    lora = LlamaLM(cfg, dtype=jnp.float32, lora_rank=4)
+    base_params = base.init(rng, tokens)
+    lora_params = lora.init(rng, tokens)
+
+    # Graft base weights into the lora tree so non-adapter params agree.
+    def graft(lp, bp):
+        if isinstance(lp, dict):
+            return {k: (graft(lp[k], bp[k]) if k in bp else lp[k])
+                    for k in lp}
+        return bp
+    grafted = graft(jax.device_get(lora_params), jax.device_get(base_params))
+    out_base = base.apply(base_params, tokens)
+    out_lora = lora.apply(grafted, tokens)
+    np.testing.assert_allclose(np.asarray(out_base), np.asarray(out_lora),
+                               atol=1e-6)
+
+
+def test_lora_mask_and_training(rng):
+    cfg = LLAMA_TINY
+    model = LlamaLM(cfg, dtype=jnp.float32, lora_rank=4)
+    tokens = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    params = model.init(rng, tokens)
+    mask = lora_mask(params)
+    leaves_mask, _ = jax.tree_util.tree_flatten(mask)
+    assert any(leaves_mask) and not all(leaves_mask)
+
+    opt = optax.multi_transform(
+        {"lora": optax.adam(1e-2), "frozen": optax.set_to_zero()},
+        jax.tree.map(lambda m: "lora" if m else "frozen", mask))
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    before = jax.device_get(params)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state)
+    after = jax.device_get(params)
+
+    flat_b = jax.tree_util.tree_flatten_with_path(before)[0]
+    flat_a = {jax.tree_util.keystr(p): v
+              for p, v in jax.tree_util.tree_flatten_with_path(after)[0]}
+    flat_m = {jax.tree_util.keystr(p): v
+              for p, v in jax.tree_util.tree_flatten_with_path(mask)[0]}
+    changed_lora = changed_base = 0
+    for path, v in flat_b:
+        key = jax.tree_util.keystr(path)
+        same = np.allclose(np.asarray(v), np.asarray(flat_a[key]))
+        if flat_m[key]:
+            # lora_b starts at zero and only moves if its grad is nonzero;
+            # lora_a must move once lora_b has.
+            changed_lora += 0 if same else 1
+        else:
+            changed_base += 0 if same else 1
+    assert changed_base == 0
+    assert changed_lora > 0
+
+
+def test_merge_lora_matches_adapter_output(rng):
+    cfg = LlamaConfig(vocab_size=64, num_layers=1, num_heads=2,
+                      num_kv_heads=1, head_dim=8, d_model=16, ffn_hidden=32,
+                      max_seq_len=32)
+    model = LlamaLM(cfg, dtype=jnp.float32, lora_rank=2)
+    tokens = jax.random.randint(rng, (1, 8), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(7), tokens)
+    # Give the adapters nonzero weights so the merge is meaningful.
+    params = jax.tree_util.tree_map_with_path(
+        lambda p, x: (x + 0.01 if any(getattr(k, "key", None) == "lora_b"
+                                      for k in p) else x), params)
+    out_adapter = model.apply(params, tokens)
+    merged = merge_lora(jax.device_get(params))
+    base_model = LlamaLM(cfg, dtype=jnp.float32, lora_rank=0)
+    out_merged = base_model.apply(merged, tokens)
+    np.testing.assert_allclose(np.asarray(out_adapter),
+                               np.asarray(out_merged), atol=1e-4)
+
+
+def test_llama_distributed_train_step(rng):
+    """Full framework path: grads allreduced over the mesh via hvd."""
+    cfg = LLAMA_TINY
+    devs = jax.devices()
+    hvd.shutdown()
+    hvd.init()
+    try:
+        model = LlamaLM(cfg, dtype=jnp.float32)
+        tokens = jax.random.randint(rng, (2 * len(devs), 16), 0,
+                                    cfg.vocab_size)
+        params = model.init(rng, tokens[:1])
+        opt = hvd.DistributedOptimizer(optax.sgd(0.05))
+        params = hvd.replicate(params, hvd.mesh())
+        opt_state = opt.init(params)
+
+        from horovod_tpu.training import make_train_step
+
+        def loss_fn(p, batch):
+            logits = model.apply(p, batch)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], batch[:, 1:]).mean()
+
+        step = make_train_step(loss_fn, opt)
+        l0 = None
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            l0 = float(loss) if l0 is None else l0
+        assert float(loss) < l0
+    finally:
+        hvd.shutdown()
