@@ -1,0 +1,48 @@
+"""Shared scaffolding for the synthetic-benchmark examples.
+
+Holds the pieces every example duplicates: virtual-CPU-mesh setup (the
+``--cpu-devices N`` dance that must happen before jax initialises), the
+compile-then-timed-loop, and throughput reporting.  Importable as a sibling
+module because each example puts its own directory on ``sys.path``.
+"""
+
+import os
+import time
+
+
+def setup_devices(cpu_devices: int) -> None:
+    """Force N virtual CPU devices.  Must run before first jax device use."""
+    if cpu_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={cpu_devices}")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def timed_training(step, params, opt_state, data, steps: int,
+                   rank: int, items_per_step: int, unit: str = "sequences"):
+    """Compile once, run a timed loop with no host syncs, report throughput.
+
+    ``step(params, opt_state, data) -> (params, opt_state, loss)``.
+    Returns the final (params, opt_state).
+    """
+    import jax
+
+    params, opt_state, loss = step(params, opt_state, data)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, data)
+        losses.append(loss)  # device array; no host sync in the timed loop
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    if rank == 0:
+        import horovod_tpu as hvd
+        for i in range(0, steps, 10):
+            print(f"step {i:4d} loss {float(losses[i]):.4f}")
+        rate = steps * items_per_step / dt
+        print(f"{rate:.1f} {unit}/s ({rate / hvd.size():.1f}/chip), "
+              f"final loss {float(losses[-1]):.4f}")
+    return params, opt_state

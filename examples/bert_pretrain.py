@@ -15,8 +15,8 @@ from os.path import abspath as _abs, dirname as _dir
 _sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
 
 import argparse
-import os
-import time
+
+from _harness import setup_devices, timed_training
 
 
 def main():
@@ -31,12 +31,7 @@ def main():
     p.add_argument("--cpu-devices", type=int, default=0)
     args = p.parse_args()
 
-    if args.cpu_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") +
-            f" --xla_force_host_platform_device_count={args.cpu_devices}")
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    setup_devices(args.cpu_devices)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -83,21 +78,8 @@ def main():
     step = hvd.make_train_step(loss_fn, opt)
     data = hvd.shard_batch((tokens, nsp_labels))
 
-    params, opt_state, loss = step(params, opt_state, data)  # compile
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    losses = []
-    for i in range(args.steps):
-        params, opt_state, loss = step(params, opt_state, data)
-        losses.append(loss)  # device array; no host sync in the timed loop
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    if hvd.rank() == 0:
-        for i in range(0, args.steps, 10):
-            print(f"step {i:4d} loss {float(losses[i]):.4f}")
-        seqs = args.steps * batch / dt
-        print(f"{seqs:.1f} sequences/s ({seqs / hvd.size():.1f}/chip), "
-              f"final loss {float(loss):.4f}")
+    timed_training(step, params, opt_state, data, args.steps, hvd.rank(),
+                   items_per_step=batch)
     hvd.shutdown()
 
 

@@ -1,0 +1,23 @@
+"""Parallelism strategies over the device mesh.
+
+DP (the reference's whole scope) lives in ``collectives``/``optim``; this
+package adds the model-parallel axes the TPU fabric makes first-class:
+tensor (``tp``), sequence/context (``sequence``: ring attention, Ulysses),
+pipeline (``pipeline``) and expert (``moe``) parallelism, all as SPMD
+functions composed inside ``jax.shard_map`` over a
+:func:`build_parallel_mesh` ``(dp, pp, ep, sp, tp)`` mesh.
+"""
+
+from .mesh import (  # noqa: F401
+    DCN_AXIS, DP_AXIS, EP_AXIS, FLAT_AXES, HIER_AXES, HVD_AXIS, ICI_AXIS,
+    PARALLEL_AXES, PP_AXIS, SP_AXIS, TP_AXIS, build_mesh,
+    build_parallel_mesh, mesh_axes, mesh_size,
+)
+from .tp import (  # noqa: F401
+    column_parallel, row_parallel, shard_tp_params, tp_mlp,
+)
+from .sequence import ring_attention, ulysses_attention  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_apply, split_microbatches, stack_stage_params,
+)
+from .moe import init_moe_params, moe_ffn  # noqa: F401

@@ -76,6 +76,52 @@ def build_mesh(
     return Mesh(grid, (DCN_AXIS, ICI_AXIS))
 
 
+# Canonical axis names for the full parallelism mesh (outermost first).
+# dp rides DCN (gradient allreduce tolerates its latency), pp crosses
+# slice/neighbor links once per microbatch, ep/sp ride ICI, and tp sits
+# innermost on the fastest ICI loops (it's latency-critical: two
+# collectives per matmul pair).
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+EP_AXIS = "ep"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+PARALLEL_AXES: Tuple[str, ...] = (DP_AXIS, PP_AXIS, EP_AXIS, SP_AXIS,
+                                  TP_AXIS)
+
+
+def build_parallel_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    dp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+) -> Mesh:
+    """Build the 5-axis ``(dp, pp, ep, sp, tp)`` parallelism mesh.
+
+    Any axis may be 1 (degenerate); the product must equal the device
+    count.  This generalises :func:`build_mesh` beyond pure data
+    parallelism: the reference framework only ever builds the DP
+    communicator (SURVEY.md section 3.8), while this mesh carries tensor,
+    pipeline, sequence (context) and expert parallelism as first-class
+    axes for the model-parallel layers in ``horovod_tpu.parallel``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    extents = {DP_AXIS: dp, PP_AXIS: pp, EP_AXIS: ep, SP_AXIS: sp,
+               TP_AXIS: tp}
+    prod = int(np.prod(list(extents.values())))
+    if prod != n:
+        raise ValueError(
+            f"dp*pp*ep*sp*tp = {prod} != {n} devices ({extents})")
+    grid = np.asarray(devices, dtype=object).reshape(
+        *[extents[a] for a in PARALLEL_AXES])
+    return Mesh(grid, PARALLEL_AXES)
+
+
 def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     """The reduction axes for a mesh produced by :func:`build_mesh`."""
     return tuple(mesh.axis_names)

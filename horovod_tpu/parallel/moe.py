@@ -1,0 +1,115 @@
+"""Expert parallelism: Switch-style MoE layer with all_to_all dispatch.
+
+The reference's only MoE-relevant primitive is ``hvd.alltoall`` (SURVEY.md
+sections 3.8/5.7 -- "the primitive MoE users call manually").  Here the
+whole layer is first-class: a top-k router with capacity, an ``all_to_all``
+that moves token slots to the ranks owning their experts over the ``ep``
+mesh axis, dense expert FFNs batched on the MXU, and the return
+``all_to_all`` + weighted combine.  The dispatch/combine use the standard
+one-hot einsum formulation (Switch Transformer, arXiv:2101.03961), which
+XLA fuses into the surrounding matmuls; dropped tokens (over capacity)
+pass through with zero expert contribution, as in the original.
+
+SPMD layout inside ``shard_map``: tokens sharded over ``ep`` (each rank
+holds t_l tokens), experts sharded over ``ep`` (each rank holds
+E / ep_size experts, so E % ep_size == 0).  Router params are replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import EP_AXIS
+
+
+def moe_ffn(x, router_kernel, w_up, w_down, *, capacity_factor: float = 1.25,
+            top_k: int = 1, axis: str = EP_AXIS,
+            activation: Callable = jax.nn.gelu,
+            router_noise_rng: Optional[jax.Array] = None):
+    """Mixture-of-experts FFN over the ``ep`` axis.
+
+    Local shapes: x (t_l, d); router_kernel (d, E) replicated;
+    w_up (E_l, d, f) and w_down (E_l, f, d) sharded on the expert dim
+    (E_l = E / ep).  Returns ``(y, aux_loss)``: the (t_l, d) output and
+    the scalar Switch load-balance loss (add ``~1e-2 * aux`` to the
+    training loss).
+
+    Capacity is per source rank: ``C = ceil(top_k * t_l / E *
+    capacity_factor)`` slots per (rank, expert), so each expert receives
+    up to ``ep * C`` tokens globally -- the Switch per-device capacity
+    rule, and every rank derives the same static C so shapes stay static
+    for XLA.
+    """
+    ep = jax.lax.axis_size(axis)
+    t_l, d = x.shape
+    e_local = w_up.shape[0]
+    n_experts = e_local * ep
+    capacity = int(max(4, -(-top_k * t_l * capacity_factor // n_experts)))
+
+    logits = x.astype(jnp.float32) @ router_kernel.astype(jnp.float32)
+    if router_noise_rng is not None:
+        logits = logits + jax.random.gumbel(router_noise_rng, logits.shape)
+    probs = jax.nn.softmax(logits, axis=-1)  # (t_l, E)
+
+    # Top-k dispatch masks with per-expert position (capacity) accounting.
+    dispatch = jnp.zeros((t_l, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((t_l, n_experts, capacity), jnp.float32)
+    position_base = jnp.zeros((n_experts,), jnp.int32)
+    remaining = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                    # (t_l,)
+        onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot       # (t_l, E)
+        pos = pos + position_base[None, :] * onehot
+        keep = (pos < capacity) * onehot                        # (t_l, E)
+        slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity,
+                              dtype=jnp.float32)                # (t_l, C)
+        gate = (probs * onehot).sum(-1, keepdims=True)          # (t_l, 1)
+        dispatch = dispatch + keep[:, :, None] * slot[:, None, :]
+        combine = combine + gate[..., None] * keep[:, :, None] \
+            * slot[:, None, :]
+        position_base = position_base + onehot.sum(0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+
+    # (t_l, E, C) x (t_l, d) -> (E, C, d): slots for every global expert.
+    slots = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # all_to_all: split the expert dim across ranks, concat token slots ->
+    # (E_l, ep * C, d): every slot destined for my local experts.
+    slots = jax.lax.all_to_all(slots, axis, split_axis=0, concat_axis=1,
+                               tiled=True)
+    h = jnp.einsum("ecd,edf->ecf", slots.astype(x.dtype), w_up)
+    h = activation(h)
+    out = jnp.einsum("ecf,efd->ecd", h, w_down)
+    # Route results back: split slots, concat experts -> (E, C, d).
+    out = jax.lax.all_to_all(out.astype(jnp.float32), axis, split_axis=1,
+                             concat_axis=0, tiled=True)
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y.astype(x.dtype), _load_balance_loss(probs, dispatch)
+
+
+def _load_balance_loss(probs, dispatch):
+    """Switch aux loss: E * dot(mean router prob, mean tokens-per-expert)."""
+    n_experts = probs.shape[-1]
+    density = dispatch.sum(-1).mean(0)        # fraction routed per expert
+    density_proxy = probs.mean(0)             # mean router prob per expert
+    return n_experts * jnp.sum(density * density_proxy)
+
+
+def init_moe_params(rng, d_model: int, d_ff: int, n_experts: int,
+                    dtype=jnp.float32):
+    """Replicated-layout MoE params: router (d, E), w_up (E, d, f),
+    w_down (E, f, d).  Shard the expert dim over ``ep`` before shard_map."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = d_model ** -0.5
+    return {
+        "router": jax.random.normal(k1, (d_model, n_experts),
+                                    jnp.float32) * scale_in,
+        "w_up": (jax.random.normal(k2, (n_experts, d_model, d_ff),
+                                   jnp.float32) * scale_in).astype(dtype),
+        "w_down": (jax.random.normal(k3, (n_experts, d_ff, d_model),
+                                     jnp.float32)
+                   * d_ff ** -0.5).astype(dtype),
+    }

@@ -1,0 +1,95 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+Not in the reference (SURVEY.md section 3.8: DP only).  TPU-first design:
+the pipeline is a *collective* program -- every rank runs the same scan;
+stage-to-stage transfer is a ``ppermute`` shift over the ``pp`` axis, which
+on TPU compiles to a neighbour DMA over ICI.  The schedule is GPipe
+(fill, steady state, drain): with S stages and M microbatches the loop runs
+``M + S - 1`` ticks and bubble fraction (S-1)/(M+S-1).
+
+The stage function is applied to *this rank's* stage params, so the params
+pytree fed to :func:`pipeline_apply` must carry a leading stage dim sharded
+over ``pp`` (use :func:`stack_stage_params` + shard_map in_specs).
+Backward is pure autodiff: reverse-mode turns the forward ppermute shift
+into the reverse shift, giving the standard 1F-then-1B schedule without any
+hand-written backward plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import PP_AXIS
+
+
+def stack_stage_params(per_stage_params) -> Any:
+    """Stack a list of per-stage param pytrees along a new leading dim.
+
+    The result is what you shard over ``pp`` (spec ``P('pp', ...)`` on
+    every leaf) before calling :func:`pipeline_apply` inside shard_map.
+    """
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stage_params: Any, microbatches: jnp.ndarray,
+                   *, axis: str = PP_AXIS) -> jnp.ndarray:
+    """Run microbatches through the stage pipeline; SPMD over ``axis``.
+
+    Args:
+      stage_fn: ``(params_for_one_stage, x) -> y`` with ``y.shape ==
+        x.shape`` (inter-stage activations must be shape-invariant, as in
+        any homogeneous-stage pipeline).
+      stage_params: *local* param shard inside shard_map -- leading dim 1
+        (this rank's stage); squeezed internally.
+      microbatches: (M, mb, ...) -- the same array on every pp rank
+        (replicated over ``axis``; other mesh axes may shard the mb dim).
+
+    Returns:
+      (M, mb, ...) final-stage outputs, identical on every pp rank
+      (the last stage's results are broadcast with a psum-mask, so the
+      loss can be computed uniformly).
+    """
+    size = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    m = microbatches.shape[0]
+    ticks = m + size - 1
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    zero_mb = jnp.zeros_like(microbatches[0])
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # Stage 0 injects microbatch t (while t < m); later stages consume
+        # what arrived from the left neighbour.
+        mb_in = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, m - 1), keepdims=False)
+        mb_in = jnp.where(t < m, mb_in, zero_mb)
+        x = jnp.where(my == 0, mb_in, incoming)
+        y = stage_fn(params, x)
+        # Last stage banks microbatch (t - size + 1) once it's real.
+        out_idx = t - (size - 1)
+        banked = jax.lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.maximum(out_idx, 0), axis=0)
+        outputs = jnp.where(out_idx >= 0, banked, outputs)
+        incoming = jax.lax.ppermute(y, axis, perm)
+        return (incoming, outputs), ()
+
+    outputs0 = jnp.zeros((m,) + microbatches.shape[1:],
+                         microbatches.dtype)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (zero_mb, outputs0), jnp.arange(ticks))
+    # Only the last rank's bank is real; broadcast it over the pp axis.
+    outputs = jnp.where(my == size - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis)
+
+
+def split_microbatches(batch: jnp.ndarray, n: int) -> jnp.ndarray:
+    """(B, ...) -> (n, B/n, ...) microbatch view for the pipeline."""
+    if batch.shape[0] % n:
+        raise ValueError(f"batch {batch.shape[0]} not divisible by {n}")
+    return batch.reshape(n, batch.shape[0] // n, *batch.shape[1:])

@@ -1,0 +1,127 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has *no* sequence-dim sharding anywhere (SURVEY.md section
+5.7); its ``alltoall`` op is the only primitive a user could build Ulysses
+from.  On TPU, long-context is first-class, so both schemes ship here as
+SPMD functions for use inside ``jax.shard_map`` with the sequence dim
+sharded over the ``sp`` mesh axis:
+
+* **Ring attention** (Liu et al., arXiv:2310.01889): K/V blocks circulate
+  around the sp ring via ``ppermute`` while each rank's queries stay put;
+  partial attention outputs merge with the online-softmax rule (running
+  max / sum-of-exp), so the full (t x t) score matrix never materialises
+  and per-chip memory stays O(t/sp).  Compute-comm overlap comes from XLA
+  pipelining the ppermute against the block matmuls; causal masking uses
+  global positions so blocks strictly in the future are skipped
+  numerically (their contribution multiplies to zero weight).
+
+* **Ulysses** (Jacobs et al., arXiv:2309.14509): two ``all_to_all``s swap
+  the sharding between the sequence dim and the heads dim, so the full
+  sequence is local during attention (enabling the Pallas flash kernel)
+  with heads/sp sharded instead.  Requires ``num_heads % sp == 0``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import SP_AXIS
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None, axis: str = SP_AXIS):
+    """Attention over a sequence sharded on the ``axis`` ring.
+
+    Shapes (local shards): q (b, h, t_l, d), k/v (b, h, t_l, d), where the
+    global sequence length is ``t_l * sp`` and rank r holds positions
+    ``[r*t_l, (r+1)*t_l)``.  Returns the local output shard (b, h, t_l, d).
+
+    Numerics are f32 online-softmax regardless of input dtype (matching
+    the Pallas flash kernel's accumulator discipline); output is cast back
+    to the input dtype.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    sp = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    b, h, t_l, d = q.shape
+    out_dtype = q.dtype
+
+    qf = q.astype(jnp.float32) * scale
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    q_pos = my * t_l + jnp.arange(t_l)  # global positions of local queries
+
+    def merge_block(state, kb, vb, src):
+        """Online-softmax merge of the block that originated at rank src."""
+        m, l, acc = state
+        scores = jnp.einsum("bhtd,bhsd->bhts", qf, kb.astype(jnp.float32))
+        if causal:
+            k_pos = src * t_l + jnp.arange(t_l)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        block_m = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, block_m)
+        # Renormalise the running accumulator to the new max.
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(scores - new_m[..., None])
+        l = l * correction + jnp.sum(p, axis=-1)
+        acc = (acc * correction[..., None]
+               + jnp.einsum("bhts,bhsd->bhtd", p, vb.astype(jnp.float32)))
+        return new_m, l, acc
+
+    m0 = jnp.full((b, h, t_l), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_l), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_l, d), jnp.float32)
+    # Local block first (no comm), then sp-1 ring rotations: permute at the
+    # top of each step so no dead final transfer is issued.
+    state = merge_block((m0, l0, acc0), k, v, my)
+
+    def step(carry, s):
+        kb, vb, state = carry
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        state = merge_block(state, kb, vb, (my - s) % sp)
+        return (kb, vb, state), ()
+
+    if sp > 1:
+        (kb, vb, state), _ = jax.lax.scan(
+            step, (k, v, state), jnp.arange(1, sp))
+    m, l, acc = state
+    # Fully-masked rows (can't happen for causal self-attention since a
+    # token always sees itself, but guard the division anyway).
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l[..., None]).astype(out_dtype)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = False,
+                      scale: Optional[float] = None, axis: str = SP_AXIS,
+                      attn_fn=None):
+    """Ulysses attention: all_to_all seq<->heads, local attention between.
+
+    Local input shards: (b, h, t_l, d) with the *sequence* sharded.  After
+    the first all_to_all each rank holds (b, h/sp, t, d) -- full sequence,
+    a slice of heads -- so any single-device attention kernel applies;
+    ``attn_fn(q, k, v, causal=..., scale=...)`` defaults to the fused
+    Pallas flash attention.  A second all_to_all restores seq sharding.
+    """
+    if attn_fn is None:
+        from horovod_tpu.ops.attention import flash_attention
+        attn_fn = flash_attention
+    sp = jax.lax.axis_size(axis)
+    if q.shape[1] % sp:
+        raise ValueError(f"heads {q.shape[1]} not divisible by sp={sp}")
+
+    # (b, h, t_l, d): split heads (axis 1) across ranks, gather seq (2).
+    to_seq = partial(jax.lax.all_to_all, axis_name=axis, split_axis=1,
+                     concat_axis=2, tiled=True)
+    to_heads = partial(jax.lax.all_to_all, axis_name=axis, split_axis=2,
+                       concat_axis=1, tiled=True)
+    o = attn_fn(to_seq(q), to_seq(k), to_seq(v), causal=causal, scale=scale)
+    return to_heads(o)

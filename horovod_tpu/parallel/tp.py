@@ -1,0 +1,106 @@
+"""Tensor parallelism: Megatron-style column/row-parallel projections.
+
+Not in the reference (SURVEY.md section 3.8 -- it ships ``alltoall`` as the
+only building block and no TP anywhere); built here TPU-first because the
+ICI mesh makes TP a first-class strategy.  The design is the standard
+pairing (Shoeybi et al., arXiv:1909.08053) expressed as SPMD functions for
+use inside ``jax.shard_map`` over the ``tp`` mesh axis:
+
+* ``column_parallel``: kernel split on the *output* dim; no communication
+  in forward (the input is replicated over tp), each rank holds an output
+  shard.  The backward psum over input grads is inserted by autodiff.
+* ``row_parallel``: kernel split on the *input* dim; forward ends in one
+  ``psum`` over tp.  Backward needs no collective.
+
+A column->row pair (e.g. FFN up/down, or QKV->output projection) therefore
+costs exactly one allreduce forward and one backward -- both of which XLA
+overlaps with the surrounding matmuls on the MXU.
+
+These are *functions over local shards*, not flax modules: inside
+``shard_map`` the params pytree is already sharded (kernel leading/trailing
+dims carry the tp extent), so modules would just obscure which collectives
+run.  ``shard_tp_params`` produces the sharded kernels from a replicated
+init.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import TP_AXIS
+
+
+def column_parallel(x, kernel, bias=None, *, axis: str = TP_AXIS):
+    """y_local = x @ kernel_local (+ bias_local).
+
+    ``kernel``: local shard (d_in, d_out / tp).  Output is sharded on the
+    feature dim; follow with :func:`row_parallel` (or an all_gather if the
+    sharded activation is needed whole).  ``axis`` is unused in forward
+    math but documents the pairing; keep it for symmetry.
+    """
+    del axis
+    y = x @ kernel
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def row_parallel(x, kernel, bias=None, *, axis: str = TP_AXIS):
+    """y = psum_tp(x_local @ kernel_local) (+ bias).
+
+    ``x``: activation sharded on the feature dim (d_model / tp), as
+    produced by :func:`column_parallel`.  ``kernel``: local shard
+    (d_in / tp, d_out).  Bias is added *after* the psum (it is replicated;
+    adding per-rank would multiply it by tp).
+    """
+    y = jax.lax.psum(x @ kernel, axis)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def shard_tp_params(params, tp_rank, tp_size, *, column_keys=("wq", "wk",
+                    "wv", "w_gate", "w_up", "w_in"),
+                    row_keys=("wo", "w_down", "w_out")):
+    """Slice a replicated transformer param tree into this rank's TP shard.
+
+    Column-parallel kernels are split on the output (last) dim, row-parallel
+    on the input (first of the 2D kernel) dim.  Key sets default to the
+    ``horovod_tpu.models.transformer`` naming; anything else is left
+    replicated.  Works on host or device trees; intended for tests and for
+    preparing per-rank shards fed to ``shard_map``.
+    """
+
+    def shard(path, leaf):
+        names = [getattr(k, "key", "") for k in path]
+        if "kernel" not in names or leaf.ndim < 2:
+            return leaf
+        owner = names[-2] if names[-1] == "kernel" else ""
+        if owner in column_keys:
+            width = leaf.shape[-1] // tp_size
+            return leaf[..., tp_rank * width:(tp_rank + 1) * width]
+        if owner in row_keys:
+            width = leaf.shape[0] // tp_size
+            return leaf[tp_rank * width:(tp_rank + 1) * width]
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(shard, params)
+
+
+def tp_mlp(x, w_up, w_down, *, axis: str = TP_AXIS,
+           activation=jax.nn.silu, w_gate: Optional[jnp.ndarray] = None):
+    """Column->row parallel MLP: one fused psum for the whole block.
+
+    With ``w_gate`` supplied this is the SwiGLU used by the Llama family;
+    without, a plain 2-layer MLP.  ``w_up``/``w_gate`` are column shards,
+    ``w_down`` a row shard.
+    """
+    up = column_parallel(x, w_up)
+    if w_gate is not None:
+        up = activation(column_parallel(x, w_gate)) * up
+    else:
+        up = activation(up)
+    return row_parallel(up, w_down, axis=axis)

@@ -1,0 +1,33 @@
+"""Driver-contract checks: entry() jits; dryrun_multichip exercises the
+full dp/pp/ep/sp/tp model-parallel train step on the virtual CPU mesh."""
+
+import sys
+from os.path import abspath, dirname
+
+import jax
+import pytest
+
+sys.path.insert(0, dirname(dirname(abspath(__file__))))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_factor_axes_covers_device_count():
+    for n in (1, 2, 4, 8, 16, 32, 64, 6, 12):
+        ext = graft._factor_axes(n)
+        prod = 1
+        for v in ext.values():
+            prod *= v
+        assert prod == n, (n, ext)
+    # 8 devices: tp/sp/pp each get 2 (the latency-critical axes first).
+    ext = graft._factor_axes(8)
+    assert ext["tp"] == 2 and ext["sp"] == 2 and ext["pp"] == 2
+
+
+def test_model_parallel_dryrun_runs():
+    graft._dryrun_model_parallel(jax.devices()[:8])
+
+
+@pytest.mark.slow
+def test_full_dryrun_multichip():
+    graft.dryrun_multichip(8)
