@@ -1,0 +1,246 @@
+"""ctypes bindings for the native coordination core (``src/core.cc``).
+
+The shared library is built on demand with the system ``g++`` (the image
+ships no pybind11; the C ABI + ctypes is the reference's own
+``HorovodBasics`` loading pattern, ``horovod/common/basics.py``).  Build
+artifacts are content-hashed so editing ``core.cc`` rebuilds automatically,
+and a failed build degrades gracefully: ``available()`` returns False and
+the pure-Python fallbacks stay in charge.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("horovod_tpu.core.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "core.cc")
+
+_lib = None
+_lib_err: Optional[str] = None
+_lib_lock = threading.Lock()
+
+BatchCB = ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_longlong),
+                           ctypes.c_int)
+
+
+def _build() -> str:
+    src = open(_SRC, "rb").read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    build_dir = os.path.join(_HERE, "build")
+    so_path = os.path.join(build_dir, f"libhvdcore-{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(build_dir, exist_ok=True)
+    tmp = so_path + f".tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, so_path)  # atomic under concurrent builders
+    return so_path
+
+
+def _bind(lib) -> None:
+    lib.hvd_core_version.restype = ctypes.c_char_p
+    lib.hvd_handle_wait.argtypes = [ctypes.c_int, ctypes.c_double]
+    lib.hvd_handle_error.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                     ctypes.c_int]
+    lib.hvd_sched_start.argtypes = [ctypes.c_double, ctypes.c_longlong,
+                                    BatchCB, ctypes.c_double, ctypes.c_int]
+    lib.hvd_sched_enqueue.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.c_longlong, ctypes.c_int]
+    lib.hvd_sched_enqueue.restype = ctypes.c_longlong
+    lib.hvd_sched_update_tuning.argtypes = [ctypes.c_double,
+                                            ctypes.c_longlong]
+    lib.hvd_cache_lookup.argtypes = [ctypes.c_char_p]
+    lib.hvd_cache_insert.argtypes = [ctypes.c_char_p]
+    lib.hvd_cache_stats.argtypes = [ctypes.POINTER(ctypes.c_longlong),
+                                    ctypes.POINTER(ctypes.c_longlong)]
+    lib.hvd_timeline_open.argtypes = [ctypes.c_char_p]
+    lib.hvd_timeline_event.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                       ctypes.c_char, ctypes.c_double,
+                                       ctypes.c_double, ctypes.c_longlong]
+
+
+def get_lib():
+    """Load (building if needed) the native core; None when unavailable."""
+    global _lib, _lib_err
+    with _lib_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        if os.environ.get("HVD_TPU_NATIVE_CORE", "1") in ("0", "false"):
+            _lib_err = "disabled via HVD_TPU_NATIVE_CORE=0"
+            return None
+        try:
+            path = _build()
+            lib = ctypes.CDLL(path)
+            _bind(lib)
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _lib_err = f"native core build failed: {detail[:500]}"
+            log.warning("%s -- falling back to pure-Python runtime",
+                        _lib_err)
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def unavailable_reason() -> Optional[str]:
+    get_lib()
+    return _lib_err
+
+
+# ---------------------------------------------------------------------------
+# Pythonic wrappers
+# ---------------------------------------------------------------------------
+
+
+class NativeHandles:
+    """Thread-safe async-op handle table (HandleManager parity)."""
+
+    def __init__(self, lib=None):
+        self._lib = lib or get_lib()
+        if self._lib is None:
+            raise RuntimeError(unavailable_reason() or "native core missing")
+
+    def create(self) -> int:
+        return self._lib.hvd_handle_create()
+
+    def done(self, h: int, status: int = 0, error: str = "") -> None:
+        self._lib.hvd_handle_done(h, status, error.encode())
+
+    def poll(self, h: int) -> int:
+        return self._lib.hvd_handle_poll(h)
+
+    def wait(self, h: int, timeout_s: float = -1.0) -> int:
+        return self._lib.hvd_handle_wait(h, timeout_s)
+
+    def error(self, h: int) -> str:
+        buf = ctypes.create_string_buffer(1024)
+        self._lib.hvd_handle_error(h, buf, len(buf))
+        return buf.value.decode()
+
+    def release(self, h: int) -> None:
+        self._lib.hvd_handle_release(h)
+
+    def pending(self) -> int:
+        return self._lib.hvd_handle_pending()
+
+
+class NativeScheduler:
+    """Cycle-time micro-batching scheduler (TensorQueue + RunLoopOnce).
+
+    Python registers payloads keyed by request id; the native background
+    thread groups requests (per dtype, up to the fusion threshold) every
+    cycle and invokes ``on_batch(payloads)`` from its own thread.
+    """
+
+    def __init__(self, on_batch: Callable[[List], None],
+                 cycle_ms: float = 1.0,
+                 fusion_bytes: int = 64 << 20,
+                 stall_warn_s: float = 60.0,
+                 deterministic: bool = False, lib=None):
+        self._lib = lib or get_lib()
+        if self._lib is None:
+            raise RuntimeError(unavailable_reason() or "native core missing")
+        self._payloads: Dict[int, object] = {}
+        self._plock = threading.Lock()
+        self._on_batch = on_batch
+
+        def _cb(ids_ptr, n):
+            ids = [ids_ptr[i] for i in range(n)]
+            with self._plock:
+                payloads = [self._payloads.pop(i) for i in ids
+                            if i in self._payloads]
+            if payloads:
+                try:
+                    self._on_batch(payloads)
+                except Exception:  # noqa: BLE001 - background thread
+                    log.exception("native scheduler batch callback failed")
+
+        self._cb = BatchCB(_cb)  # keep a ref; C holds the raw pointer
+        rc = self._lib.hvd_sched_start(cycle_ms, fusion_bytes, self._cb,
+                                       stall_warn_s, int(deterministic))
+        if rc != 0:
+            raise RuntimeError("scheduler already running (singleton)")
+
+    def enqueue(self, payload, name: str, dtype_code: int, nbytes: int,
+                handle: int = 0) -> int:
+        # The payload must be registered under the same lock the dispatch
+        # callback takes, so a cycle firing between the native enqueue and
+        # the registration blocks until the payload is in place.
+        with self._plock:
+            rid = self._lib.hvd_sched_enqueue(name.encode(), dtype_code,
+                                              nbytes, handle)
+            if rid < 0:
+                raise RuntimeError("scheduler not running")
+            self._payloads[rid] = payload
+        return rid
+
+    def flush(self) -> None:
+        self._lib.hvd_sched_flush()
+
+    def pending(self) -> int:
+        return self._lib.hvd_sched_pending()
+
+    def update_tuning(self, cycle_ms: float = -1.0,
+                      fusion_bytes: int = -1) -> None:
+        self._lib.hvd_sched_update_tuning(cycle_ms, fusion_bytes)
+
+    def stop(self) -> None:
+        self._lib.hvd_sched_stop()
+
+
+class NativeCache:
+    """LRU response-signature cache (ResponseCache parity)."""
+
+    def __init__(self, capacity: int = 1024, lib=None):
+        self._lib = lib or get_lib()
+        if self._lib is None:
+            raise RuntimeError(unavailable_reason() or "native core missing")
+        self._lib.hvd_cache_configure(capacity)
+
+    def lookup(self, sig: str) -> bool:
+        return bool(self._lib.hvd_cache_lookup(sig.encode()))
+
+    def insert(self, sig: str) -> None:
+        self._lib.hvd_cache_insert(sig.encode())
+
+    def __len__(self) -> int:
+        return self._lib.hvd_cache_size()
+
+    def stats(self):
+        hits = ctypes.c_longlong()
+        misses = ctypes.c_longlong()
+        self._lib.hvd_cache_stats(ctypes.byref(hits), ctypes.byref(misses))
+        return hits.value, misses.value
+
+
+class NativeTimeline:
+    """Background-thread chrome-trace writer (timeline.cc parity)."""
+
+    def __init__(self, path: str, lib=None):
+        self._lib = lib or get_lib()
+        if self._lib is None:
+            raise RuntimeError(unavailable_reason() or "native core missing")
+        rc = self._lib.hvd_timeline_open(path.encode())
+        if rc != 0:
+            raise RuntimeError(f"timeline open failed ({rc}): {path}")
+
+    def event(self, name: str, cat: str, ph: str, ts_us: float,
+              dur_us: float = 0.0, tid: int = 0) -> None:
+        self._lib.hvd_timeline_event(name.encode(), cat.encode(),
+                                     ph.encode(), ts_us, dur_us, tid)
+
+    def close(self) -> None:
+        self._lib.hvd_timeline_close()

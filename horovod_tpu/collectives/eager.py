@@ -207,13 +207,15 @@ def grouped_allreduce(xs: Sequence, op: ReduceOp = Average, *, name=None,
     if not xs:
         return []
     ps = _ps.get_process_set(process_set)
-    n = ps.size()
+    # Inputs are rank-stacked: ALL ranks single-process, this process's
+    # local ranks in multi-process mode -- flatten per leading row.
+    k = local_rank_count(ps)
     by_dtype: Dict[Any, List[int]] = {}
     for i, x in enumerate(xs):
         by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
     out: List[Any] = [None] * len(xs)
     for dt, idxs in by_dtype.items():
-        flats = [xs[i].reshape(n, -1) for i in idxs]
+        flats = [xs[i].reshape(k, -1) for i in idxs]
         widths = [f.shape[1] for f in flats]
         fused = flats[0] if len(flats) == 1 else jnp.concatenate(flats, axis=1)
         red = allreduce(fused, op,
@@ -221,7 +223,10 @@ def grouped_allreduce(xs: Sequence, op: ReduceOp = Average, *, name=None,
                         process_set=process_set, compression=compression)
         off = 0
         for i, w in zip(idxs, widths):
-            out[i] = red[:, off:off + w].reshape(xs[i].shape)
+            # ``red`` is rank-stacked over the GLOBAL set (its leading axis
+            # is ps.size(), not the local k), so unfuse per global row.
+            out[i] = red[:, off:off + w].reshape(
+                (red.shape[0],) + xs[i].shape[1:])
             off += w
     return out
 

@@ -145,6 +145,9 @@ def _atexit_shutdown() -> None:
 
 def shutdown() -> None:
     """Tear down framework state (``hvd.shutdown()`` parity)."""
+    import sys
+    if "horovod_tpu.torch_api.batching" in sys.modules:
+        sys.modules["horovod_tpu.torch_api.batching"].shutdown_batcher()
     st = global_state()
     with st.lock:
         if not st.initialized:

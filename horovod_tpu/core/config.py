@@ -64,6 +64,10 @@ class Config:
     # Reference: HOROVOD_CACHE_CAPACITY (default 1024).
     cache_capacity: int = 1024
 
+    # Eager-path micro-batch window in milliseconds (HOROVOD_CYCLE_TIME):
+    # how long the native scheduler waits to fuse hook-enqueued gradients.
+    cycle_time: float = 1.0
+
     # Two-level DCN x ICI reduction (NCCLHierarchicalAllreduce analogue).
     hierarchical_allreduce: bool = False
 
@@ -114,6 +118,7 @@ def load_config() -> Config:
     return Config(
         fusion_threshold=_env_int("FUSION_THRESHOLD", 64 * _MiB),
         cache_capacity=_env_int("CACHE_CAPACITY", 1024),
+        cycle_time=_env_float("CYCLE_TIME", 1.0),
         hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE"),
         timeline=_env("TIMELINE"),
         timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES"),

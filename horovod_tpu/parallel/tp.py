@@ -80,9 +80,17 @@ def shard_tp_params(params, tp_rank, tp_size, *, column_keys=("wq", "wk",
             return leaf
         owner = names[-2] if names[-1] == "kernel" else ""
         if owner in column_keys:
+            if leaf.shape[-1] % tp_size:
+                raise ValueError(
+                    f"{owner}.kernel output dim {leaf.shape[-1]} not "
+                    f"divisible by tp={tp_size}")
             width = leaf.shape[-1] // tp_size
             return leaf[..., tp_rank * width:(tp_rank + 1) * width]
         if owner in row_keys:
+            if leaf.shape[0] % tp_size:
+                raise ValueError(
+                    f"{owner}.kernel input dim {leaf.shape[0]} not "
+                    f"divisible by tp={tp_size}")
             width = leaf.shape[0] // tp_size
             return leaf[tp_rank * width:(tp_rank + 1) * width]
         return leaf

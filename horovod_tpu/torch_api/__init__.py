@@ -94,10 +94,12 @@ def allreduce_async_(tensor: torch.Tensor, **kwargs) -> int:
 
 
 def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
-                      op=None, process_set=None) -> List[torch.Tensor]:
+                      op=None, process_set=None,
+                      compression=Compression.none) -> List[torch.Tensor]:
     op = _resolve_op(average, op)
     outs = _eager.grouped_allreduce([_to_stack(t) for t in tensors], op,
-                                    name=name, process_set=process_set)
+                                    name=name, process_set=process_set,
+                                    compression=compression)
     return [_from_row(o, t) for o, t in zip(outs, tensors)]
 
 

@@ -20,6 +20,7 @@ import torch
 from ..collectives.compression import Compression
 from ..collectives.reduce_op import Average, ReduceOp
 from . import _handles, allreduce_async_
+from . import batching as _batching
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):
@@ -27,13 +28,23 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _init_distributed(self, named_parameters, compression, op,
                           backward_passes_per_step, process_set) -> None:
+        # Every param needs a UNIQUE name: in multi-process mode the
+        # native scheduler cuts fused buckets in name-sorted order, so
+        # duplicate names would let bucket layouts diverge across ranks
+        # and sum mismatched gradients (the reference likewise rejects
+        # dup/incomplete named_parameters, horovod/torch/optimizer.py).
+        self._param_names = {
+            v: f"allreduce.noname.{i}.{j}"
+            for i, group in enumerate(self.param_groups)
+            for j, v in enumerate(group["params"])}
         if named_parameters:
-            self._param_names = {v: k for k, v in named_parameters}
-        else:
-            self._param_names = {
-                v: f"allreduce.noname.{i}.{j}"
-                for i, group in enumerate(self.param_groups)
-                for j, v in enumerate(group["params"])}
+            named = list(named_parameters)
+            names = [k for k, _ in named]
+            if len(set(names)) != len(names):
+                dups = sorted({n for n in names if names.count(n) > 1})
+                raise ValueError(
+                    f"named_parameters contains duplicate names: {dups}")
+            self._param_names.update({v: k for k, v in named})
         self._compression = compression
         self._op = op
         self._process_set = process_set
@@ -73,18 +84,36 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._counter[p] = 0
             if self.backward_passes_per_step > 1:
                 p.grad.div_(self.backward_passes_per_step)
-            self._pending[p] = allreduce_async_(
-                p.grad, op=self._op,
-                name=self._param_names.get(p, "allreduce.noname"),
-                compression=self._compression,
-                process_set=self._process_set)
+            name = self._param_names.get(p)
+            if name is None:
+                raise AssertionError(
+                    "parameter was added to the optimizer after "
+                    "DistributedOptimizer() wrapped it; re-wrap so every "
+                    "parameter has a stable unique allreduce name")
+            # Hot path: hand the gradient to the native cycle scheduler,
+            # which fuses everything produced within HOROVOD_CYCLE_TIME
+            # into one collective per bucket (RunLoopOnce parity).  The
+            # per-tensor eager dispatch is the no-native fallback.
+            b = _batching.batcher()
+            if b is not None:
+                self._pending[p] = ("native", b.enqueue(
+                    p.grad, name, self._op, self._compression,
+                    self._process_set))
+            else:
+                self._pending[p] = ("eager", allreduce_async_(
+                    p.grad, op=self._op, name=name,
+                    compression=self._compression,
+                    process_set=self._process_set))
         return hook
 
     # -- sync -------------------------------------------------------------
     def synchronize(self) -> None:
         """Drain outstanding allreduce handles (grads updated in place)."""
-        for p, h in list(self._pending.items()):
-            _handles.synchronize(h)
+        for p, (kind, h) in list(self._pending.items()):
+            if kind == "native":
+                _batching.batcher().wait(h)
+            else:
+                _handles.synchronize(h)
             del self._pending[p]
 
     class _DisableSync:
