@@ -1,0 +1,49 @@
+"""``horovod_tpu.mxnet``: MXNet API shim (reference ``horovod/mxnet/``).
+
+MXNet reached end-of-life upstream (retired by Apache in 2023) and is not
+installed in TPU images; the reference still ships the binding, so the
+surface exists here for parity.  Core identity functions work without
+MXNet (they don't touch NDArrays); the tensor APIs require the ``mxnet``
+package and raise with guidance otherwise.
+"""
+
+from __future__ import annotations
+
+from ..core.basics import (  # noqa: F401
+    init, shutdown, is_initialized, size, rank, local_size, local_rank,
+    cross_size, cross_rank, nccl_built, mpi_built, gloo_built, tpu_built,
+    mpi_threads_supported,
+)
+from ..collectives.reduce_op import (  # noqa: F401
+    ReduceOp, Average, Sum, Min, Max, Product, Adasum,
+)
+from ..collectives.compression import Compression  # noqa: F401
+
+_TENSOR_APIS = (
+    "allreduce", "allreduce_", "grouped_allreduce", "allgather",
+    "broadcast", "broadcast_", "alltoall", "reducescatter",
+    "broadcast_parameters", "broadcast_object", "DistributedOptimizer",
+    "DistributedTrainer",
+)
+
+
+def _require_mxnet():
+    try:
+        import mxnet  # noqa: F401
+        return mxnet
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.mxnet tensor APIs require the `mxnet` package, "
+            "which is not installed (MXNet is EOL and absent from TPU "
+            "images). Use horovod_tpu (JAX), horovod_tpu.torch, or "
+            "horovod_tpu.tensorflow instead.") from e
+
+
+def __getattr__(name: str):
+    if name in _TENSOR_APIS:
+        _require_mxnet()
+        raise NotImplementedError(
+            f"horovod_tpu.mxnet.{name}: MXNet NDArray bridging is not "
+            f"implemented for the TPU backend (MXNet is EOL); the "
+            f"reference surface is documented for parity only.")
+    raise AttributeError(name)

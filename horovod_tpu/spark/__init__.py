@@ -1,0 +1,82 @@
+"""``horovod_tpu.spark``: Spark cluster integration (reference
+``horovod/spark/`` parity surface).
+
+``run(fn)`` executes ``fn`` once per worker inside a Spark barrier-mode
+stage, with the ``HOROVOD_*`` identity env and the coordinator address
+injected exactly like ``horovod_tpu.run`` does for local workers (the
+reference's ``horovod.spark.run`` + ``gloo_run`` path, SURVEY.md section
+3.6).  PySpark is an optional dependency: importing this package works
+without it; calling :func:`run` raises with guidance.
+
+The :class:`~horovod_tpu.spark.store.LocalStore` / ``Store`` abstraction
+(checkpoint + intermediate-data layout used by the estimators) is
+dependency-free and fully functional.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Callable, List, Optional
+
+from .store import LocalStore, Store  # noqa: F401
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark.run() requires pyspark, which is not "
+            "installed in this environment. Install pyspark (or launch "
+            "workers directly with `python -m horovod_tpu.run -np N ...`)."
+        ) from e
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        num_proc: Optional[int] = None, verbose: int = 1) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``num_proc`` Spark barrier tasks.
+
+    Each task initializes the framework with its barrier partition id as
+    rank; rank 0's host serves as the JAX coordinator (the rendezvous
+    analogue).  Returns the per-rank results, rank-ordered.
+    """
+    pyspark = _require_pyspark()
+    kwargs = kwargs or {}
+    spark = pyspark.sql.SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    n = num_proc or int(sc.defaultParallelism)
+
+    coordinator_port = _free_port()
+
+    def task_fn(iterator):
+        from pyspark import BarrierTaskContext
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        infos = ctx.getTaskInfos()
+        coordinator = infos[0].address.split(":")[0]
+        os.environ.update(task_env(rank, n, coordinator, coordinator_port))
+        ctx.barrier()
+        yield rank, fn(*args, **kwargs)
+
+    results = (sc.parallelize(range(n), n)
+               .barrier()
+               .mapPartitions(task_fn)
+               .collect())
+    return [r for _, r in sorted(results)]
+
+
+def task_env(rank: int, size: int, coordinator: str, port: int) -> dict:
+    """The env a Spark barrier task exports before user code runs
+    (mirrors ``horovod_tpu.run.launch.worker_env``; dependency-free so the
+    layout is unit-testable without a cluster)."""
+    from ..run.launch import worker_env
+    return worker_env(rank=rank, size=size, coordinator=coordinator,
+                      port=port, cpu=False)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

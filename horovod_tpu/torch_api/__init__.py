@@ -245,3 +245,4 @@ def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
 
 
 from .optimizer import DistributedOptimizer  # noqa: E402,F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: E402,F401

@@ -1,0 +1,115 @@
+"""SyncBatchNorm for the torch shim (``horovod/torch/sync_batch_norm.py``
+parity).
+
+BatchNorm whose batch statistics are computed over the GLOBAL batch: each
+rank contributes its local sum / sum-of-squares / count through a Sum
+allreduce on the XLA mesh, and the backward pass likewise sum-reduces the
+two gradient statistics, so training with sync BN is numerically identical
+to single-process training on the concatenated batch.
+
+Weight/bias gradients are returned as LOCAL sums (like every other layer),
+so the wrapping ``DistributedOptimizer`` averages them -- matching the
+reference's division of labour.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from ..collectives.reduce_op import Sum
+from . import allreduce, size
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in ``hvd.SyncBatchNorm(num_features, ...)``.
+
+    In eval mode (or when no peer exists) it behaves exactly like the
+    underlying ``_BatchNorm``; in training mode the statistics cross the
+    mesh.  ``process_set`` restricts the stat exchange to a subset of
+    ranks (e.g. per model-parallel group).
+    """
+
+    def __init__(self, *args, process_set=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._process_set = process_set
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(f"expected at least 2D input, got "
+                             f"{input.dim()}D")
+
+    def forward(self, input: torch.Tensor) -> torch.Tensor:
+        self._check_input_dim(input)
+        if not self.training or size() == 1:
+            return super().forward(input)
+
+        out, mean, var_biased, count = _SyncBatchNormFn.apply(
+            input, self.weight, self.bias, self.eps, self._process_set)
+
+        if self.track_running_stats:
+            with torch.no_grad():
+                self.num_batches_tracked += 1
+                momentum = (1.0 / float(self.num_batches_tracked)
+                            if self.momentum is None else self.momentum)
+                n = float(count)
+                var_unbiased = var_biased * n / max(n - 1.0, 1.0)
+                self.running_mean.mul_(1 - momentum).add_(
+                    momentum * mean.detach())
+                self.running_var.mul_(1 - momentum).add_(
+                    momentum * var_unbiased.detach())
+        return out
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, eps, process_set):
+        dims = [0] + list(range(2, input.dim()))
+        c = input.shape[1]
+        local_count = float(input.numel()) / c
+        stats = torch.cat([
+            input.sum(dims),
+            (input * input).sum(dims),
+            torch.full((1,), local_count, dtype=input.dtype),
+        ])
+        g = allreduce(stats, op=Sum, name="sync_batch_norm.fwd",
+                      process_set=process_set)
+        g_count = float(g[-1])
+        g_sum, g_sqsum = g[:c], g[c:2 * c]
+        mean = g_sum / g_count
+        var = g_sqsum / g_count - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+        ctx.affine = weight is not None
+        w = weight if ctx.affine else torch.ones(c, dtype=input.dtype)
+        b = bias if bias is not None else torch.zeros(c, dtype=input.dtype)
+        out = xhat * w.view(shape) + b.view(shape)
+        ctx.save_for_backward(xhat, w, invstd)
+        ctx.g_count = g_count
+        ctx.process_set = process_set
+        return out, mean, var, torch.tensor(g_count)
+
+    @staticmethod
+    def backward(ctx, grad_out, _gm, _gv, _gc):
+        xhat, weight, invstd = ctx.saved_tensors
+        dims = [0] + list(range(2, grad_out.dim()))
+        c = grad_out.shape[1]
+        shape = [1, c] + [1] * (grad_out.dim() - 2)
+
+        sum_dy_local = grad_out.sum(dims)
+        sum_dy_xhat_local = (grad_out * xhat).sum(dims)
+        g = allreduce(torch.cat([sum_dy_local, sum_dy_xhat_local]), op=Sum,
+                      name="sync_batch_norm.bwd", process_set=ctx.process_set)
+        sum_dy, sum_dy_xhat = g[:c], g[c:]
+
+        n = ctx.g_count
+        grad_input = (weight * invstd).view(shape) / n * (
+            n * grad_out - sum_dy.view(shape)
+            - xhat * sum_dy_xhat.view(shape))
+        # Local sums: the DistributedOptimizer averages these like any
+        # other parameter gradient.
+        grad_weight = sum_dy_xhat_local if ctx.affine else None
+        grad_bias = sum_dy_local if ctx.affine else None
+        return grad_input, grad_weight, grad_bias, None, None

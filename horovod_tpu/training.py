@@ -49,6 +49,26 @@ def replicate(tree: Any, mesh: Optional[Mesh] = None) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
+def sync_batch_norm(axes=None, **kwargs):
+    """Flax BatchNorm whose batch statistics span the mesh.
+
+    Reference parity: ``horovod/torch/sync_batch_norm.py`` (the torch shim
+    equivalent lives at ``horovod_tpu.torch.SyncBatchNorm``).  On TPU the
+    stat exchange is just ``lax.pmean`` over the mesh axes, which flax's
+    BatchNorm emits natively via ``axis_name`` -- XLA fuses it with the
+    surrounding reduction, so sync BN costs one small fused collective.
+
+    Use inside a step built by :func:`make_train_step` /
+    :func:`make_flax_train_step` (the mesh axes are bound by shard_map
+    there).  ``axes`` defaults to the initialized mesh's axis names.
+    """
+    import flax.linen as nn
+    axes = tuple(axes) if axes is not None else tuple(
+        _basics.mesh().axis_names)
+    return nn.BatchNorm(axis_name=axes if len(axes) > 1 else axes[0],
+                        **kwargs)
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Any], jnp.ndarray],
     optimizer: optax.GradientTransformation,

@@ -1,0 +1,115 @@
+"""Spark/Ray integration analogues and the MXNet shim."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Spark
+# ---------------------------------------------------------------------------
+
+
+def test_spark_run_requires_pyspark():
+    import horovod_tpu.spark as s
+    with pytest.raises(ImportError, match="pyspark"):
+        s.run(lambda: None)
+
+
+def test_spark_task_env_layout():
+    from horovod_tpu.spark import task_env
+    env = task_env(rank=3, size=8, coordinator="10.0.0.5", port=1234)
+    assert env["HOROVOD_RANK"] == "3"
+    assert env["HOROVOD_SIZE"] == "8"
+    assert env["HVD_TPU_COORDINATOR_ADDR"] == "10.0.0.5"
+    assert env["HVD_TPU_COORDINATOR_PORT"] == "1234"
+
+
+def test_local_store_layout_and_io(tmp_path):
+    from horovod_tpu.spark import LocalStore, Store
+    store = Store.create(str(tmp_path))
+    assert isinstance(store, LocalStore)
+    ckpt = store.get_checkpoint_path("run1")
+    assert ckpt.startswith(str(tmp_path))
+    assert "run1" in ckpt
+    store.write(os.path.join(ckpt, "model.bin"), b"abc")
+    assert store.exists(os.path.join(ckpt, "model.bin"))
+    assert store.read(os.path.join(ckpt, "model.bin")) == b"abc"
+    store.delete(store.get_run_path("run1"))
+    assert not store.exists(ckpt)
+    assert store.get_train_data_path(2).endswith(".2")
+
+
+def test_hdfs_store_raises_with_guidance(tmp_path):
+    from horovod_tpu.spark import Store
+    with pytest.raises(ImportError, match="hdfs"):
+        Store.create("hdfs://namenode/path")
+    with pytest.raises(ValueError, match="mount"):
+        Store.create("s3://bucket/path")
+
+
+# ---------------------------------------------------------------------------
+# Ray (local backend)
+# ---------------------------------------------------------------------------
+
+
+def _worker_identity():
+    return (os.environ["HOROVOD_RANK"], os.environ["HOROVOD_SIZE"])
+
+
+def test_ray_executor_requires_start():
+    from horovod_tpu.ray import RayExecutor
+    ex = RayExecutor(num_workers=2, use_ray=False)
+    with pytest.raises(RuntimeError, match="start"):
+        ex.run(_worker_identity)
+
+
+@pytest.mark.integration
+def test_ray_executor_local_backend_runs_workers():
+    from horovod_tpu.ray import RayExecutor
+    ex = RayExecutor(num_workers=2, cpu=True, use_ray=False)
+    ex.start()
+    try:
+        results = ex.run(_worker_identity)
+    finally:
+        ex.shutdown()
+    assert results == [("0", "2"), ("1", "2")]
+
+
+@pytest.mark.integration
+def test_ray_executor_local_backend_propagates_failure():
+    from horovod_tpu.ray import RayExecutor
+
+    ex = RayExecutor(num_workers=2, cpu=True, use_ray=False)
+    ex.start()
+    try:
+        with pytest.raises(RuntimeError, match="worker .* failed"):
+            ex.run(_crashing_worker)
+    finally:
+        ex.shutdown()
+
+
+def _crashing_worker():
+    raise ValueError("boom")
+
+
+# ---------------------------------------------------------------------------
+# MXNet shim
+# ---------------------------------------------------------------------------
+
+
+def test_mxnet_identity_works_without_mxnet():
+    import horovod_tpu.mxnet as m
+    assert not m.nccl_built()
+    assert m.tpu_built() in (True, False)
+
+
+def test_mxnet_tensor_apis_raise_with_guidance():
+    import horovod_tpu.mxnet as m
+    with pytest.raises(ImportError, match="mxnet"):
+        m.allreduce
+    with pytest.raises(AttributeError):
+        m.not_a_real_api

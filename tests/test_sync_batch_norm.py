@@ -1,0 +1,136 @@
+"""SyncBatchNorm: torch shim and flax cross-replica BN."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture
+def hvd_t(hvd):
+    import horovod_tpu.torch_api as t
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Torch shim
+# ---------------------------------------------------------------------------
+
+
+def test_torch_sync_bn_matches_local_bn(hvd_t):
+    """Single-controller mode replicates the batch to every rank, so the
+    global stats equal the local ones -> must match plain BatchNorm2d."""
+    torch.manual_seed(0)
+    x = torch.randn(4, 3, 5, 5, requires_grad=True)
+    x_ref = x.detach().clone().requires_grad_(True)
+
+    sbn = hvd_t.SyncBatchNorm(3, momentum=0.1)
+    bn = torch.nn.BatchNorm2d(3, momentum=0.1)
+    bn.load_state_dict({k: v.clone() for k, v in sbn.state_dict().items()})
+
+    out_s = sbn(x)
+    out_r = bn(x_ref)
+    np.testing.assert_allclose(out_s.detach().numpy(),
+                               out_r.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(sbn.running_mean.numpy(),
+                               bn.running_mean.numpy(), atol=1e-5)
+    # The unbiased-var correction uses the GLOBAL count (n * world_size
+    # with the replicated batch), not torch's local n -- same convention
+    # as torch.nn.SyncBatchNorm.
+    n_global = float(x.numel() / x.shape[1]) * hvd_t.size()
+    var_b = x.detach().var(dim=(0, 2, 3), unbiased=False)
+    want_rv = 0.9 * 1.0 + 0.1 * var_b * n_global / (n_global - 1)
+    np.testing.assert_allclose(sbn.running_var.numpy(), want_rv.numpy(),
+                               atol=1e-5)
+
+    g = torch.randn_like(out_s)
+    out_s.backward(g)
+    out_r.backward(g)
+    np.testing.assert_allclose(x.grad.numpy(), x_ref.grad.numpy(),
+                               atol=1e-4)
+    # weight/bias grads are LOCAL sums here; local == global per-rank
+    # contribution in replicated mode, so they match plain BN too.
+    np.testing.assert_allclose(sbn.weight.grad.numpy(),
+                               bn.weight.grad.numpy(), atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(sbn.bias.grad.numpy(),
+                               bn.bias.grad.numpy(), atol=2e-4, rtol=1e-4)
+
+
+def test_torch_sync_bn_eval_uses_running_stats(hvd_t):
+    sbn = hvd_t.SyncBatchNorm(2)
+    x = torch.randn(3, 2, 4)
+    sbn(x)  # one training step updates running stats
+    sbn.eval()
+    out = sbn(x)
+    mean = sbn.running_mean.view(1, 2, 1)
+    var = sbn.running_var.view(1, 2, 1)
+    want = (x - mean) / torch.sqrt(var + sbn.eps)
+    want = want * sbn.weight.view(1, 2, 1) + sbn.bias.view(1, 2, 1)
+    np.testing.assert_allclose(out.detach().numpy(), want.detach().numpy(),
+                               atol=1e-5)
+
+
+def test_torch_sync_bn_no_affine(hvd_t):
+    sbn = hvd_t.SyncBatchNorm(3, affine=False)
+    x = torch.randn(4, 3, 4, requires_grad=True)
+    out = sbn(x)
+    out.sum().backward()
+    assert x.grad is not None
+    assert sbn.weight is None
+
+
+def test_torch_sync_bn_rejects_1d(hvd_t):
+    with pytest.raises(ValueError, match="2D"):
+        hvd_t.SyncBatchNorm(3)(torch.randn(5))
+
+
+# ---------------------------------------------------------------------------
+# Flax cross-replica BN
+# ---------------------------------------------------------------------------
+
+
+def test_flax_sync_bn_matches_global_batch(hvd):
+    """BN stats over the sharded batch == BN over the full batch."""
+    import flax.linen as nn
+    from jax.sharding import PartitionSpec as P
+
+    class SyncModel(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            import horovod_tpu as hv
+            return hv.sync_batch_norm(
+                use_running_average=not train, momentum=0.9)(x)
+
+    class LocalModel(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            return nn.BatchNorm(use_running_average=not train,
+                                momentum=0.9)(x)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 6).astype(np.float32) * 3 + 1)
+
+    sync = SyncModel()
+    local = LocalModel()
+    variables = local.init(jax.random.PRNGKey(0), x[:1])
+    want, ref_mut = local.apply(variables, x, mutable=["batch_stats"])
+
+    mesh = hvd.mesh()
+    axes = tuple(mesh.axis_names)
+
+    def spmd(v, xs):
+        out, mut = sync.apply(v, xs, mutable=["batch_stats"])
+        return out, mut
+
+    got, got_mut = jax.jit(jax.shard_map(
+        spmd, mesh=mesh, in_specs=(P(), P(axes)),
+        out_specs=(P(axes), P()), check_vma=False))(variables, x)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+    # Running stats must equal the full-batch ones (not per-shard).
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(got_mut)[0]),
+        np.asarray(jax.tree.leaves(ref_mut)[0]), atol=1e-5)
