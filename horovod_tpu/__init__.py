@@ -26,6 +26,7 @@ from .core.exceptions import (  # noqa: F401
     HorovodTpuError, HorovodInternalError, HostsUpdatedInterrupt,
     NotInitializedError, ProcessSetError,
 )
+from .core.desync import check_desync  # noqa: F401
 from .core.process_sets import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, get_process_set,
     process_set_names,

@@ -40,6 +40,7 @@ from .compression import Compression
 from .reduce_op import ReduceOp, Average, Sum
 from ..controller.cache import signature
 from ..core import process_sets as _ps
+from ..core import stall as _stall
 from ..core.state import global_state
 from ..parallel.mesh import HVD_AXIS
 
@@ -150,7 +151,8 @@ def synchronize(handle: int):
     """Block until the async op completes and return its result."""
     with _handle_lock:
         value = _handles.pop(handle)
-    return jax.block_until_ready(value)
+    with _stall.watched(f"synchronize(handle={handle})"):
+        return jax.block_until_ready(value)
 
 
 def poll(handle: int) -> bool:
@@ -279,7 +281,8 @@ def barrier(*, process_set=None) -> None:
     ones = replicated_stack(np.ones((1,), np.int32), ps)
     out = _run("barrier", ones, "barrier", ps,
                lambda t: _ops.barrier(axes=(HVD_AXIS,)) * t, "barrier")
-    jax.block_until_ready(out)
+    with _stall.watched("barrier"):
+        jax.block_until_ready(out)
 
 
 def join() -> int:

@@ -289,3 +289,36 @@ def ppermute(x, perm, *, axes: Optional[AxisSpec] = None):
     if len(axes) != 1:
         raise NotImplementedError("ppermute requires a flat mesh axis")
     return lax.ppermute(x, axes[0], perm)
+
+
+def desync_check(x, *, axes: Optional[AxisSpec] = None):
+    """In-step desync probe: scalar bool, True when ``x`` is NOT
+    bit-identical on every mesh member.
+
+    Debug-mode companion of :func:`horovod_tpu.core.desync.check_desync`
+    (SURVEY.md 5.2's "psum of hashes"): an integer bit-sum of the local
+    array compared via pmax/pmin -- two cheap scalar collectives, so it can
+    run every step under ``HOROVOD_CHECK_DESYNC=1`` without moving data.
+    """
+    axes, _ = _resolve(axes)
+    x = jnp.asarray(x)
+    nbits = x.dtype.itemsize * 8
+    if x.dtype == jnp.bool_:
+        bits = x.astype(jnp.int32)
+    elif nbits >= 32:
+        # Wide elements bitcast to int32 words (64-bit dtypes gain a
+        # trailing length-2 dim), so no high bits are dropped.
+        bits = lax.bitcast_convert_type(x, jnp.int32)
+    elif jnp.issubdtype(x.dtype, jnp.floating):
+        bits = lax.bitcast_convert_type(
+            x, jnp.dtype(f"int{nbits}")).astype(jnp.int32)
+    else:
+        bits = x.astype(jnp.int32)
+    # Wrapping int32 sum: exact (associative) regardless of reduction order,
+    # unlike a float checksum.
+    c = jnp.sum(bits) if bits.size else jnp.zeros((), jnp.int32)
+    hi, lo = c, c
+    for a in axes:
+        hi = lax.pmax(hi, a)
+        lo = lax.pmin(lo, a)
+    return hi != lo

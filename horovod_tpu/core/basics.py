@@ -121,6 +121,8 @@ def init(
         if cfg.autotune:
             from ..autotune import Autotuner
             st.autotuner = Autotuner(cfg)
+        from . import stall as _stall
+        _stall.configure(cfg)
         global _atexit_registered
         if not _atexit_registered:
             atexit.register(_atexit_shutdown)
@@ -154,6 +156,8 @@ def shutdown() -> None:
             return
         owns = st.owns_distributed
         st.reset()
+        from . import stall as _stall
+        _stall.teardown()
     if owns:
         try:
             jax.distributed.shutdown()

@@ -1,0 +1,105 @@
+"""Debug-mode desync detection (checksums across the mesh).
+
+The reference has no equivalent subsystem -- its single-background-thread
+design plus the StallInspector covered the divergence failure modes of a
+rank-per-process runtime (SURVEY.md section 5.2).  Under SPMD the dangerous
+class is different: every *process* holds what it believes is a replica of
+the model state, and a bug (non-deterministic host input, a missed
+broadcast after restore, reading params outside the donated step) silently
+diverges replicas until the loss explodes.  SURVEY.md 5.2 prescribes "a
+debug mode that checksums (psum of hashes) to detect desync -- cheap on
+TPU"; this module is that mode, enabled with ``HOROVOD_CHECK_DESYNC=1``.
+
+Two entry points:
+
+* :func:`check_desync` -- host-level: CRC32 every leaf of a pytree,
+  allgather the checksum vectors across the world, and raise
+  ``HorovodInternalError`` naming the leaves that differ.  Wired into
+  ``hvd.elastic`` ``State.commit()`` when the debug flag is on (the commit
+  boundary is exactly where a silent desync would get checkpointed).
+* :func:`horovod_tpu.collectives.ops.desync_check` -- in-step: an integer
+  bit-sum compared via pmax/pmin inside the traced program (see ops.py).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .exceptions import DesyncError
+
+
+def _leaf_checksum(leaf) -> int:
+    """Stable CRC32 of a leaf's host bytes (uint32)."""
+    try:
+        a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+        if a.dtype == object:
+            raise TypeError
+        return zlib.crc32(a.tobytes())
+    except (TypeError, ValueError):
+        # Non-array leaves (strings, tuples of python scalars, ...).
+        return zlib.crc32(repr(leaf).encode())
+
+
+def tree_checksums(tree: Any) -> Tuple[List[str], np.ndarray]:
+    """(leaf paths, per-leaf CRC32 vector) for a pytree."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    paths = [jax.tree_util.keystr(kp) or "<root>" for kp, _ in flat]
+    sums = np.array([_leaf_checksum(v) for _, v in flat], dtype=np.int64)
+    return paths, sums
+
+
+def mismatched_rows(rows: np.ndarray, paths: List[str]) -> List[str]:
+    """Leaf paths whose checksum differs across the rank rows."""
+    if rows.size == 0:
+        return []
+    diff = (rows != rows[0:1]).any(axis=0)
+    return [p for p, d in zip(paths, diff) if d]
+
+
+def check_desync(tree: Any, name: str = "state", process_set=None,
+                 raise_error: bool = True) -> List[str]:
+    """Verify ``tree`` is bit-identical on every process in the set.
+
+    Each process CRC32s its host view of every leaf; the checksum vectors
+    are allgathered and compared.  Returns the paths of mismatched leaves
+    (and raises ``HorovodInternalError`` unless ``raise_error=False``).
+
+    In single-process mode every rank shares one host copy, so this
+    degenerates to a cheap no-op check -- the interesting case is the
+    launcher's one-process-per-device mode.
+    """
+    from ..collectives import eager as _eager
+    from ..core import process_sets as _ps
+
+    ps = _ps.get_process_set(process_set)
+    paths, sums = tree_checksums(tree)
+    if not paths:
+        return []
+    local = _eager.replicated_stack(sums, ps)
+    out = _eager.allgather(local, name=f"desync.{name}", process_set=ps)
+    # Row 0 of the local result is this rank's copy of the concatenation of
+    # every rank's checksum vector.
+    row = _eager.local_result(out)[0]
+    rows = np.asarray(row).reshape(ps.size(), len(paths))
+    bad = mismatched_rows(rows, paths)
+    if bad and raise_error:
+        raise DesyncError(
+            f"desync detected in {name!r}: {len(bad)} leaf/leaves differ "
+            f"across ranks: {bad[:8]}{'...' if len(bad) > 8 else ''} -- a "
+            f"replica of the model state has diverged (missed broadcast "
+            f"after restore, or non-deterministic update?)", leaves=bad)
+    return bad
+
+
+def maybe_check(tree: Any, name: str = "state",
+                process_set=None) -> Optional[List[str]]:
+    """Run :func:`check_desync` only when ``HOROVOD_CHECK_DESYNC`` is on."""
+    from .state import global_state
+    st = global_state()
+    if not st.initialized or st.config is None or not st.config.check_desync:
+        return None
+    return check_desync(tree, name=name, process_set=process_set)

@@ -34,6 +34,20 @@ class HostsUpdatedInterrupt(HorovodTpuError):
         self.skip_sync = skip_sync
 
 
+class DesyncError(HorovodTpuError):
+    """Replica state diverged across ranks (debug-mode checksums).
+
+    Raised by the ``HOROVOD_CHECK_DESYNC=1`` commit-boundary check *before*
+    the diverged values overwrite the last good snapshot.  The elastic run
+    loop recovers without a re-rendezvous: restore the last commit, then
+    ``sync()`` re-broadcasts rank 0's copy so replicas reconverge.
+    """
+
+    def __init__(self, message: str, leaves=None):
+        super().__init__(message)
+        self.leaves = list(leaves or [])
+
+
 class NotInitializedError(HorovodTpuError):
     """An API was called before ``hvd.init()``."""
 

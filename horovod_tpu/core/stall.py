@@ -1,0 +1,274 @@
+"""Stall inspection + worker heartbeats.
+
+TPU-native analogue of the reference's ``horovod/common/stall_inspector.cc``
+(warn when a collective has been outstanding longer than
+``HOROVOD_STALL_CHECK_TIME``, optionally shut the job down after
+``HOROVOD_STALL_SHUTDOWN_TIME``) re-targeted at the two places a TPU SPMD
+runtime can actually stall, per SURVEY.md section 5.2:
+
+* **Blocking waits** (``synchronize()``/``barrier()``/fused-bucket drains):
+  under SPMD the reference's rank-divergence class is gone by construction
+  (every process compiles the same program), but a peer process dying or a
+  wedged device grant leaves ``jax.block_until_ready`` hanging forever.
+  :class:`StallInspector` tracks every watched wait and a daemon checker
+  thread logs which named ops are stuck and for how long.
+* **The launcher/elastic plane**: worker liveness via heartbeat files
+  (:class:`HeartbeatWriter` / :func:`heartbeat_age`); the elastic driver
+  treats a stale heartbeat like a failed worker (terminate -> blacklist ->
+  rescale), replacing the reference's per-tensor cross-rank stall report.
+
+The native cycle scheduler has its own in-C++ stall check for the torch
+hook path (``_core/src/core.cc::CheckStalls``); this module covers the
+pure-Python paths and the process plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("horovod_tpu.stall")
+
+
+class StallInspector:
+    """Watches named blocking operations and complains about stuck ones."""
+
+    def __init__(self, warn_time_s: float = 60.0,
+                 shutdown_time_s: float = 0.0,
+                 check_interval_s: Optional[float] = None,
+                 on_shutdown: Optional[Callable[[List[str]], None]] = None):
+        self.warn_time_s = warn_time_s
+        self.shutdown_time_s = shutdown_time_s
+        self.check_interval_s = check_interval_s or max(
+            min(warn_time_s / 4.0, 10.0), 0.01)
+        self._on_shutdown = on_shutdown or self._default_shutdown
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, Tuple[str, float]] = {}
+        self._next_token = 0
+        self._last_warn: Dict[int, float] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- watching ---------------------------------------------------------
+    def begin(self, name: str) -> int:
+        with self._lock:
+            self._next_token += 1
+            token = self._next_token
+            self._inflight[token] = (name, time.monotonic())
+        self._ensure_thread()
+        return token
+
+    def end(self, token: int) -> None:
+        with self._lock:
+            self._inflight.pop(token, None)
+            self._last_warn.pop(token, None)
+
+    class _Watch:
+        def __init__(self, inspector: "StallInspector", name: str):
+            self._i, self._name = inspector, name
+            self._token: Optional[int] = None
+
+        def __enter__(self):
+            self._token = self._i.begin(self._name)
+            return self
+
+        def __exit__(self, *exc):
+            self._i.end(self._token)
+            return False
+
+    def watch(self, name: str) -> "StallInspector._Watch":
+        """Context manager marking a blocking wait as in flight."""
+        return self._Watch(self, name)
+
+    def stalled(self) -> List[str]:
+        """Names of ops currently past the warn threshold (no logging)."""
+        now = time.monotonic()
+        with self._lock:
+            return [name for name, start in self._inflight.values()
+                    if now - start > self.warn_time_s]
+
+    # -- checking ---------------------------------------------------------
+    def check_now(self) -> List[str]:
+        """One inspection pass; returns the names of currently stalled ops."""
+        now = time.monotonic()
+        stalled: List[str] = []
+        doomed: List[str] = []
+        with self._lock:
+            for token, (name, start) in self._inflight.items():
+                age = now - start
+                if age <= self.warn_time_s:
+                    continue
+                stalled.append(name)
+                if now - self._last_warn.get(token, 0.0) > self.warn_time_s:
+                    self._last_warn[token] = now
+                    logger.warning(
+                        "stall inspector: operation %r has been waiting for "
+                        "%.1fs (> %.1fs). One or more peer processes may "
+                        "have died or a device grant may be wedged.",
+                        name, age, self.warn_time_s)
+                if self.shutdown_time_s > 0 and age > self.shutdown_time_s:
+                    doomed.append(name)
+        if doomed:
+            self._on_shutdown(doomed)
+        return stalled
+
+    @staticmethod
+    def _default_shutdown(names: List[str]) -> None:
+        logger.critical(
+            "stall inspector: operations %s exceeded the shutdown "
+            "threshold; aborting the process (HOROVOD_STALL_SHUTDOWN_TIME "
+            "semantics).", names)
+        os._exit(17)
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-tpu-stall-inspector")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            with self._lock:
+                empty = not self._inflight
+            if empty:
+                continue
+            try:
+                self.check_now()
+            except Exception:  # pragma: no cover - never kill the checker
+                logger.exception("stall inspector check failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.check_interval_s + 1)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# Module singleton, configured by hvd.init().
+# ---------------------------------------------------------------------------
+
+_inspector: Optional[StallInspector] = None
+_inspector_lock = threading.Lock()
+
+
+def configure(config) -> Optional[StallInspector]:
+    """(Re)build the process-wide inspector from a parsed Config."""
+    global _inspector
+    with _inspector_lock:
+        if _inspector is not None:
+            _inspector.stop()
+            _inspector = None
+        if not config.stall_check_disable and config.stall_check_time > 0:
+            _inspector = StallInspector(
+                warn_time_s=config.stall_check_time,
+                shutdown_time_s=config.stall_shutdown_time)
+        return _inspector
+
+
+def inspector() -> Optional[StallInspector]:
+    return _inspector
+
+
+def teardown() -> None:
+    """Stop and drop the process-wide inspector (shutdown path)."""
+    global _inspector
+    with _inspector_lock:
+        if _inspector is not None:
+            _inspector.stop()
+            _inspector = None
+
+
+class _NullWatch:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_WATCH = _NullWatch()
+
+
+def watched(name: str):
+    """``with watched("allreduce.x"):`` around a blocking wait; no-op when
+    no inspector is configured."""
+    ins = _inspector
+    return ins.watch(name) if ins is not None else _NULL_WATCH
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat plane (elastic driver <- worker liveness).
+# ---------------------------------------------------------------------------
+
+def heartbeat_path(assignment_path: str, worker_id: str) -> str:
+    """Heartbeat file for a worker, next to the elastic assignment file.
+
+    Lives here (not in the elastic modules) so the launcher/driver process
+    can compute it without importing jax.
+    """
+    safe = worker_id.replace("/", "_")
+    return os.path.join(os.path.dirname(assignment_path), f"hb_{safe}")
+
+
+class HeartbeatWriter:
+    """Worker-side: touch ``path`` every ``interval_s`` from a daemon thread.
+
+    ``gate`` (when given) is consulted before each beat; returning False
+    skips it.  The elastic run loop gates on the stall inspector, so a
+    worker wedged inside a blocking collective stops beating and the
+    driver's heartbeat timeout can actually evict it -- a live daemon
+    thread alone would keep beating through the hang.
+    """
+
+    def __init__(self, path: str, interval_s: float = 1.0,
+                 gate: Optional[Callable[[], bool]] = None):
+        self.path = path
+        self.interval_s = interval_s
+        self._gate = gate
+        self._stop = threading.Event()
+        self.beat(force=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-tpu-heartbeat")
+        self._thread.start()
+
+    def beat(self, force: bool = False) -> None:
+        if not force and self._gate is not None:
+            try:
+                if not self._gate():
+                    return
+            except Exception:  # pragma: no cover - gate must never kill us
+                logger.exception("heartbeat gate failed")
+        try:
+            with open(self.path, "a"):
+                os.utime(self.path, None)
+        except OSError:  # pragma: no cover - dir vanished mid-teardown
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self.interval_s + 1)
+
+
+def progress_gate() -> bool:
+    """Default heartbeat gate: healthy unless the stall inspector sees a
+    wait past its warn threshold."""
+    ins = _inspector
+    return ins is None or not ins.stalled()
+
+
+def heartbeat_age(path: str) -> Optional[float]:
+    """Seconds since the last beat, or None if no heartbeat exists yet."""
+    try:
+        return max(0.0, time.time() - os.stat(path).st_mtime)
+    except OSError:
+        return None

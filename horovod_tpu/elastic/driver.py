@@ -35,7 +35,8 @@ class ElasticDriver:
                  min_np: int = 1, max_np: Optional[int] = None,
                  cpu: bool = False, slots: int = 1, verbose: int = 0,
                  poll_interval_s: float = 1.0,
-                 elastic_timeout_s: float = 600.0):
+                 elastic_timeout_s: float = 600.0,
+                 heartbeat_timeout_s: float = 0.0):
         self.command = list(command)
         self.discovery = HostDiscoveryScript(discovery_script,
                                              default_slots=slots)
@@ -46,6 +47,10 @@ class ElasticDriver:
         self.verbose = verbose
         self.poll_interval_s = poll_interval_s
         self.elastic_timeout_s = elastic_timeout_s
+        # > 0 enables the process-level stall plane: a worker whose
+        # heartbeat file (written by the elastic run loop) goes stale is
+        # terminated and blacklisted like any failed worker.
+        self.heartbeat_timeout_s = heartbeat_timeout_s
         self.epoch = -1
         self.blacklist: set = set()
         self.workers: Dict[str, TaggedProcess] = {}  # worker_id -> proc
@@ -77,6 +82,14 @@ class ElasticDriver:
         return ranks
 
     def _spawn(self, wid: str, rank: int, size: int, port: int) -> None:
+        # A previous incarnation of this slot may have left a heartbeat
+        # file behind; its stale mtime would get the fresh worker evicted
+        # before it writes its first beat.
+        from ..core.stall import heartbeat_path
+        try:
+            os.unlink(heartbeat_path(self.assignment_path, wid))
+        except OSError:
+            pass
         env = dict(os.environ)
         env.update(worker_env(rank=rank, size=size, coordinator="127.0.0.1",
                               port=port, cpu=self.cpu, slots=1,
@@ -87,6 +100,22 @@ class ElasticDriver:
             env["HOROVOD_LOG_LEVEL"] = "info"
         self.workers[wid] = TaggedProcess(rank, self.command, env,
                                           lock=self._lock)
+
+    def _check_heartbeats(self) -> None:
+        """Terminate workers whose heartbeat went stale (they then reap as
+        failures -> blacklist -> rescale, like the reference's stall-based
+        shutdown)."""
+        if self.heartbeat_timeout_s <= 0:
+            return
+        from ..core.stall import heartbeat_age, heartbeat_path
+        for wid, proc in list(self.workers.items()):
+            age = heartbeat_age(heartbeat_path(self.assignment_path, wid))
+            if age is not None and age > self.heartbeat_timeout_s:
+                logger.warning(
+                    "worker %s heartbeat stale for %.1fs "
+                    "(> %.1fs); terminating", wid, age,
+                    self.heartbeat_timeout_s)
+                proc.terminate()
 
     # -- main loop --------------------------------------------------------
     def run(self) -> int:
@@ -109,6 +138,7 @@ class ElasticDriver:
 
         while True:
             time.sleep(self.poll_interval_s)
+            self._check_heartbeats()
             # 1. Reap exits.
             finished_ok = []
             failed = []

@@ -28,7 +28,9 @@ from typing import Any, Callable
 import jax
 
 from ..core import basics as _basics
-from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..core.exceptions import (DesyncError, HorovodInternalError,
+                               HostsUpdatedInterrupt)
+from ..core.stall import heartbeat_path  # noqa: F401  (re-export)
 from .notify import Notifier
 from .state import State
 
@@ -103,32 +105,59 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
     def wrapper(state: State, *args, **kwargs):
         notifier = Notifier()
         state._hvd_notifier = notifier
-        reset_required = False
-        while True:
-            if reset_required:
-                _reinitialize(notifier)
-                state.on_reset()
-                reset_required = False
-            try:
-                # sync() ends in commit(), which may itself raise
-                # HostsUpdatedInterrupt -- keep it inside the catch.
-                state.sync()
-                return func(state, *args, **kwargs)
-            except HostsUpdatedInterrupt:
-                logger.info("hosts updated; re-rendezvousing")
-                reset_required = True
-            except HorovodInternalError:
-                logger.warning("collective failed; rolling back to last "
-                               "commit")
-                state.restore()
-                reset_required = True
-            except Exception as e:  # noqa: BLE001
-                if _looks_like_comm_failure(e):
-                    logger.warning("comm-plane failure (%s); rolling back",
-                                   type(e).__name__)
-                    state.restore()
-                    reset_required = True
-                else:
-                    raise
+        heartbeat = None
+        if notifier.enabled and notifier.worker_id:
+            # Liveness signal for the driver's stall plane (StallInspector
+            # analogue at the process level).  Beats are gated on the stall
+            # inspector: a worker wedged in a blocking collective stops
+            # beating, so the driver's heartbeat timeout can evict it.
+            from ..core.stall import HeartbeatWriter, progress_gate
+            heartbeat = HeartbeatWriter(
+                heartbeat_path(notifier.path, notifier.worker_id),
+                gate=progress_gate)
+        try:
+            return _elastic_loop(func, state, notifier, args, kwargs)
+        finally:
+            if heartbeat is not None:
+                heartbeat.stop()
 
     return wrapper
+
+
+def _elastic_loop(func, state, notifier, args, kwargs):
+    reset_required = False
+    while True:
+        if reset_required:
+            _reinitialize(notifier)
+            state.on_reset()
+            reset_required = False
+        try:
+            # sync() ends in commit(), which may itself raise
+            # HostsUpdatedInterrupt -- keep it inside the catch.
+            state.sync()
+            return func(state, *args, **kwargs)
+        except HostsUpdatedInterrupt:
+            logger.info("hosts updated; re-rendezvousing")
+            reset_required = True
+        except DesyncError as e:
+            # Raised symmetrically on every rank by the commit-boundary
+            # checksum (the check runs BEFORE the snapshot is overwritten,
+            # so the last commit is still converged).  No membership
+            # change happened, so no re-rendezvous: restore and let the
+            # loop-top sync() rebroadcast rank 0's copy.
+            logger.warning("replica desync (%s); restoring last commit and "
+                           "re-syncing from rank 0", e)
+            state.restore()
+        except HorovodInternalError:
+            logger.warning("collective failed; rolling back to last "
+                           "commit")
+            state.restore()
+            reset_required = True
+        except Exception as e:  # noqa: BLE001
+            if _looks_like_comm_failure(e):
+                logger.warning("comm-plane failure (%s); rolling back",
+                               type(e).__name__)
+                state.restore()
+                reset_required = True
+            else:
+                raise

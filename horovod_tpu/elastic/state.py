@@ -45,6 +45,16 @@ class State:
         from .run_loop import check_for_host_updates
         check_for_host_updates(self)
 
+    def _check_desync(self, values) -> None:
+        """Under HOROVOD_CHECK_DESYNC=1, verify the values about to be
+        committed are identical on every rank -- BEFORE they overwrite the
+        last good snapshot, so ``restore()`` still holds a converged copy
+        and the run loop recovers with restore + rank-0 ``sync()`` alone
+        (no re-rendezvous; :class:`~horovod_tpu.core.exceptions.DesyncError`
+        is the signal)."""
+        from ..core.desync import maybe_check
+        maybe_check(values, name="elastic_commit")
+
     def commit(self) -> None:
         raise NotImplementedError
 
@@ -70,6 +80,7 @@ class ObjectState(State):
         self.commit()
 
     def commit(self) -> None:
+        self._check_desync({k: getattr(self, k) for k in self._known})
         self._saved = {k: copy.deepcopy(getattr(self, k))
                        for k in self._known}
         self._check_host_updates()
@@ -111,6 +122,9 @@ class JaxState(State):
         self.commit()
 
     def commit(self) -> None:
+        self._check_desync({
+            "trees": {k: getattr(self, k) for k in self._tree_keys},
+            "scalars": {k: getattr(self, k) for k in self._scalar_keys}})
         # Host-RAM snapshot (device_get): survives device-state loss on
         # preemption/rescale, the whole point of elastic commit.
         self._saved_trees = {

@@ -1,0 +1,261 @@
+"""Stall inspector + desync checksum debug mode (SURVEY.md 3.1/5.2)."""
+
+import logging
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.core import desync as desync_mod
+from horovod_tpu.core import stall as stall_mod
+from horovod_tpu.core.exceptions import DesyncError
+from horovod_tpu.core.stall import (HeartbeatWriter, StallInspector,
+                                    heartbeat_age)
+
+
+# ---------------------------------------------------------------------------
+# StallInspector unit behavior.
+# ---------------------------------------------------------------------------
+
+def test_stall_inspector_warns_on_slow_op(caplog):
+    ins = StallInspector(warn_time_s=0.05, check_interval_s=0.02)
+    try:
+        with caplog.at_level(logging.WARNING, "horovod_tpu.stall"):
+            with ins.watch("allreduce.slow"):
+                time.sleep(0.15)
+                stalled = ins.check_now()
+        assert "allreduce.slow" in stalled
+        assert any("allreduce.slow" in r.message for r in caplog.records)
+    finally:
+        ins.stop()
+
+
+def test_stall_inspector_no_warning_for_fast_op(caplog):
+    ins = StallInspector(warn_time_s=10.0, check_interval_s=0.02)
+    try:
+        with caplog.at_level(logging.WARNING, "horovod_tpu.stall"):
+            with ins.watch("fast"):
+                pass
+            assert ins.check_now() == []
+        assert not caplog.records
+    finally:
+        ins.stop()
+
+
+def test_stall_inspector_shutdown_hook():
+    fired = []
+    ins = StallInspector(warn_time_s=0.01, shutdown_time_s=0.05,
+                         check_interval_s=0.01,
+                         on_shutdown=lambda names: fired.append(names))
+    try:
+        with ins.watch("doomed"):
+            time.sleep(0.1)
+            ins.check_now()
+        assert fired and fired[0] == ["doomed"]
+    finally:
+        ins.stop()
+
+
+def test_stall_inspector_configured_from_env(hvd):
+    # Default config: enabled at 60s.
+    assert stall_mod.inspector() is not None
+    assert stall_mod.inspector().warn_time_s == 60.0
+    hvd.shutdown()
+    assert stall_mod.inspector() is None
+    os.environ["HOROVOD_STALL_CHECK_DISABLE"] = "1"
+    try:
+        hvd.init()
+        assert stall_mod.inspector() is None
+    finally:
+        del os.environ["HOROVOD_STALL_CHECK_DISABLE"]
+
+
+def test_heartbeat_writer_and_age(tmp_path):
+    path = str(tmp_path / "hb_w0")
+    assert heartbeat_age(path) is None
+    hb = HeartbeatWriter(path, interval_s=0.05)
+    try:
+        time.sleep(0.1)
+        age = heartbeat_age(path)
+        assert age is not None and age < 5.0
+    finally:
+        hb.stop()
+
+
+# ---------------------------------------------------------------------------
+# Desync checksums.
+# ---------------------------------------------------------------------------
+
+def test_tree_checksums_stable_and_sensitive():
+    tree = {"a": np.arange(8, dtype=np.float32), "b": np.ones(3)}
+    paths, sums = desync_mod.tree_checksums(tree)
+    assert len(paths) == 2 and sums.shape == (2,)
+    _, sums2 = desync_mod.tree_checksums(tree)
+    np.testing.assert_array_equal(sums, sums2)
+    tree["a"] = tree["a"] + 1
+    _, sums3 = desync_mod.tree_checksums(tree)
+    assert sums3[0] != sums[0]
+
+
+def test_mismatched_rows_names_leaves():
+    paths = ["['a']", "['b']", "['c']"]
+    rows = np.array([[1, 2, 3], [1, 9, 3]])
+    assert desync_mod.mismatched_rows(rows, paths) == ["['b']"]
+    assert desync_mod.mismatched_rows(rows[:1], paths) == []
+
+
+def test_check_desync_clean_single_process(hvd):
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+    assert hvd.check_desync(params, name="params") == []
+
+
+def test_check_desync_raises_on_forced_mismatch(hvd, monkeypatch):
+    # Make rank rows disagree by corrupting the local checksum vector of
+    # one "rank" row before the allgather.
+    real_stack = hvd.replicated_stack
+
+    def skewed_stack(leaf, ps=None):
+        out = np.array(real_stack(leaf, ps))
+        out[-1, 0] ^= 0xDEAD
+        return out
+
+    monkeypatch.setattr("horovod_tpu.collectives.eager.replicated_stack",
+                        skewed_stack)
+    with pytest.raises(DesyncError, match="desync detected"):
+        hvd.check_desync({"w": jnp.ones(3)}, name="params")
+
+
+def test_maybe_check_gated_by_config(hvd, monkeypatch):
+    calls = []
+    monkeypatch.setattr(desync_mod, "check_desync",
+                        lambda *a, **k: calls.append(a) or [])
+    desync_mod.maybe_check({"w": np.ones(2)})
+    assert calls == []  # flag off by default
+    from horovod_tpu.core.state import global_state
+    import dataclasses
+    st = global_state()
+    st.config = dataclasses.replace(st.config, check_desync=True)
+    desync_mod.maybe_check({"w": np.ones(2)})
+    assert len(calls) == 1
+
+
+def test_in_step_desync_check(hvd):
+    from horovod_tpu.collectives import ops as cops
+    mesh = hvd.mesh()
+
+    def same_fn(x):
+        return cops.desync_check(x)[None]
+
+    def diff_fn(x):
+        skew = cops.axis_index().astype(jnp.float32)
+        return cops.desync_check(x[0] + skew)[None]
+
+    n = mesh.devices.size
+    x = jnp.ones((n, 4), jnp.float32)
+    spec = P(mesh.axis_names)
+    same = jax.jit(jax.shard_map(same_fn, mesh=mesh, in_specs=spec,
+                                 out_specs=spec))(x)
+    assert not bool(np.asarray(same).any())
+    diff = jax.jit(jax.shard_map(
+        lambda x: diff_fn(x), mesh=mesh, in_specs=spec,
+        out_specs=spec))(x)
+    assert bool(np.asarray(diff).all())
+
+
+def test_elastic_commit_desync_hook(hvd, monkeypatch):
+    import dataclasses
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.elastic.state import JaxState
+
+    st = global_state()
+    st.config = dataclasses.replace(st.config, check_desync=True)
+    checked = []
+    monkeypatch.setattr(desync_mod, "check_desync",
+                        lambda tree, **k: checked.append(tree) or [])
+    state = JaxState(params={"w": jnp.ones(2)}, batch=0)
+    state.commit()
+    assert len(checked) >= 1
+    # Live values (trees AND scalar counters) are what gets checked,
+    # before the snapshot is overwritten.
+    assert "params" in checked[-1]["trees"]
+    assert "batch" in checked[-1]["scalars"]
+
+
+def test_run_loop_recovers_from_desync():
+    """DesyncError at commit -> restore + re-sync, no re-rendezvous."""
+    from horovod_tpu.elastic.run_loop import run as elastic_run
+    from horovod_tpu.elastic.state import State
+
+    log = []
+
+    class FakeState(State):
+        def sync(self):
+            log.append("sync")
+
+        def restore(self):
+            log.append("restore")
+
+        def commit(self):
+            pass
+
+    calls = {"n": 0}
+
+    def train(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise DesyncError("diverged", leaves=["w"])
+        return "done"
+
+    assert elastic_run(train)(FakeState()) == "done"
+    assert log == ["sync", "restore", "sync"]
+
+
+def test_heartbeat_gate_pauses_beats(tmp_path):
+    path = str(tmp_path / "hb")
+    gate_open = [True]
+    hb = HeartbeatWriter(path, interval_s=0.03,
+                         gate=lambda: gate_open[0])
+    try:
+        time.sleep(0.1)
+        assert heartbeat_age(path) < 1.0
+        gate_open[0] = False
+        old = time.time() - 99
+        os.utime(path, (old, old))
+        time.sleep(0.12)
+        # Gate closed: the daemon thread must NOT refresh the mtime.
+        assert heartbeat_age(path) > 90
+    finally:
+        hb.stop()
+
+
+def test_driver_heartbeat_eviction(tmp_path):
+    """A stale worker heartbeat gets the worker terminated (then the normal
+    reap path blacklists it)."""
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.elastic.run_loop import heartbeat_path
+
+    class FakeProc:
+        terminated = False
+
+        def terminate(self):
+            self.terminated = True
+
+    drv = ElasticDriver(command=["true"], discovery_script="/bin/true",
+                        heartbeat_timeout_s=0.05)
+    drv.assignment_path = str(tmp_path / "assignment.json")
+    proc = FakeProc()
+    drv.workers = {"h:0": proc}
+    # No heartbeat file yet: grace (worker not in the run loop yet).
+    drv._check_heartbeats()
+    assert not proc.terminated
+    hb = heartbeat_path(drv.assignment_path, "h:0")
+    with open(hb, "w"):
+        pass
+    old = time.time() - 10
+    os.utime(hb, (old, old))
+    drv._check_heartbeats()
+    assert proc.terminated
