@@ -11,6 +11,12 @@ path (DistributedOptimizer fused allreduce, bf16 compute).
 driver recorded no reference numbers), so the first recorded run *is* the
 baseline.  A watchdog guards against the axon TPU tunnel wedging (observed:
 computations can hang indefinitely when the pooled chip's grant is lost).
+
+Timing note: on the axon-tunnelled TPU, ``jax.block_until_ready`` returns
+before the computation actually finishes (measured: it would imply 52 PFLOP/s
+on a 394 TFLOP/s chip).  The only reliable fence is a device->host value
+fetch, so the timed loop chains N steps and fetches the final scalar loss --
+loss_N depends on params_{N-1} and therefore on every prior step.
 """
 
 import json
@@ -63,17 +69,18 @@ def main():
     step = make_flax_train_step(model.apply, opt)
     batch = hvd.shard_batch((x, y))
 
-    # Warmup (compile + cache).
+    # Warmup (compile + cache).  float() is a device->host fetch -- the only
+    # fence that really waits on this platform (see module docstring).
     for _ in range(3):
         params, batch_stats, opt_state, loss = step(params, batch_stats,
                                                     opt_state, batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
         params, batch_stats, opt_state, loss = step(params, batch_stats,
                                                     opt_state, batch)
-    jax.block_until_ready(loss)
+    float(loss)  # forces the full step chain
     dt = time.perf_counter() - t0
 
     ips_per_chip = STEPS * global_batch / dt / n

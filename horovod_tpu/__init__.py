@@ -24,7 +24,7 @@ from .core.basics import (  # noqa: F401
 )
 from .core.exceptions import (  # noqa: F401
     HorovodTpuError, HorovodInternalError, HostsUpdatedInterrupt,
-    NotInitializedError, ProcessSetError,
+    DesyncError, NotInitializedError, ProcessSetError,
 )
 from .core.desync import check_desync  # noqa: F401
 from .core.process_sets import (  # noqa: F401

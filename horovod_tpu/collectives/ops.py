@@ -314,9 +314,19 @@ def desync_check(x, *, axes: Optional[AxisSpec] = None):
             x, jnp.dtype(f"int{nbits}")).astype(jnp.int32)
     else:
         bits = x.astype(jnp.int32)
-    # Wrapping int32 sum: exact (associative) regardless of reduction order,
-    # unlike a float checksum.
-    c = jnp.sum(bits) if bits.size else jnp.zeros((), jnp.int32)
+    # Wrapping uint32 sum of position-weighted words: exact (associative)
+    # regardless of reduction order, unlike a float checksum, and the
+    # per-position odd multiplier (Knuth hash constant; bijective mod 2^32)
+    # makes permutations of the same values visible -- a plain bit-sum
+    # would pass rank 0 holding [a, b] against rank 1 holding [b, a].
+    flat = bits.ravel()
+    if flat.size:
+        u = lax.bitcast_convert_type(flat, jnp.uint32)
+        w = (jnp.arange(flat.size, dtype=jnp.uint32)
+             * jnp.uint32(2654435761) + jnp.uint32(1))
+        c = jnp.sum(u * w, dtype=jnp.uint32)
+    else:
+        c = jnp.zeros((), jnp.uint32)
     hi, lo = c, c
     for a in axes:
         hi = lax.pmax(hi, a)

@@ -105,6 +105,13 @@ class Config:
 
     # Debug-mode desync checksums (no reference equivalent; SURVEY.md 5.2).
     check_desync: bool = False
+    # Consecutive restore+sync attempts before a persistent desync aborts.
+    desync_max_retries: int = 3
+
+    # Driver-side heartbeat eviction (seconds; 0 disables).  Workers whose
+    # elastic heartbeat file goes stale longer than this are terminated and
+    # blacklisted (HOROVOD_STALL_SHUTDOWN_TIME analogue at process level).
+    heartbeat_timeout: float = 0.0
 
     # Force the XLA:CPU backend before first device use (the launcher's
     # --cpu test mode; the Gloo-CPU-backend analogue).
@@ -142,5 +149,7 @@ def load_config() -> Config:
         coordinator_addr=addr,
         coordinator_port=port,
         check_desync=_env_bool("CHECK_DESYNC"),
+        desync_max_retries=_env_int("DESYNC_MAX_RETRIES", 3),
+        heartbeat_timeout=_env_float("HEARTBEAT_TIMEOUT", 0.0),
         force_cpu=_env_bool("FORCE_CPU"),
     )

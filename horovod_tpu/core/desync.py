@@ -14,7 +14,8 @@ Two entry points:
 
 * :func:`check_desync` -- host-level: CRC32 every leaf of a pytree,
   allgather the checksum vectors across the world, and raise
-  ``HorovodInternalError`` naming the leaves that differ.  Wired into
+  :class:`~horovod_tpu.DesyncError` (a ``HorovodInternalError`` subclass)
+  naming the leaves that differ.  Wired into
   ``hvd.elastic`` ``State.commit()`` when the debug flag is on (the commit
   boundary is exactly where a silent desync would get checkpointed).
 * :func:`horovod_tpu.collectives.ops.desync_check` -- in-step: an integer
@@ -23,6 +24,7 @@ Two entry points:
 
 from __future__ import annotations
 
+import pickle
 import zlib
 from typing import Any, List, Optional, Tuple
 
@@ -33,15 +35,27 @@ from .exceptions import DesyncError
 
 
 def _leaf_checksum(leaf) -> int:
-    """Stable CRC32 of a leaf's host bytes (uint32)."""
+    """Stable CRC32 of a leaf's host bytes (uint32).
+
+    Non-array leaves are checksummed via their pickle bytes, which (unlike
+    ``repr``) never embed per-process memory addresses.  Leaves that cannot
+    be pickled contribute only their type name -- such a leaf is
+    under-checked, never a false positive.  Caveat: containers whose
+    iteration order depends on the string hash seed (sets of strings) can
+    still pickle differently across processes; run workers with a fixed
+    ``PYTHONHASHSEED`` when such leaves are in elastic state.
+    """
     try:
         a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
         if a.dtype == object:
             raise TypeError
         return zlib.crc32(a.tobytes())
     except (TypeError, ValueError):
-        # Non-array leaves (strings, tuples of python scalars, ...).
-        return zlib.crc32(repr(leaf).encode())
+        pass
+    try:
+        return zlib.crc32(pickle.dumps(leaf, protocol=4))
+    except Exception:  # noqa: BLE001 - unpicklable leaf
+        return zlib.crc32(type(leaf).__qualname__.encode())
 
 
 def tree_checksums(tree: Any) -> Tuple[List[str], np.ndarray]:
@@ -66,7 +80,8 @@ def check_desync(tree: Any, name: str = "state", process_set=None,
 
     Each process CRC32s its host view of every leaf; the checksum vectors
     are allgathered and compared.  Returns the paths of mismatched leaves
-    (and raises ``HorovodInternalError`` unless ``raise_error=False``).
+    (and raises :class:`~horovod_tpu.DesyncError` unless
+    ``raise_error=False``).
 
     In single-process mode every rank shares one host copy, so this
     degenerates to a cheap no-op check -- the interesting case is the

@@ -34,13 +34,14 @@ class HostsUpdatedInterrupt(HorovodTpuError):
         self.skip_sync = skip_sync
 
 
-class DesyncError(HorovodTpuError):
+class DesyncError(HorovodInternalError):
     """Replica state diverged across ranks (debug-mode checksums).
 
     Raised by the ``HOROVOD_CHECK_DESYNC=1`` commit-boundary check *before*
-    the diverged values overwrite the last good snapshot.  The elastic run
-    loop recovers without a re-rendezvous: restore the last commit, then
-    ``sync()`` re-broadcasts rank 0's copy so replicas reconverge.
+    the diverged values overwrite the last good snapshot.  Subclasses
+    :class:`HorovodInternalError` so generic elastic handlers (restore from
+    last commit) catch it; the run loop special-cases it first to skip the
+    re-rendezvous (no membership change happened).
     """
 
     def __init__(self, message: str, leaves=None):

@@ -257,6 +257,14 @@ class HeartbeatWriter:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=self.interval_s + 1)
+        # Remove the file so the driver sees "no heartbeat yet" (which it
+        # grants grace) rather than a stale mtime it would treat as a dead
+        # worker -- a worker doing post-training work (checkpoint save,
+        # eval) after the elastic loop returns must not get evicted.
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
 
 
 def progress_gate() -> bool:

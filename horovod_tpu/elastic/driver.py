@@ -54,6 +54,11 @@ class ElasticDriver:
         self.epoch = -1
         self.blacklist: set = set()
         self.workers: Dict[str, TaggedProcess] = {}  # worker_id -> proc
+        # SIGTERM time per evicted worker, for SIGKILL escalation: a worker
+        # wedged in a blocking collective (the very case the stall-gated
+        # heartbeat detects) may never service SIGTERM.
+        self._terminated_at: Dict[str, float] = {}
+        self.term_grace_s = 15.0
         self._assignment_dir = tempfile.mkdtemp(prefix="hvd_tpu_elastic_")
         self.assignment_path = os.path.join(self._assignment_dir,
                                             "assignment.json")
@@ -96,6 +101,7 @@ class ElasticDriver:
                               local_rank=rank, local_size=size))
         env[ASSIGNMENT_ENV] = self.assignment_path
         env[WORKER_ID_ENV] = wid
+        self._terminated_at.pop(wid, None)
         if self.verbose:
             env["HOROVOD_LOG_LEVEL"] = "info"
         self.workers[wid] = TaggedProcess(rank, self.command, env,
@@ -108,7 +114,15 @@ class ElasticDriver:
         if self.heartbeat_timeout_s <= 0:
             return
         from ..core.stall import heartbeat_age, heartbeat_path
+        now = time.monotonic()
         for wid, proc in list(self.workers.items()):
+            terminated = self._terminated_at.get(wid)
+            if terminated is not None:
+                if now - terminated > self.term_grace_s:
+                    logger.warning("worker %s ignored SIGTERM for %.1fs; "
+                                   "killing", wid, now - terminated)
+                    proc.kill()
+                continue
             age = heartbeat_age(heartbeat_path(self.assignment_path, wid))
             if age is not None and age > self.heartbeat_timeout_s:
                 logger.warning(
@@ -116,6 +130,7 @@ class ElasticDriver:
                     "(> %.1fs); terminating", wid, age,
                     self.heartbeat_timeout_s)
                 proc.terminate()
+                self._terminated_at[wid] = now
 
     # -- main loop --------------------------------------------------------
     def run(self) -> int:
@@ -148,6 +163,7 @@ class ElasticDriver:
                     continue
                 proc.wait()
                 del self.workers[wid]
+                self._terminated_at.pop(wid, None)
                 (finished_ok if code == 0 else failed).append((wid, code))
             for wid, code in failed:
                 logger.warning("worker %s failed (exit %d); blacklisting",

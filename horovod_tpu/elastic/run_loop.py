@@ -124,8 +124,21 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
     return wrapper
 
 
+def _desync_max_retries() -> int:
+    """Config-time knob (HOROVOD_DESYNC_MAX_RETRIES), read at use time like
+    every other HOROVOD_* flag."""
+    from ..core.state import global_state
+    st = global_state()
+    if st.initialized and st.config is not None:
+        return st.config.desync_max_retries
+    from ..core.config import load_config
+    return load_config().desync_max_retries
+
+
 def _elastic_loop(func, state, notifier, args, kwargs):
     reset_required = False
+    desync_retries = 0
+    commit_baseline = None  # commit count right after the last sync()
     while True:
         if reset_required:
             _reinitialize(notifier)
@@ -135,6 +148,7 @@ def _elastic_loop(func, state, notifier, args, kwargs):
             # sync() ends in commit(), which may itself raise
             # HostsUpdatedInterrupt -- keep it inside the catch.
             state.sync()
+            commit_baseline = getattr(state, "_commit_count", 0)
             return func(state, *args, **kwargs)
         except HostsUpdatedInterrupt:
             logger.info("hosts updated; re-rendezvousing")
@@ -144,9 +158,28 @@ def _elastic_loop(func, state, notifier, args, kwargs):
             # checksum (the check runs BEFORE the snapshot is overwritten,
             # so the last commit is still converged).  No membership
             # change happened, so no re-rendezvous: restore and let the
-            # loop-top sync() rebroadcast rank 0's copy.
+            # loop-top sync() rebroadcast rank 0's copy.  A cause that
+            # survives restore+sync (non-deterministic pipeline, an
+            # unchecksummable leaf) would otherwise spin this loop
+            # forever, so cap CONSECUTIVE failures: a successful in-func
+            # commit since the last sync() (commit counter moved past the
+            # post-sync baseline) means the last recovery worked, and the
+            # count starts over.  sync()'s own commit is excluded -- it
+            # always succeeds after a broadcast and would otherwise make a
+            # persistent desync look like progress.
+            commits = getattr(state, "_commit_count", 0)
+            if commit_baseline is not None and commits > commit_baseline:
+                desync_retries = 0
+            commit_baseline = commits
+            desync_retries += 1
+            cap = _desync_max_retries()
+            if desync_retries > cap:
+                logger.error("replica desync persisted through %d "
+                             "restore+sync attempts; giving up", cap)
+                raise
             logger.warning("replica desync (%s); restoring last commit and "
-                           "re-syncing from rank 0", e)
+                           "re-syncing from rank 0 (attempt %d/%d)", e,
+                           desync_retries, cap)
             state.restore()
         except HorovodInternalError:
             logger.warning("collective failed; rolling back to last "

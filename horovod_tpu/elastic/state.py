@@ -26,6 +26,9 @@ class State:
 
     def __init__(self):
         self._reset_callbacks: List[Callable[[], None]] = []
+        # Successful-commit counter; the run loop uses it to distinguish a
+        # persistent desync from occasional recovered ones.
+        self._commit_count = 0
 
     def register_reset_callbacks(self, callbacks) -> None:
         self._reset_callbacks.extend(callbacks)
@@ -42,6 +45,7 @@ class State:
         advanced the membership epoch (reference: commit is the interrupt
         point).  The snapshot is taken before the check, so no progress is
         lost."""
+        self._commit_count += 1  # snapshot is already saved at this point
         from .run_loop import check_for_host_updates
         check_for_host_updates(self)
 

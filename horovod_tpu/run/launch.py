@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-discovery-script", default=None,
                    help="executable printing one host[:slots] per line; "
                         "enables elastic mode")
+    p.add_argument("--heartbeat-timeout", type=float, default=None,
+                   help="evict elastic workers whose heartbeat file goes "
+                        "stale for this many seconds (default: "
+                        "HOROVOD_HEARTBEAT_TIMEOUT env or disabled)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program and args to launch per worker")
     return p
@@ -114,7 +118,11 @@ def run_command(args: Optional[List[str]] = None) -> int:
 
     np_ = opts.num_proc
     if opts.host_discovery_script:
+        from ..core.config import load_config
         from ..elastic.driver import ElasticDriver
+        heartbeat = opts.heartbeat_timeout
+        if heartbeat is None:
+            heartbeat = load_config().heartbeat_timeout
         driver = ElasticDriver(
             command=cmd,
             discovery_script=opts.host_discovery_script,
@@ -123,6 +131,7 @@ def run_command(args: Optional[List[str]] = None) -> int:
             cpu=opts.cpu,
             slots=opts.slots,
             verbose=opts.verbose,
+            heartbeat_timeout_s=heartbeat,
         )
         return driver.run()
 

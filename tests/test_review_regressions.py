@@ -97,3 +97,59 @@ def test_autotuner_sweeps_and_locks_in(n_devices, tmp_path):
     assert tuner.fusion_threshold() in tuner.candidates
     assert log.exists() and "best" in log.read_text()
     hv.shutdown()
+
+
+class _Opaque:
+    """Unpicklable-by-value?  No -- picklable, but with a default repr that
+    embeds the memory address (the round-2 review's false-desync case)."""
+
+    def __init__(self, v):
+        self.v = v
+
+
+def test_leaf_checksum_ignores_memory_addresses():
+    from horovod_tpu.core.desync import _leaf_checksum
+    a, b = _Opaque(7), _Opaque(7)
+    assert repr(a) != repr(b)  # default repr embeds id()
+    assert _leaf_checksum(a) == _leaf_checksum(b)
+    assert _leaf_checksum(_Opaque(7)) != _leaf_checksum(_Opaque(8))
+
+
+def test_leaf_checksum_unpicklable_is_stable_not_false_positive():
+    from horovod_tpu.core.desync import _leaf_checksum
+    a = lambda: 1  # noqa: E731 - lambdas don't pickle
+    b = lambda: 2  # noqa: E731
+    assert _leaf_checksum(a) == _leaf_checksum(b)  # under-checked, stable
+
+
+def test_desync_error_is_internal_error_subclass():
+    assert issubclass(hv.DesyncError, hv.HorovodInternalError)
+
+
+def test_in_step_desync_check_sees_permutation(hvd, n_devices):
+    """A permuted replica must trip the probe (bit-sum alone would not)."""
+    from horovod_tpu.collectives import ops as cops
+    import jax
+
+    def f():
+        r = jax.lax.axis_index(hv.reduce_axes()[0])
+        # Same multiset of values everywhere, but rank 1 sees them swapped.
+        vals = jnp.where(r == 1, jnp.array([2.0, 1.0]), jnp.array([1.0, 2.0]))
+        return cops.desync_check(vals)[None]
+
+    from jax.sharding import PartitionSpec as P
+    mesh = hv.mesh()
+    g = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(),
+                              out_specs=P(mesh.axis_names[0])))
+    res = np.asarray(g())
+    if n_devices > 1:
+        assert bool(res.any())
+
+
+def test_heartbeat_stop_removes_file(tmp_path):
+    from horovod_tpu.core.stall import HeartbeatWriter
+    p = tmp_path / "hb_0"
+    w = HeartbeatWriter(str(p), interval_s=0.05)
+    assert p.exists()
+    w.stop()
+    assert not p.exists()
