@@ -50,8 +50,8 @@ from .optim.functions import (  # noqa: F401
 )
 from . import elastic  # noqa: F401
 from .training import (  # noqa: F401
-    make_train_step, make_eval_step, shard_batch, replicate,
-    batch_sharding, replicated_sharding, sync_batch_norm,
+    make_train_step, make_eval_step, shard_batch, shard_batch_from_local,
+    replicate, batch_sharding, replicated_sharding, sync_batch_norm,
 )
 
 __version__ = "0.1.0"

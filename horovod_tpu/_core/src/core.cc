@@ -233,9 +233,22 @@ class CycleScheduler {
     std::unique_lock<std::mutex> lk(m_);
     if (!running_) return;
     flush_ = true;
+    // Watermark: this flush covers only requests already enqueued.  The
+    // flag may be consumed by the cycle thread AFTER later requests
+    // arrive (a second Flush() returns immediately on an empty queue but
+    // leaves flush_ set); without the watermark that stale wakeup would
+    // sweep up the next step's partially-enqueued gradients and the
+    // fused bucket composition would diverge across SPMD processes.
+    flush_upto_ = next_id_ - 1;
     cv_.notify_all();
-    // Wait until the queue has been drained and dispatched.
-    drained_cv_.wait(lk, [this] { return queue_.empty() || !running_; });
+    // Wait until everything covered by this flush has been dispatched --
+    // including the callback having RUN (in_flight_), so callers can rely
+    // on "flush returned => batches delivered".
+    drained_cv_.wait(lk, [this] {
+      return !running_ ||
+             ((queue_.empty() || queue_.front().id > flush_upto_) &&
+              in_flight_ == 0);
+    });
   }
 
   int Pending() {
@@ -265,12 +278,29 @@ class CycleScheduler {
           continue;
         }
         flush_ = false;
-        batch.assign(queue_.begin(), queue_.end());
-        queue_.clear();
-        pending_bytes_ = 0;
+        if (deterministic_ && !stop_) {
+          // Deterministic mode: drain only up to the flush watermark
+          // (see Flush()); requests enqueued after it belong to the
+          // next synchronize and must not be swept into this batch.
+          while (!queue_.empty() && queue_.front().id <= flush_upto_) {
+            pending_bytes_ -= queue_.front().nbytes;
+            batch.push_back(queue_.front());
+            queue_.pop_front();
+          }
+        } else {
+          batch.assign(queue_.begin(), queue_.end());
+          queue_.clear();
+          pending_bytes_ = 0;
+        }
+        if (!batch.empty()) ++in_flight_;
         drained_cv_.notify_all();
       }
-      if (!batch.empty()) Dispatch(batch);
+      if (!batch.empty()) {
+        Dispatch(batch);
+        std::lock_guard<std::mutex> g(m_);
+        --in_flight_;
+        drained_cv_.notify_all();
+      }
       CheckStalls();
     }
   }
@@ -338,6 +368,8 @@ class CycleScheduler {
   double stall_warn_s_ = 60.0;
   double last_stall_warn_s_ = 0.0;
   int64_t next_id_ = 1;
+  int64_t flush_upto_ = -1;
+  int in_flight_ = 0;
   bool running_ = false, stop_ = false, flush_ = false;
   bool deterministic_ = false;
 };

@@ -121,7 +121,56 @@ def _run(kind: str, x, name: Optional[str], ps, per_rank_fn, op_label: str,
     else:
         fn = st.cache.get_or_build(key, build)
         out = fn(arr)
+    if _is_multiprocess(mesh):
+        # Serialize cross-process eager collectives.  Two hazards on the
+        # multi-process CPU (Gloo) backend, both observed as
+        # "op.preamble.length <= op.nbytes ... distributed collective
+        # mismatch" aborts:
+        #  1. separately-compiled programs reuse the same collective
+        #     channel tags, so two programs in flight at once interleave
+        #     their Gloo messages across processes;
+        #  2. consecutive executions of even the SAME program reuse slots,
+        #     and local completion on one rank does not imply the peer
+        #     drained its tail messages -- the next dispatch can race them.
+        # block_until_ready closes (1) locally; the coordination-service
+        # barrier (gRPC, independent of the Gloo transport) closes (2) by
+        # ensuring every participant fully finished before anyone starts
+        # the next collective.  In-step fused collectives (one program per
+        # step) are unaffected; single-process and TPU paths skip this.
+        jax.block_until_ready(out)
+        _coordination_fence(mesh)
     return out
+
+
+_fence_lock = threading.Lock()
+_fence_seq: Dict[tuple, int] = {}
+
+
+def reset_fences() -> None:
+    """Reset barrier sequence numbers.  Called by ``hvd.shutdown()``: after
+    an elastic re-init, a restarted worker starts counting from zero, so a
+    survivor carrying the old counts would wait at differently-named
+    barriers forever."""
+    with _fence_lock:
+        _fence_seq.clear()
+
+
+def _coordination_fence(mesh: Mesh) -> None:
+    """Cross-process happens-before via the JAX coordination service.
+
+    Every process whose devices appear in ``mesh`` joins a named barrier;
+    the name carries a per-participant-set sequence number, which matches
+    across processes because SPMD requires them to issue eager collectives
+    in the same order.
+    """
+    procs = tuple(sorted({d.process_index for d in mesh.devices.flat}))
+    with _fence_lock:
+        seq = _fence_seq[procs] = _fence_seq.get(procs, 0) + 1
+    client = getattr(jax._src.distributed.global_state, "client", None)
+    if client is None:  # pragma: no cover - not under jax.distributed
+        return
+    name = "hvd_eager_fence_" + "_".join(map(str, procs)) + f"_{seq}"
+    client.wait_at_barrier(name, 60_000, process_ids=list(procs))
 
 
 def local_result(out) -> np.ndarray:

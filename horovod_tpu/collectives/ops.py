@@ -322,8 +322,10 @@ def desync_check(x, *, axes: Optional[AxisSpec] = None):
     flat = bits.ravel()
     if flat.size:
         u = lax.bitcast_convert_type(flat, jnp.uint32)
+        # |1 keeps every weight ODD (hence invertible mod 2^32): i*K+1 is
+        # even at odd i, which would zero out top-bit-only differences.
         w = (jnp.arange(flat.size, dtype=jnp.uint32)
-             * jnp.uint32(2654435761) + jnp.uint32(1))
+             * jnp.uint32(2654435761)) | jnp.uint32(1)
         c = jnp.sum(u * w, dtype=jnp.uint32)
     else:
         c = jnp.zeros((), jnp.uint32)

@@ -150,6 +150,8 @@ def shutdown() -> None:
     import sys
     if "horovod_tpu.torch_api.batching" in sys.modules:
         sys.modules["horovod_tpu.torch_api.batching"].shutdown_batcher()
+    from ..collectives import eager as _eager
+    _eager.reset_fences()
     st = global_state()
     with st.lock:
         if not st.initialized:

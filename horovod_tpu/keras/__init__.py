@@ -18,7 +18,7 @@ from ..core.basics import (  # noqa: F401
 from ..collectives.reduce_op import Average, Sum  # noqa: F401
 from ..collectives.compression import Compression  # noqa: F401
 from ..tensorflow import (  # noqa: F401
-    DistributedOptimizer, allreduce, broadcast, broadcast_variables,
+    DistributedOptimizer, allreduce, barrier, broadcast, broadcast_variables,
 )
 
 

@@ -4,6 +4,8 @@ from .lenet import LeNet  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
 )
+from .inception import InceptionV3  # noqa: F401
+from .vgg import VGG, VGG16, VGG19  # noqa: F401
 from .transformer import (  # noqa: F401
     BERT_BASE, BERT_LARGE, BERT_TINY, Bert, BertConfig, LLAMA3_8B,
     LLAMA_TINY, LlamaConfig, LlamaLM, lora_mask, merge_lora,

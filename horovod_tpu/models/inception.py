@@ -1,0 +1,199 @@
+"""Inception V3 in flax.linen, bf16-first for the MXU.
+
+Benchmark workload parity: Inception V3 is one of the reference's three
+headline scaling workloads (~90% of linear at 128 accelerators --
+``README.rst`` perf chart / ``docs/benchmarks.rst`` via
+``tf_cnn_benchmarks``; SURVEY.md section 6).  Architecture follows the
+original (Szegedy et al. 2015, "Rethinking the Inception Architecture"):
+299x299 input, factorized 7x7 branches, grid reductions to 8x8x2048.
+
+TPU-first choices: NHWC layout, bfloat16 compute with float32
+parameters/statistics, BN after every conv (the "BasicConv2d" unit), and
+concatenations along the channel (lane) dimension, which XLA fuses into
+the surrounding convolutions' output writes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    """Conv + BN + ReLU (the Inception "BasicConv2d" unit)."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.conv(self.features, self.kernel, self.strides,
+                      padding=self.padding)(x)
+        x = self.norm()(x)
+        return nn.relu(x)
+
+
+def _pool(x, window, strides, kind="max", padding="SAME"):
+    if kind == "max":
+        return nn.max_pool(x, (window, window), (strides, strides), padding)
+    return nn.avg_pool(x, (window, window), (strides, strides), padding)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    cbn: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.cbn(64, (1, 1))(x)
+        b5 = self.cbn(48, (1, 1))(x)
+        b5 = self.cbn(64, (5, 5))(b5)
+        b3 = self.cbn(64, (1, 1))(x)
+        b3 = self.cbn(96, (3, 3))(b3)
+        b3 = self.cbn(96, (3, 3))(b3)
+        bp = _pool(x, 3, 1, "avg")
+        bp = self.cbn(self.pool_features, (1, 1))(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """35x35 -> 17x17 grid reduction."""
+
+    cbn: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.cbn(384, (3, 3), (2, 2), padding="VALID")(x)
+        bd = self.cbn(64, (1, 1))(x)
+        bd = self.cbn(96, (3, 3))(bd)
+        bd = self.cbn(96, (3, 3), (2, 2), padding="VALID")(bd)
+        bp = _pool(x, 3, 2, "max", padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches at 17x17."""
+
+    channels_7x7: int
+    cbn: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.channels_7x7
+        b1 = self.cbn(192, (1, 1))(x)
+        b7 = self.cbn(c7, (1, 1))(x)
+        b7 = self.cbn(c7, (1, 7))(b7)
+        b7 = self.cbn(192, (7, 1))(b7)
+        bd = self.cbn(c7, (1, 1))(x)
+        bd = self.cbn(c7, (7, 1))(bd)
+        bd = self.cbn(c7, (1, 7))(bd)
+        bd = self.cbn(c7, (7, 1))(bd)
+        bd = self.cbn(192, (1, 7))(bd)
+        bp = _pool(x, 3, 1, "avg")
+        bp = self.cbn(192, (1, 1))(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """17x17 -> 8x8 grid reduction."""
+
+    cbn: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.cbn(192, (1, 1))(x)
+        b3 = self.cbn(320, (3, 3), (2, 2), padding="VALID")(b3)
+        b7 = self.cbn(192, (1, 1))(x)
+        b7 = self.cbn(192, (1, 7))(b7)
+        b7 = self.cbn(192, (7, 1))(b7)
+        b7 = self.cbn(192, (3, 3), (2, 2), padding="VALID")(b7)
+        bp = _pool(x, 3, 2, "max", padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank blocks at 8x8 (output 2048 channels)."""
+
+    cbn: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.cbn(320, (1, 1))(x)
+        b3 = self.cbn(384, (1, 1))(x)
+        b3 = jnp.concatenate([self.cbn(384, (1, 3))(b3),
+                              self.cbn(384, (3, 1))(b3)], axis=-1)
+        bd = self.cbn(448, (1, 1))(x)
+        bd = self.cbn(384, (3, 3))(bd)
+        bd = jnp.concatenate([self.cbn(384, (1, 3))(bd),
+                              self.cbn(384, (3, 1))(bd)], axis=-1)
+        bp = _pool(x, 3, 1, "avg")
+        bp = self.cbn(192, (1, 1))(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception V3 classifier (299x299 NHWC input).
+
+    ``aux_logits=True`` adds the training-time auxiliary head on the
+    17x17 grid (returned as a second output during training).
+    """
+
+    num_classes: int = 1000
+    aux_logits: bool = False
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3, dtype=self.dtype)
+        cbn = partial(ConvBN, conv=conv, norm=norm)
+
+        x = x.astype(self.dtype)
+        # Stem: 299 -> 35x35x192.
+        x = cbn(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = cbn(32, (3, 3), padding="VALID")(x)
+        x = cbn(64, (3, 3))(x)
+        x = _pool(x, 3, 2, "max", padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x)
+        x = cbn(192, (3, 3), padding="VALID")(x)
+        x = _pool(x, 3, 2, "max", padding="VALID")
+        # 35x35 Inception-A stack -> 288 channels.
+        x = InceptionA(32, cbn)(x)
+        x = InceptionA(64, cbn)(x)
+        x = InceptionA(64, cbn)(x)
+        # Reduce to 17x17x768; Inception-C stack.
+        x = InceptionB(cbn)(x)
+        x = InceptionC(128, cbn)(x)
+        x = InceptionC(160, cbn)(x)
+        x = InceptionC(160, cbn)(x)
+        x = InceptionC(192, cbn)(x)
+        aux = None
+        if self.aux_logits and train:
+            a = _pool(x, 5, 3, "avg", padding="VALID")
+            a = cbn(128, (1, 1))(a)
+            a = cbn(768, a.shape[1:3], padding="VALID")(a)
+            a = a.reshape((a.shape[0], -1))
+            aux = nn.Dense(self.num_classes, dtype=self.dtype,
+                           name="aux_head")(a).astype(jnp.float32)
+        # Reduce to 8x8; Inception-E stack -> 2048 channels.
+        x = InceptionD(cbn)(x)
+        x = InceptionE(cbn)(x)
+        x = InceptionE(cbn)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        x = x.astype(jnp.float32)
+        if self.aux_logits and train:
+            return x, aux
+        return x

@@ -1,0 +1,67 @@
+"""VGG-16/19 in flax.linen, bf16-first.
+
+Benchmark workload parity: VGG-16 is the reference's *comm-bound*
+headline workload (~68% of linear at 128 accelerators, and the one where
+RDMA vs TCP mattered -- ``docs/benchmarks.rst``, SURVEY.md section 6).
+Its ~134M parameters (102M of them in the first FC layer) make the
+gradient allreduce the bottleneck, which is exactly what it stresses in
+this framework too: one fused bucket sweep moves >500 MB of fp32
+gradients per step through the collective layer.
+
+Classic configuration (Simonyan & Zisserman 2014): no batch norm
+(``batch_norm=True`` opts into the modern variant), 224x224 NHWC input,
+two 4096-wide FC layers -- kept as-is because those giant Dense layers
+land on the MXU as single large matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Channel plan per conv stage; "M" = 2x2 max-pool.
+_CFG = {
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    batch_norm: bool = False
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for item in _CFG[self.depth]:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), (2, 2))
+                continue
+            x = nn.Conv(item, (3, 3), dtype=self.dtype,
+                        use_bias=not self.batch_norm)(x)
+            if self.batch_norm:
+                x = nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        for _ in range(2):
+            x = nn.Dense(4096, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def VGG16(**kw) -> VGG:
+    return VGG(depth=16, **kw)
+
+
+def VGG19(**kw) -> VGG:
+    return VGG(depth=19, **kw)

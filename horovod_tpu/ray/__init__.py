@@ -103,7 +103,12 @@ class RayExecutor:
                             for a in self._actors])
         return self._run_local(fn, args, kwargs, envs)
 
-    def _run_local(self, fn, args, kwargs, envs) -> List[Any]:
+    def _run_local(self, fn, args, kwargs, envs,
+                   timeout_s: float = 600.0,
+                   failure_grace_s: float = 15.0) -> List[Any]:
+        import queue as _queue
+        import time
+
         ctx = mp.get_context("spawn")
         q = ctx.Queue()
         procs = [ctx.Process(target=_local_worker_main,
@@ -112,17 +117,54 @@ class RayExecutor:
         for p in procs:
             p.start()
         results: dict = {}
+        failures: dict = {}
+        remaining = set(range(len(procs)))
+        deadline = time.monotonic() + timeout_s
+        term_deadline = None  # set on first failure: grace for peers'
+        # secondary errors to surface, then stragglers are cut loose
         try:
-            for _ in procs:
-                rank, ok, value = q.get(timeout=600)
-                if not ok:
-                    raise RuntimeError(f"worker {rank} failed: {value}")
-                results[rank] = value
+            while remaining:
+                now = time.monotonic()
+                if now > deadline:
+                    for r in sorted(remaining):
+                        procs[r].terminate()
+                        failures[r] = f"no result within {timeout_s}s"
+                    break
+                if term_deadline is not None and now > term_deadline:
+                    for r in sorted(remaining):
+                        procs[r].terminate()
+                        failures[r] = ("terminated: still running "
+                                       f"{failure_grace_s}s after a peer "
+                                       "failed (likely blocked in a "
+                                       "collective with the dead peer)")
+                    break
+                try:
+                    rank, ok, value = q.get(timeout=1.0)
+                    (results if ok else failures)[rank] = value
+                    remaining.discard(rank)
+                except _queue.Empty:
+                    # Reap workers that died without reporting (segfault,
+                    # os._exit); give one poll cycle for in-flight messages.
+                    for r in sorted(remaining):
+                        p = procs[r]
+                        if not p.is_alive() and p.exitcode is not None \
+                                and q.empty():
+                            failures[r] = (f"exited with code {p.exitcode} "
+                                           "without reporting")
+                            remaining.discard(r)
+                if failures and term_deadline is None:
+                    term_deadline = time.monotonic() + failure_grace_s
         finally:
             for p in procs:
                 p.join(timeout=30)
                 if p.is_alive():
                     p.terminate()
+        if failures:
+            # Report every failure: the FIRST message received is often a
+            # secondary "peer died" error, not the root cause.
+            detail = "; ".join(f"worker {r}: {failures[r]}"
+                               for r in sorted(failures))
+            raise RuntimeError(f"worker(s) failed: {detail}")
         return [results[i] for i in range(self.num_workers)]
 
     def shutdown(self) -> None:

@@ -20,6 +20,10 @@ import socket
 from typing import Any, Callable, List, Optional
 
 from .store import LocalStore, Store  # noqa: F401
+from .estimator import (  # noqa: F401
+    EstimatorParams, JaxEstimator, JaxModel, KerasEstimator, KerasModel,
+    TorchEstimator, TorchModel,
+)
 
 
 def _require_pyspark():

@@ -38,6 +38,35 @@ def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def shard_batch_from_local(local_batch: Any,
+                           mesh: Optional[Mesh] = None) -> Any:
+    """Assemble the global batch from each process's local rows.
+
+    The reference's data model: every rank loads its own shard (Petastorm
+    per-rank readers, ``ElasticSampler``).  Each process passes the rows it
+    owns; the global array is stitched with
+    ``jax.make_array_from_process_local_data``.  Single-process, this is
+    :func:`shard_batch`.
+    """
+    import numpy as np
+
+    mesh = mesh or _basics.mesh()
+    mesh_procs = {d.process_index for d in mesh.devices.flat}
+    if len(mesh_procs) == 1:
+        return shard_batch(local_batch, mesh)
+    sharding = batch_sharding(mesh)
+
+    def put(x):
+        x = np.asarray(x)
+        # Multiply by the processes IN THIS MESH (a process-set sub-mesh
+        # may span fewer than jax.process_count()).
+        global_shape = (x.shape[0] * len(mesh_procs),) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, x,
+                                                      global_shape)
+
+    return jax.tree.map(put, local_batch)
+
+
 def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     mesh = mesh or _basics.mesh()
     return NamedSharding(mesh, P())

@@ -65,3 +65,63 @@ def test_flax_step_on_hierarchical_mesh(n_devices):
     p2, s2, o2, loss = step(params, stats, opt_state, hv.shard_batch((x, y)))
     assert np.isfinite(float(loss))
     hv.shutdown()
+
+
+def test_inception_v3_forward(hvd):
+    from horovod_tpu.models import InceptionV3
+    model = InceptionV3(num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 75, 75, 3))
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(v, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    # 2048-channel final feature map is the V3 signature.
+    assert v["params"]["Dense_0"]["kernel"].shape[0] == 2048
+
+
+def test_inception_v3_aux_head_trains(hvd):
+    from horovod_tpu.models import InceptionV3
+    model = InceptionV3(num_classes=5, aux_logits=True, dtype=jnp.float32)
+    x = jnp.ones((1, 139, 139, 3))
+    v = model.init({"params": jax.random.PRNGKey(0),
+                    "dropout": jax.random.PRNGKey(1)}, x, train=True)
+    logits, aux = model.apply(v, x, train=True,
+                              rngs={"dropout": jax.random.PRNGKey(2)},
+                              mutable=["batch_stats"])[0]
+    assert logits.shape == (1, 5) and aux.shape == (1, 5)
+
+
+def test_vgg16_forward_and_param_shape(hvd):
+    from horovod_tpu.models import VGG16
+    model = VGG16(num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3))
+    v = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    out = model.apply(v, x, train=False)
+    assert out.shape == (2, 10)
+    # 13 convs + 3 dense = VGG-16's 16 weight layers.
+    convs = [k for k in v["params"] if k.startswith("Conv")]
+    denses = [k for k in v["params"] if k.startswith("Dense")]
+    assert len(convs) == 13 and len(denses) == 3
+
+
+def test_vgg_bn_variant_trains(hvd, n_devices):
+    from horovod_tpu.models import VGG
+    model = VGG(depth=16, num_classes=4, batch_norm=True, dropout_rate=0.0,
+                dtype=jnp.float32)
+    n = n_devices
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, 32, 32, 3), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 4, n), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), x[:1], train=True)
+    params, stats = v["params"], v["batch_stats"]
+    opt = hv.DistributedOptimizer(optax.sgd(0.01))
+    params, stats = hv.replicate(params), hv.replicate(stats)
+    opt_state = hv.replicate(opt.init(params))
+    step = make_flax_train_step(model.apply, opt)
+    batch = hv.shard_batch((x, y))
+    losses = []
+    for _ in range(4):
+        params, stats, opt_state, loss = step(params, stats, opt_state,
+                                              batch)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()

@@ -214,3 +214,55 @@ def test_timeline_writes_valid_chrome_trace(tmp_path):
     assert len(events) == 3
     assert events[2]["ph"] == "X" and events[2]["dur"] == 230.0
     assert events[0]["name"] == "allreduce.grad0"
+
+
+def test_deterministic_flush_watermark_excludes_late_enqueues():
+    """A stale flush flag must not sweep up requests enqueued after the
+    flush call (SPMD bucket divergence regression: rank A's late flush
+    wakeup grabbed 3 of the next step's 4 gradients and cut a different
+    fused bucket than rank B)."""
+    import time
+    batches = []
+
+    def on_batch(payloads):
+        batches.append(list(payloads))
+
+    sched = _core.NativeScheduler(on_batch, cycle_ms=1.0,
+                                  deterministic=True)
+    try:
+        sched.enqueue("a", name="g.a", dtype_code=0, nbytes=8)
+        sched.enqueue("b", name="g.b", dtype_code=0, nbytes=8)
+        sched.flush()
+        assert [sorted(b) for b in batches] == [["a", "b"]]
+        # Enqueue after the flush: cycle ticks alone must NOT dispatch it
+        # in deterministic mode, even though flush flags were just set.
+        sched.enqueue("c", name="g.c", dtype_code=0, nbytes=8)
+        time.sleep(0.05)  # many cycle ticks
+        assert len(batches) == 1
+        assert sched.pending() == 1
+        sched.flush()
+        assert [sorted(b) for b in batches] == [["a", "b"], ["c"]]
+    finally:
+        sched.stop()
+
+
+def test_deterministic_rapid_flush_then_enqueue_race():
+    """Tight loop of (enqueue x4, flush) must always cut 4-element batches
+    -- the exact pattern of per-step gradient sync."""
+    batches = []
+
+    def on_batch(payloads):
+        batches.append(list(payloads))
+
+    sched = _core.NativeScheduler(on_batch, cycle_ms=0.1,
+                                  deterministic=True)
+    try:
+        for step in range(200):
+            for j in range(4):
+                sched.enqueue(f"{step}/{j}", name=f"g.{j}", dtype_code=0,
+                              nbytes=8)
+            sched.flush()
+    finally:
+        sched.stop()
+    assert len(batches) == 200
+    assert all(len(b) == 4 for b in batches)

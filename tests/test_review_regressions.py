@@ -153,3 +153,33 @@ def test_heartbeat_stop_removes_file(tmp_path):
     assert p.exists()
     w.stop()
     assert not p.exists()
+
+
+def test_in_step_desync_check_sees_sign_flip_at_odd_index(hvd, n_devices):
+    """Top-bit-only difference at an odd flat index must trip the probe
+    (an even weight there would cancel it mod 2^32)."""
+    from horovod_tpu.collectives import ops as cops
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f():
+        r = jax.lax.axis_index(hv.reduce_axes()[0])
+        vals = jnp.where(r == 1, jnp.array([1.0, -2.0]),
+                         jnp.array([1.0, 2.0]))
+        return cops.desync_check(vals)[None]
+
+    mesh = hv.mesh()
+    g = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(),
+                              out_specs=P(mesh.axis_names[0])))
+    res = np.asarray(g())
+    if n_devices > 1:
+        assert bool(res.any())
+
+
+def test_fence_seq_resets_on_shutdown():
+    from horovod_tpu.collectives import eager
+    with eager._fence_lock:
+        eager._fence_seq[(0, 1)] = 41
+    hv.shutdown()
+    assert eager._fence_seq == {}
+    hv.init()
