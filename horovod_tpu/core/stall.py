@@ -245,10 +245,13 @@ class HeartbeatWriter:
             except Exception:  # pragma: no cover - gate must never kill us
                 logger.exception("heartbeat gate failed")
         try:
-            with open(self.path, "a"):
-                os.utime(self.path, None)
+            self._do_beat()
         except OSError:  # pragma: no cover - dir vanished mid-teardown
             pass
+
+    def _do_beat(self) -> None:
+        with open(self.path, "a"):
+            os.utime(self.path, None)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -257,6 +260,9 @@ class HeartbeatWriter:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=self.interval_s + 1)
+        self._cleanup()
+
+    def _cleanup(self) -> None:
         # Remove the file so the driver sees "no heartbeat yet" (which it
         # grants grace) rather than a stale mtime it would treat as a dead
         # worker -- a worker doing post-training work (checkpoint save,
@@ -264,6 +270,35 @@ class HeartbeatWriter:
         try:
             os.unlink(self.path)
         except OSError:
+            pass
+
+
+class KVHeartbeatWriter(HeartbeatWriter):
+    """Heartbeats over the HTTP KV rendezvous (multi-host: no shared FS).
+
+    Publishes a wall-clock timestamp under ``hb/<worker_id>``; the driver
+    compares against its own clock (same-pod VMs are NTP-synced; the
+    heartbeat timeout is seconds, not milliseconds).
+    """
+
+    def __init__(self, url: str, worker_id: str, secret_key: str,
+                 interval_s: float = 1.0,
+                 gate: Optional[Callable[[], bool]] = None):
+        from ..run.http_kv import KVClient
+        self._kv = KVClient.from_url(url, secret_key, timeout_s=5.0)
+        self.worker_id = worker_id
+        super().__init__(path=url, interval_s=interval_s, gate=gate)
+
+    def _do_beat(self) -> None:
+        try:
+            self._kv.put("hb", self.worker_id, repr(time.time()).encode())
+        except (ConnectionError, OSError):  # driver gone/restarting
+            pass
+
+    def _cleanup(self) -> None:
+        try:
+            self._kv.delete("hb", self.worker_id)
+        except (ConnectionError, OSError):
             pass
 
 

@@ -41,23 +41,8 @@ class HostDiscoveryScript:
         return hosts
 
     def _parse_line(self, line: str):
-        # Accepted forms: "host", "host:slots", "[ipv6]", "[ipv6]:slots".
-        # A bare IPv6 address ("::1") is a host with default slots; only a
-        # single-colon "host:int" (or bracketed form) carries a slot count.
-        if line.startswith("["):
-            addr, _, rest = line.partition("]")
-            host = addr[1:] or line
-            if rest.startswith(":"):
-                try:
-                    return host, int(rest[1:])
-                except ValueError:
-                    pass
-            return host, self.default_slots
-        if line.count(":") == 1:
-            host, _, slots = line.partition(":")
-            if host:
-                try:
-                    return host, int(slots)
-                except ValueError:
-                    pass
-        return line, self.default_slots
+        # One canonical host[:slots] splitter (IPv6-aware), shared with
+        # the launcher's -H/--hostfile parsing; lenient mode because a
+        # discovery script's transient garbage must not kill the driver.
+        from ..run.hosts import split_host_slots
+        return split_host_slots(line, self.default_slots, strict=False)

@@ -36,7 +36,8 @@ class ElasticDriver:
                  cpu: bool = False, slots: int = 1, verbose: int = 0,
                  poll_interval_s: float = 1.0,
                  elastic_timeout_s: float = 600.0,
-                 heartbeat_timeout_s: float = 0.0):
+                 heartbeat_timeout_s: float = 0.0,
+                 rendezvous: bool = False):
         self.command = list(command)
         self.discovery = HostDiscoveryScript(discovery_script,
                                              default_slots=slots)
@@ -63,6 +64,18 @@ class ElasticDriver:
         self.assignment_path = os.path.join(self._assignment_dir,
                                             "assignment.json")
         self._lock = threading.Lock()
+        # Network rendezvous (multi-host, no shared FS): serve the
+        # assignment doc + worker heartbeats over the HMAC-signed HTTP KV
+        # store instead of the assignment file.
+        self._rdv = None
+        self._kv = None
+        self._secret = None
+        if rendezvous:
+            from ..run.http_kv import KVClient, RendezvousServer
+            from ..run.secret import make_secret_key
+            self._secret = make_secret_key()
+            self._rdv = RendezvousServer(self._secret)
+            self._kv = KVClient("127.0.0.1", self._rdv.port, self._secret)
 
     # -- membership -------------------------------------------------------
     def _desired_workers(self) -> List[str]:
@@ -82,6 +95,12 @@ class ElasticDriver:
         ranks = {wid: i for i, wid in enumerate(sorted(worker_ids))}
         write_assignment(self.assignment_path, self.epoch,
                          len(worker_ids), port, ranks)
+        if self._kv is not None:
+            import json
+            from .notify import ASSIGNMENT_KEY
+            doc = {"epoch": self.epoch, "size": len(worker_ids),
+                   "port": port, "ranks": ranks}
+            self._kv.put(*ASSIGNMENT_KEY, json.dumps(doc).encode())
         logger.info("elastic epoch %d: %d worker(s), port %d",
                     self.epoch, len(worker_ids), port)
         return ranks
@@ -99,7 +118,16 @@ class ElasticDriver:
         env.update(worker_env(rank=rank, size=size, coordinator="127.0.0.1",
                               port=port, cpu=self.cpu, slots=1,
                               local_rank=rank, local_size=size))
-        env[ASSIGNMENT_ENV] = self.assignment_path
+        if self._rdv is not None:
+            from ..run.secret import SECRET_ENV
+            env[ASSIGNMENT_ENV] = f"http://127.0.0.1:{self._rdv.port}"
+            env[SECRET_ENV] = self._secret
+            try:
+                self._kv.delete("hb", wid)
+            except ConnectionError:  # pragma: no cover
+                pass
+        else:
+            env[ASSIGNMENT_ENV] = self.assignment_path
         env[WORKER_ID_ENV] = wid
         self._terminated_at.pop(wid, None)
         if self.verbose:
@@ -123,7 +151,8 @@ class ElasticDriver:
                                    "killing", wid, now - terminated)
                     proc.kill()
                 continue
-            age = heartbeat_age(heartbeat_path(self.assignment_path, wid))
+            age = self._kv_heartbeat_age(wid) if self._kv is not None else \
+                heartbeat_age(heartbeat_path(self.assignment_path, wid))
             if age is not None and age > self.heartbeat_timeout_s:
                 logger.warning(
                     "worker %s heartbeat stale for %.1fs "
@@ -132,8 +161,29 @@ class ElasticDriver:
                 proc.terminate()
                 self._terminated_at[wid] = now
 
+    def _kv_heartbeat_age(self, wid: str) -> Optional[float]:
+        """Age of a worker's KV heartbeat (None: no beat yet)."""
+        import time as _time
+        try:
+            raw = self._kv.get("hb", wid)
+        except ConnectionError:  # pragma: no cover - own server gone
+            return None
+        if raw is None:
+            return None
+        try:
+            return max(0.0, _time.time() - float(raw))
+        except ValueError:
+            return None
+
     # -- main loop --------------------------------------------------------
     def run(self) -> int:
+        try:
+            return self._run()
+        finally:
+            if self._rdv is not None:
+                self._rdv.stop()
+
+    def _run(self) -> int:
         deadline = time.monotonic() + self.elastic_timeout_s
         desired: List[str] = []
         while len(desired) < self.min_np:

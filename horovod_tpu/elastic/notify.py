@@ -4,13 +4,18 @@ Reference: ``horovod/runner/elastic/worker.py`` (WorkerNotificationService:
 the driver pushes a HostsUpdated ping over HTTP; workers raise
 ``HostsUpdatedInterrupt`` at the next commit boundary).
 
-This runtime uses an *assignment file* per job: the driver atomically
-rewrites a JSON document ``{"epoch": N, "size": S, "port": P,
+This runtime publishes an *assignment document* per job: the driver
+atomically rewrites a JSON document ``{"epoch": N, "size": S, "port": P,
 "ranks": {worker_id: rank}}`` whenever membership changes; workers poll
-its epoch (cheap stat+read) inside ``state.commit()``/the run loop.  A
-file works both for localhost tests and for TPU pod slices with a shared
-staging volume; a TCP push channel can replace it without touching the
-worker-side API.
+its epoch inside ``state.commit()``/the run loop.  Two transports behind
+one worker-side API:
+
+* **file** (localhost tests; pod slices with a shared staging volume):
+  atomic rewrite + cheap stat/read polling.
+* **HTTP KV** (multi-host without shared storage): ``ASSIGNMENT_ENV`` set
+  to ``http://driver:port`` points workers at the launcher's HMAC-signed
+  :class:`~horovod_tpu.run.http_kv.RendezvousServer` (reference: the Gloo
+  rendezvous + elastic registration HTTP server).
 """
 
 from __future__ import annotations
@@ -22,6 +27,7 @@ from typing import Dict, Optional
 
 ASSIGNMENT_ENV = "HVD_TPU_ELASTIC_ASSIGNMENT"
 WORKER_ID_ENV = "HVD_TPU_ELASTIC_WORKER_ID"
+ASSIGNMENT_KEY = ("elastic", "assignment")
 
 
 def write_assignment(path: str, epoch: int, size: int, port: int,
@@ -42,10 +48,33 @@ def write_assignment(path: str, epoch: int, size: int, port: int,
 
 
 def read_assignment(path: str) -> Optional[dict]:
+    if path.startswith("http://"):
+        return _read_assignment_http(path)
     try:
         with open(path) as f:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _read_assignment_http(url: str) -> Optional[dict]:
+    from ..run.http_kv import KVClient
+    from ..run.secret import SECRET_ENV
+
+    secret = os.environ.get(SECRET_ENV)
+    if not secret:
+        raise RuntimeError(
+            f"{ASSIGNMENT_ENV} is an http:// rendezvous but {SECRET_ENV} "
+            "is unset; the launcher must export the per-job secret")
+    try:
+        raw = KVClient.from_url(url, secret).get(*ASSIGNMENT_KEY)
+    except (ConnectionError, OSError):
+        return None
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
         return None
 
 

@@ -111,10 +111,17 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
             # analogue at the process level).  Beats are gated on the stall
             # inspector: a worker wedged in a blocking collective stops
             # beating, so the driver's heartbeat timeout can evict it.
-            from ..core.stall import HeartbeatWriter, progress_gate
-            heartbeat = HeartbeatWriter(
-                heartbeat_path(notifier.path, notifier.worker_id),
-                gate=progress_gate)
+            from ..core.stall import (HeartbeatWriter, KVHeartbeatWriter,
+                                      progress_gate)
+            if notifier.path.startswith("http://"):
+                from ..run.secret import SECRET_ENV
+                heartbeat = KVHeartbeatWriter(
+                    notifier.path, notifier.worker_id,
+                    os.environ.get(SECRET_ENV, ""), gate=progress_gate)
+            else:
+                heartbeat = HeartbeatWriter(
+                    heartbeat_path(notifier.path, notifier.worker_id),
+                    gate=progress_gate)
         try:
             return _elastic_loop(func, state, notifier, args, kwargs)
         finally:

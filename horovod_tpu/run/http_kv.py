@@ -1,0 +1,171 @@
+"""HMAC-signed HTTP KV store: the rendezvous plane.
+
+Reference: ``horovod/runner/http/http_server.py`` (``RendezvousServer``, a
+threaded HTTP KV store used by Gloo rendezvous and elastic worker
+registration) + ``http_client.py``.  TPU-native role: the launcher/elastic
+driver publishes the membership document (epoch, coordinator port, rank
+assignment) under a key; workers on other VMs poll it over HTTP instead of
+a shared-filesystem assignment file.  Every request is HMAC-signed with
+the per-job secret (``run/secret.py``); unsigned or mis-signed requests
+get 403.
+
+Wire format: ``PUT/GET/DELETE /kv/<scope>/<key>``; the ``X-Hvd-Sig``
+header signs ``method\\npath\\ntimestamp\\nbody`` and the ``X-Hvd-Ts``
+timestamp must be within ``MAX_SKEW_S`` of the server clock, bounding the
+replay window.  Auth failures raise :class:`RendezvousAuthError` (NOT a
+``ConnectionError``): a wrong per-job secret is a configuration bug that
+must surface loudly, while connection errors mean the driver is
+down/restarting and are retried by callers.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.request import Request, urlopen
+from urllib.error import HTTPError
+
+from .secret import check_digest, compute_digest
+
+SIG_HEADER = "X-Hvd-Sig"
+TS_HEADER = "X-Hvd-Ts"
+MAX_SKEW_S = 60.0
+
+
+class RendezvousAuthError(RuntimeError):
+    """Signature rejected (wrong or missing per-job secret)."""
+
+
+def _signable(method: str, path: str, ts: str, body: bytes) -> bytes:
+    return (method.encode() + b"\n" + path.encode() + b"\n" + ts.encode()
+            + b"\n" + body)
+
+
+class RendezvousServer:
+    """Threaded KV store over HTTP; values are opaque bytes."""
+
+    def __init__(self, secret_key: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        # Default loopback: the local driver hands workers 127.0.0.1.
+        # Multi-host deployments pass host="0.0.0.0" explicitly.
+        self._store: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        store, lock, secret = self._store, self._lock, secret_key
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _verify(self, body: bytes) -> bool:
+                import time
+                sig = self.headers.get(SIG_HEADER, "")
+                ts = self.headers.get(TS_HEADER, "")
+                try:
+                    skew = abs(time.time() - float(ts))
+                except ValueError:
+                    return False
+                if skew > MAX_SKEW_S:
+                    return False
+                return check_digest(
+                    secret,
+                    _signable(self.command, self.path, ts, body), sig)
+
+            def _reply(self, code: int, body: bytes = b"") -> None:
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if not self._verify(b""):
+                    return self._reply(403)
+                with lock:
+                    val = store.get(self.path)
+                self._reply(200, val) if val is not None else self._reply(404)
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if not self._verify(body):
+                    return self._reply(403)
+                with lock:
+                    store[self.path] = body
+                self._reply(200)
+
+            def do_DELETE(self):
+                if not self._verify(b""):
+                    return self._reply(403)
+                with lock:
+                    store.pop(self.path, None)
+                self._reply(200)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="hvd-tpu-rendezvous")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+class KVClient:
+    """Signing client for :class:`RendezvousServer`."""
+
+    def __init__(self, addr: str, port: int, secret_key: str,
+                 timeout_s: float = 10.0):
+        self.base = f"http://{addr}:{port}"
+        self.secret_key = secret_key
+        self.timeout_s = timeout_s
+
+    @classmethod
+    def from_url(cls, url: str, secret_key: str,
+                 timeout_s: float = 10.0) -> "KVClient":
+        """``http://host:port`` -> client."""
+        hostport = url.split("//", 1)[1].rstrip("/")
+        host, _, port = hostport.rpartition(":")
+        return cls(host, int(port), secret_key, timeout_s)
+
+    def _request(self, method: str, path: str,
+                 body: bytes = b"") -> Tuple[int, bytes]:
+        import time
+        ts = repr(time.time())
+        sig = compute_digest(self.secret_key,
+                             _signable(method, path, ts, body))
+        req = Request(self.base + path, data=body if method == "PUT" else
+                      None, method=method,
+                      headers={SIG_HEADER: sig, TS_HEADER: ts})
+        try:
+            with urlopen(req, timeout=self.timeout_s) as resp:
+                return resp.status, resp.read()
+        except HTTPError as e:
+            return e.code, b""
+
+    def _check(self, op: str, code: int) -> None:
+        if code == 403:
+            raise RendezvousAuthError(
+                f"rendezvous {op} rejected (403): per-job secret mismatch "
+                f"or >={MAX_SKEW_S:.0f}s clock skew -- check "
+                "HVD_TPU_SECRET_KEY and NTP on every host")
+        if code != 200:
+            raise ConnectionError(f"rendezvous {op} -> HTTP {code}")
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        code, _ = self._request("PUT", f"/kv/{scope}/{key}", value)
+        self._check(f"PUT {scope}/{key}", code)
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        code, body = self._request("GET", f"/kv/{scope}/{key}")
+        if code == 200:
+            return body
+        if code == 404:
+            return None
+        self._check(f"GET {scope}/{key}", code)
+
+    def delete(self, scope: str, key: str) -> None:
+        code, _ = self._request("DELETE", f"/kv/{scope}/{key}")
+        self._check(f"DELETE {scope}/{key}", code)

@@ -41,8 +41,14 @@ def build_parser() -> argparse.ArgumentParser:
         description="Launch a horovod_tpu job: one controller process per "
                     "host/worker, coordinated via the JAX distributed "
                     "runtime.")
-    p.add_argument("-np", "--num-proc", type=int, default=1,
-                   help="number of controller processes to launch")
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="number of controller processes to launch "
+                        "(default: total slots of -H/--hostfile, else 1)")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="comma-separated host[:slots] list (reference "
+                        "-H h1:4,h2:4 syntax)")
+    p.add_argument("--hostfile", default=None,
+                   help="file with one 'host [slots=N]' or host:N per line")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend in workers (testing); each "
                         "worker gets --slots virtual devices")
@@ -74,6 +80,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="evict elastic workers whose heartbeat file goes "
                         "stale for this many seconds (default: "
                         "HOROVOD_HEARTBEAT_TIMEOUT env or disabled)")
+    p.add_argument("--network-rendezvous", action="store_true",
+                   help="elastic mode: publish membership + heartbeats "
+                        "over the HMAC-signed HTTP KV store instead of a "
+                        "shared assignment file (multi-host)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="program and args to launch per worker")
     return p
@@ -117,6 +127,29 @@ def run_command(args: Optional[List[str]] = None) -> int:
         parser.error("no command given")
 
     np_ = opts.num_proc
+    if opts.hosts or opts.hostfile:
+        if opts.host_discovery_script:
+            parser.error("-H/--hostfile is a static host list; it cannot "
+                         "be combined with --host-discovery-script "
+                         "(elastic membership comes from the script)")
+        from .hosts import (all_local, parse_host_spec, parse_hostfile,
+                            total_slots)
+        try:
+            hosts = parse_host_spec(opts.hosts) if opts.hosts else \
+                parse_hostfile(opts.hostfile)
+        except (ValueError, OSError) as e:
+            parser.error(str(e))
+        if not all_local(hosts):
+            parser.error(
+                "remote hosts in -H/--hostfile: this launcher spawns "
+                "processes locally (on TPU pods each worker VM's agent "
+                "runs `hvdrun` with its local slots; point every VM at "
+                "the same --coordinator and use HOROVOD_RANK offsets). "
+                f"Got: {', '.join(h for h, _ in hosts)}")
+        if np_ is None:
+            np_ = total_slots(hosts)
+    if np_ is None:
+        np_ = 1
     if opts.host_discovery_script:
         from ..core.config import load_config
         from ..elastic.driver import ElasticDriver
@@ -132,6 +165,7 @@ def run_command(args: Optional[List[str]] = None) -> int:
             slots=opts.slots,
             verbose=opts.verbose,
             heartbeat_timeout_s=heartbeat,
+            rendezvous=opts.network_rendezvous,
         )
         return driver.run()
 

@@ -154,3 +154,44 @@ def test_elastic_scale_down_live(tmp_path):
     assert mutated, out[-4000:]
     assert proc.returncode == 0, out[-4000:]
     assert "final size 2" in out, out[-4000:]
+
+
+@pytest.mark.integration
+def test_elastic_network_rendezvous_live(tmp_path):
+    """Same scale-down flow, but membership + heartbeats ride the
+    HMAC-signed HTTP KV rendezvous instead of the assignment file."""
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("a\nb\nc\n")
+    disc = tmp_path / "disc.sh"
+    disc.write_text(f"#!/bin/sh\ncat {hosts}\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TARGET_BATCHES"] = "40"
+    env["ELASTIC_BATCH_DELAY_S"] = "0.4"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run",
+         "--host-discovery-script", str(disc), "--min-np", "2",
+         "--network-rendezvous", "--heartbeat-timeout", "30", "--cpu",
+         sys.executable, os.path.join(REPO, "examples", "elastic_train.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    lines = []
+    try:
+        deadline = time.time() + 240
+        mutated = False
+        for line in proc.stdout:
+            lines.append(line)
+            if not mutated and " batch 5 " in line:
+                hosts.write_text("a\nb\n")
+                mutated = True
+            if time.time() > deadline:
+                raise TimeoutError("no progress")
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = "".join(lines)
+    assert mutated, out[-4000:]
+    assert proc.returncode == 0, out[-4000:]
+    assert "final size 2" in out, out[-4000:]

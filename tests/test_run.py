@@ -71,3 +71,185 @@ def test_failing_worker_propagates_exit_code(tmp_path):
          sys.executable, str(bad)],
         env=env, capture_output=True, text=True, timeout=120)
     assert out.returncode == 3
+
+
+# ---------------------------------------------------------------------------
+# Host parsing (-H / --hostfile)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_host_spec_forms():
+    from horovod_tpu.run.hosts import parse_host_spec, total_slots
+    hosts = parse_host_spec("h1:4, h2:2,h3")
+    assert hosts == [("h1", 4), ("h2", 2), ("h3", 1)]
+    assert total_slots(hosts) == 7
+    with pytest.raises(ValueError, match="slots"):
+        parse_host_spec("h1:x")
+    with pytest.raises(ValueError, match="empty host"):
+        parse_host_spec(":4")
+
+
+def test_parse_hostfile(tmp_path):
+    from horovod_tpu.run.hosts import parse_hostfile
+    hf = tmp_path / "hosts"
+    hf.write_text("# cluster\nnode1 slots=4\nnode2:2\nnode3\n")
+    assert parse_hostfile(str(hf)) == [("node1", 4), ("node2", 2),
+                                       ("node3", 1)]
+
+
+def test_all_local_detection():
+    from horovod_tpu.run.hosts import all_local
+    assert all_local([("localhost", 2), ("127.0.0.1", 1)])
+    assert not all_local([("localhost", 2), ("farawaynode", 1)])
+
+
+def test_launcher_hosts_errors(tmp_path):
+    from horovod_tpu.run import run_command
+    with pytest.raises(SystemExit):  # remote hosts unsupported locally
+        run_command(["-H", "remote1:4", "python", "x.py"])
+    with pytest.raises(SystemExit):  # malformed slots -> usage error
+        run_command(["-H", "localhost:x", "python", "x.py"])
+    with pytest.raises(SystemExit):  # static hosts + elastic conflict
+        run_command(["-H", "localhost:2", "--host-discovery-script",
+                     "d.sh", "python", "x.py"])
+
+
+def test_hostfile_validates_slots(tmp_path):
+    from horovod_tpu.run.hosts import parse_hostfile
+    bad = tmp_path / "bad"
+    bad.write_text("node1:0\n")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_hostfile(str(bad))
+    bad.write_text("node1 slots=-3\n")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_hostfile(str(bad))
+    bad.write_text("node1:x\n")
+    with pytest.raises(ValueError, match="integer"):
+        parse_hostfile(str(bad))
+
+
+def test_ipv6_host_specs():
+    from horovod_tpu.run.hosts import all_local, parse_host_spec
+    assert parse_host_spec("::1") == [("::1", 1)]
+    assert parse_host_spec("[::1]:2") == [("::1", 2)]
+    assert parse_host_spec("[2001:db8::2]:4") == [("2001:db8::2", 4)]
+    assert all_local([("::1", 2)])
+
+
+@pytest.mark.integration
+def test_launcher_dash_h_derives_np():
+    """-H localhost:2 with no -np runs 2 workers end-to-end."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-H", "localhost:2",
+         "--cpu", sys.executable,
+         os.path.join(REPO, "examples", "allreduce_check.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "rank 1: barrier OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Secret + HTTP KV rendezvous
+# ---------------------------------------------------------------------------
+
+
+def test_secret_sign_verify_tamper():
+    from horovod_tpu.run.secret import (check_digest, compute_digest,
+                                        make_secret_key)
+    k = make_secret_key()
+    d = compute_digest(k, b"payload")
+    assert check_digest(k, b"payload", d)
+    assert not check_digest(k, b"payloaX", d)
+    assert not check_digest(make_secret_key(), b"payload", d)
+
+
+def test_http_kv_roundtrip_and_auth():
+    from horovod_tpu.run.http_kv import KVClient, RendezvousServer
+    from horovod_tpu.run.secret import make_secret_key
+    secret = make_secret_key()
+    srv = RendezvousServer(secret, host="127.0.0.1")
+    try:
+        kv = KVClient("127.0.0.1", srv.port, secret)
+        assert kv.get("s", "k") is None
+        kv.put("s", "k", b"value-1")
+        assert kv.get("s", "k") == b"value-1"
+        kv.delete("s", "k")
+        assert kv.get("s", "k") is None
+        # Wrong secret -> RendezvousAuthError (NOT ConnectionError: a
+        # misconfigured secret must not be retried as "driver gone").
+        from horovod_tpu.run.http_kv import RendezvousAuthError
+        bad = KVClient("127.0.0.1", srv.port, make_secret_key())
+        with pytest.raises(RendezvousAuthError, match="secret"):
+            bad.put("s", "k", b"evil")
+        with pytest.raises(RendezvousAuthError, match="secret"):
+            bad.get("s", "k")
+        assert not isinstance(RendezvousAuthError("x"), ConnectionError)
+        # Stale timestamp (valid signature over it) -> 403: replay window.
+        import time as _time
+        from urllib.request import Request, urlopen
+        from urllib.error import HTTPError
+        from horovod_tpu.run.http_kv import (SIG_HEADER, TS_HEADER,
+                                             _signable)
+        from horovod_tpu.run.secret import compute_digest
+        old_ts = repr(_time.time() - 3600)
+        path = "/kv/s/k2"
+        sig = compute_digest(secret, _signable("PUT", path, old_ts,
+                                               b"replayed"))
+        req = Request(f"http://127.0.0.1:{srv.port}{path}", data=b"replayed",
+                      method="PUT",
+                      headers={SIG_HEADER: sig, TS_HEADER: old_ts})
+        with pytest.raises(HTTPError) as ei:
+            urlopen(req, timeout=5)
+        assert ei.value.code == 403
+    finally:
+        srv.stop()
+
+
+def test_notifier_reads_assignment_over_http(monkeypatch):
+    import json
+    from horovod_tpu.elastic.notify import ASSIGNMENT_KEY, Notifier
+    from horovod_tpu.run.http_kv import KVClient, RendezvousServer
+    from horovod_tpu.run.secret import SECRET_ENV, make_secret_key
+    secret = make_secret_key()
+    srv = RendezvousServer(secret, host="127.0.0.1")
+    try:
+        monkeypatch.setenv(SECRET_ENV, secret)
+        url = f"http://127.0.0.1:{srv.port}"
+        n = Notifier(path=url, worker_id="w0")
+        assert n.enabled and n.read() is None
+        kv = KVClient("127.0.0.1", srv.port, secret)
+        doc = {"epoch": 3, "size": 2, "port": 1234, "ranks": {"w0": 0}}
+        kv.put(*ASSIGNMENT_KEY, json.dumps(doc).encode())
+        got = n.updated()
+        assert got == doc
+        n.accept(got)
+        assert n.updated() is None
+    finally:
+        srv.stop()
+
+
+def test_kv_heartbeat_writer_and_age(monkeypatch):
+    import time
+    from horovod_tpu.core.stall import KVHeartbeatWriter
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.run.http_kv import RendezvousServer
+    from horovod_tpu.run.secret import make_secret_key
+    secret = make_secret_key()
+    srv = RendezvousServer(secret, host="127.0.0.1")
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        w = KVHeartbeatWriter(url, "w0", secret, interval_s=0.05)
+        time.sleep(0.15)
+        # Driver-side age check through the same KV.
+        drv = ElasticDriver.__new__(ElasticDriver)
+        from horovod_tpu.run.http_kv import KVClient
+        drv._kv = KVClient("127.0.0.1", srv.port, secret)
+        age = drv._kv_heartbeat_age("w0")
+        assert age is not None and age < 5.0
+        assert drv._kv_heartbeat_age("w-unknown") is None
+        w.stop()
+        assert drv._kv_heartbeat_age("w0") is None  # cleaned up
+    finally:
+        srv.stop()
