@@ -1,55 +1,92 @@
-"""Online autotuning of the fusion threshold (ParameterManager analogue).
+"""Online autotuning of fusion threshold + cycle time (ParameterManager).
 
-The reference (``horovod/common/parameter_manager.cc`` + Bayesian
-optimization in ``optim/bayesian_optimization.cc``) tunes fusion threshold
-and cycle time against observed throughput.  On TPU there is no cycle time
-(no background loop), so the tunable surface is the gradient bucket size.
-Round-1 implementation is the reference's documented fallback strategy --
-discrete candidate sweep scored by observed step throughput -- with the GP
-surrogate as a later upgrade.
+The reference (``horovod/common/parameter_manager.cc`` driving the GP
+Bayesian optimization in ``optim/bayesian_optimization.cc``) tunes the
+fusion threshold and cycle time against observed throughput, with rank 0
+deciding and broadcasting so every rank applies identical values.  Same
+architecture here:
 
-Usage: the training loop reports ``record_step(seconds, bytes)`` each step;
-every ``steps_per_sample`` steps the tuner moves to the next candidate, and
-after one full sweep it locks in the argmax.  ``HOROVOD_AUTOTUNE=1``
-enables it; ``HOROVOD_AUTOTUNE_LOG`` writes the CSV of samples, matching
-the reference's warm-start log format in spirit.
+* the tunable surface is the gradient bucket size (``fusion_threshold``)
+  and -- when the native cycle scheduler is active (torch shim) -- the
+  cycle time;
+* scoring is observed bytes/sec over ``steps_per_sample`` steps;
+* the search is expected-improvement Bayesian optimization over a
+  discrete grid (:mod:`horovod_tpu.autotune.gp`), seeded with a strided
+  warmup.  Discrete because every distinct fusion threshold costs one
+  XLA retrace -- a continuum would thrash the executable cache;
+* in multi-process mode rank 0's decisions are pickle-broadcast at
+  sample boundaries (the reference's coordinator-decides model), so SPMD
+  processes never cut divergent buckets while tuning;
+* ``HOROVOD_AUTOTUNE=1`` enables, ``HOROVOD_AUTOTUNE_LOG`` persists the
+  sampled configurations as CSV and warm-starts the next run (reference
+  warm-start file behavior).
 """
 
 from __future__ import annotations
 
-import time
-from typing import List, Optional
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .gp import BayesianOptimizer
 
 _MiB = 1024 * 1024
-_CANDIDATES = [2 * _MiB, 8 * _MiB, 32 * _MiB, 64 * _MiB, 128 * _MiB]
+_THRESHOLDS = [2 * _MiB, 8 * _MiB, 32 * _MiB, 64 * _MiB, 128 * _MiB]
+_CYCLES_MS = [0.5, 1.0, 5.0]
+MAX_SAMPLES = 12
+
+
+def _grid(thresholds, cycles) -> List[Tuple[int, float]]:
+    return [(t, c) for t in thresholds for c in cycles]
 
 
 class Autotuner:
+    """Feed ``record_step(seconds, nbytes)`` per training step; read the
+    current ``fusion_threshold()`` / ``cycle_time_ms()``."""
+
     def __init__(self, config, steps_per_sample: int = 10,
-                 candidates: Optional[List[int]] = None):
-        self.candidates = list(candidates or _CANDIDATES)
-        base = config.fusion_threshold
-        if base not in self.candidates:
-            self.candidates.append(base)
+                 candidates: Optional[List[int]] = None,
+                 max_samples: int = MAX_SAMPLES):
+        self.candidates = list(candidates or _THRESHOLDS)
+        if config.fusion_threshold not in self.candidates:
+            self.candidates.append(config.fusion_threshold)
+        import sys
+        # The cycle-time axis only matters when the native cycle scheduler
+        # (torch shim grad batching) is in play; tuning it in a pure-JAX
+        # run would burn most of the sample budget re-measuring identical
+        # configurations under noise.
+        torch_shim = ("horovod_tpu.torch_api" in sys.modules
+                      or "horovod_tpu.torch" in sys.modules)
+        cycles = list(_CYCLES_MS) if torch_shim else []
+        if config.cycle_time not in cycles:
+            cycles.append(config.cycle_time)
+        self.grid = _grid(sorted(self.candidates), sorted(cycles))
         self.steps_per_sample = steps_per_sample
+        self.max_samples = min(max_samples, len(self.grid))
         self.log_path = config.autotune_log
-        self._idx = 0
+        self._opt = BayesianOptimizer(
+            [(float(t), c) for t, c in self.grid])
+        self._samples: List[tuple] = []
+        self._best: Optional[Tuple[int, float]] = None
         self._step = 0
         self._accum_s = 0.0
         self._accum_bytes = 0
-        self._scores: List[float] = []
-        self._best: Optional[int] = None
-        self._samples: List[tuple] = []
+        self._warm_start()
+        self._idx = self._next_index()
 
+    # -- current knobs ----------------------------------------------------
     def fusion_threshold(self) -> int:
-        if self._best is not None:
-            return self._best
-        return self.candidates[self._idx]
+        return (self._best or self.grid[self._idx])[0]
+
+    def cycle_time_ms(self) -> float:
+        return (self._best or self.grid[self._idx])[1]
 
     @property
     def done(self) -> bool:
         return self._best is not None
 
+    # -- sampling loop ----------------------------------------------------
     def record_step(self, seconds: float, nbytes: int) -> None:
         """Report one training step's wall time and gradient bytes."""
         if self._best is not None:
@@ -60,23 +97,93 @@ class Autotuner:
         if self._step < self.steps_per_sample:
             return
         score = self._accum_bytes / max(self._accum_s, 1e-9)  # bytes/s
-        self._samples.append((self.candidates[self._idx], score))
-        self._scores.append(score)
+        self._opt.observe(self._idx, score)
+        self._samples.append(self.grid[self._idx] + (score,))
         self._step = 0
         self._accum_s = 0.0
         self._accum_bytes = 0
-        self._idx += 1
-        if self._idx >= len(self.candidates):
-            best_i = max(range(len(self._scores)),
-                         key=lambda i: self._scores[i])
-            self._best = self.candidates[best_i]
-            self._write_log()
+        self._idx = self._next_index()
+        self._apply_to_batcher()
+
+    def _next_index(self) -> int:
+        """Pick the next configuration (rank 0 decides; others follow)."""
+        if self._opt.n_observed >= self.max_samples:
+            self._finish()
+            return self._opt.best_index or 0
+        nxt = self._sync(self._opt.suggest())
+        if nxt is None:
+            self._finish()
+            return self._opt.best_index or 0
+        return nxt
+
+    def _sync(self, value):
+        """Broadcast rank 0's decision in multi-process mode (the
+        reference's coordinator-decides model): per-rank scores differ,
+        and diverging fusion thresholds would cut mismatched buckets."""
+        import jax
+        if jax.process_count() == 1:
+            return value
+        from ..optim.functions import broadcast_object
+        return broadcast_object(value, root_rank=0)
+
+    def _finish(self) -> None:
+        if self._best is not None:
+            return
+        best = self._sync(self._opt.best_index)
+        self._best = self.grid[best if best is not None else 0]
+        self._write_log()
+        self._apply_to_batcher()
+
+    def _apply_to_batcher(self) -> None:
+        """Push current knobs into the native cycle scheduler (torch
+        shim), mirroring the ParameterManager owning the C++ knobs."""
+        import sys
+        mod = sys.modules.get("horovod_tpu.torch_api.batching")
+        if mod is None:
+            return
+        b = mod._batcher
+        if b is not None:
+            b._sched.update_tuning(self.cycle_time_ms(),
+                                   self.fusion_threshold())
+
+    # -- warm start / log -------------------------------------------------
+    def _warm_start(self) -> None:
+        """Seed the optimizer from the previous run's log.
+
+        Only rank 0 reads the file (it may exist on rank 0's filesystem
+        alone); the observation list is broadcast so every process sees
+        the identical sampling schedule -- a rank-local read would desync
+        the broadcast protocol and deadlock.
+        """
+        obs: List[tuple] = []
+        if self.log_path and os.path.exists(self.log_path):
+            try:
+                with open(self.log_path) as f:
+                    for line in f:
+                        if line.startswith(("fusion", "#")):
+                            continue
+                        parts = line.strip().split(",")
+                        if len(parts) < 3:
+                            continue
+                        cfg = (int(float(parts[0])), float(parts[1]))
+                        if cfg in self.grid:
+                            obs.append((self.grid.index(cfg),
+                                        float(parts[2])))
+            except (OSError, ValueError):  # pragma: no cover - corrupt log
+                obs = []
+        obs = self._sync(obs)
+        for idx, score in obs:
+            self._opt.observe(idx, score)
+            # Keep warm rows in _samples so _write_log preserves them --
+            # otherwise a warm-started run truncates the log and the
+            # warm start survives exactly one restart.
+            self._samples.append(self.grid[idx] + (score,))
 
     def _write_log(self) -> None:
         if not self.log_path:
             return
         with open(self.log_path, "w") as f:
-            f.write("fusion_threshold_bytes,score_bytes_per_s\n")
-            for thr, score in self._samples:
-                f.write(f"{thr},{score}\n")
-            f.write(f"# best,{self._best}\n")
+            f.write("fusion_threshold_bytes,cycle_time_ms,score_bytes_per_s\n")
+            for thr, cyc, score in self._samples:
+                f.write(f"{thr},{cyc},{score}\n")
+            f.write(f"# best,{self._best[0]},{self._best[1]}\n")

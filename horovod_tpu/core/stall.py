@@ -292,13 +292,22 @@ class KVHeartbeatWriter(HeartbeatWriter):
     def _do_beat(self) -> None:
         try:
             self._kv.put("hb", self.worker_id, repr(time.time()).encode())
-        except (ConnectionError, OSError):  # driver gone/restarting
+        except ConnectionError:  # driver gone/restarting: keep trying
             pass
+        except Exception as e:  # RendezvousAuthError etc: NOT transient
+            # Surface the misconfiguration loudly ONCE and stop the beat
+            # thread cleanly -- a dead daemon thread would hide the cause
+            # and the worker would just get evicted as "stale".
+            logger.error(
+                "heartbeat publication failed permanently (%s); stopping "
+                "heartbeats -- the driver will evict this worker after "
+                "its heartbeat timeout", e)
+            self._stop.set()
 
     def _cleanup(self) -> None:
         try:
             self._kv.delete("hb", self.worker_id)
-        except (ConnectionError, OSError):
+        except Exception:  # noqa: BLE001 - best-effort cleanup
             pass
 
 

@@ -37,7 +37,8 @@ class ElasticDriver:
                  poll_interval_s: float = 1.0,
                  elastic_timeout_s: float = 600.0,
                  heartbeat_timeout_s: float = 0.0,
-                 rendezvous: bool = False):
+                 rendezvous: bool = False,
+                 extra_env: Optional[Dict[str, str]] = None):
         self.command = list(command)
         self.discovery = HostDiscoveryScript(discovery_script,
                                              default_slots=slots)
@@ -52,6 +53,7 @@ class ElasticDriver:
         # heartbeat file (written by the elastic run loop) goes stale is
         # terminated and blacklisted like any failed worker.
         self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.extra_env = dict(extra_env or {})
         self.epoch = -1
         self.blacklist: set = set()
         self.workers: Dict[str, TaggedProcess] = {}  # worker_id -> proc
@@ -115,6 +117,7 @@ class ElasticDriver:
         except OSError:
             pass
         env = dict(os.environ)
+        env.update(self.extra_env)
         env.update(worker_env(rank=rank, size=size, coordinator="127.0.0.1",
                               port=port, cpu=self.cpu, slots=1,
                               local_rank=rank, local_size=size))

@@ -20,6 +20,7 @@ import socket
 from typing import Any, Callable, List, Optional
 
 from ..run.launch import worker_env
+from .elastic import ElasticRayExecutor  # noqa: F401
 
 
 def _free_port() -> int:

@@ -1,0 +1,144 @@
+"""Elastic Ray executor (reference ``horovod/ray/elastic_v2.py`` parity).
+
+``ElasticRayExecutor`` runs a python function on an elastically-managed
+worker set: host membership comes from the Ray cluster (one slot per
+alive node) when Ray is importable, or from any user-supplied discovery
+source; workers ride the same :class:`~horovod_tpu.elastic.driver.
+ElasticDriver` rescale/blacklist/heartbeat machinery as ``hvdrun
+--host-discovery-script``.  The function is shipped to workers by pickle;
+per-rank results come back through the run directory, rank-ordered.
+
+The user function runs under the worker's own elastic loop: decorate
+training with ``@horovod_tpu.elastic.run`` inside it exactly as a script
+would (the executor deliberately does not hide that contract -- commit
+boundaries are the user's to choose, reference semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from ..elastic.driver import ElasticDriver
+
+
+def _ray_discovery_script(workdir: str, slots: int) -> str:
+    """Discovery script printing one worker id per alive Ray node."""
+    path = os.path.join(workdir, "ray_discovery.py")
+    with open(path, "w") as f:
+        f.write(
+            "#!/usr/bin/env python\n"
+            "import ray\n"
+            "ray.init(address='auto', ignore_reinit_error=True,\n"
+            "         logging_level='ERROR')\n"
+            "for node in ray.nodes():\n"
+            "    if node.get('Alive'):\n"
+            f"        print(node['NodeID'][:12] + ':{slots}')\n")
+    os.chmod(path, 0o755)
+    return path
+
+
+def _file_discovery_script(workdir: str, host_file: str) -> str:
+    import shlex
+    path = os.path.join(workdir, "file_discovery.sh")
+    with open(path, "w") as f:
+        f.write(f"#!/bin/sh\ncat {shlex.quote(host_file)}\n")
+    os.chmod(path, 0o755)
+    return path
+
+
+class ElasticRayExecutor:
+    """Elastic function runner over a dynamic host set.
+
+    ``host_file``: path whose lines name the current hosts (the test/
+    non-Ray discovery source; rewrite it to scale).  Without it, Ray's
+    alive-node set is polled.
+    """
+
+    def __init__(self, min_workers: int = 1,
+                 max_workers: Optional[int] = None,
+                 slots_per_worker: int = 1, cpu: bool = False,
+                 host_file: Optional[str] = None,
+                 heartbeat_timeout_s: float = 0.0,
+                 network_rendezvous: bool = False):
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.slots = slots_per_worker
+        self.cpu = cpu
+        self.host_file = host_file
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.network_rendezvous = network_rendezvous
+        self.workdir = tempfile.mkdtemp(prefix="hvd_tpu_ray_elastic_")
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        """Run ``fn(*args, **kwargs)`` elastically; rank-ordered results
+        from the FINAL membership epoch."""
+        payload = os.path.join(self.workdir, "payload.pkl")
+        with open(payload, "wb") as f:
+            pickle.dump((fn, args, kwargs or {}), f)
+        results_dir = os.path.join(self.workdir, "results")
+        # Fresh results dir per call: stale rank files from a previous
+        # run() (or an earlier, larger membership epoch) must not leak
+        # into this call's output.
+        if os.path.isdir(results_dir):
+            import shutil
+            shutil.rmtree(results_dir)
+        os.makedirs(results_dir)
+
+        if self.host_file is not None:
+            discovery = _file_discovery_script(self.workdir, self.host_file)
+        else:
+            try:
+                import ray  # noqa: F401
+            except ImportError as e:
+                raise ImportError(
+                    "ElasticRayExecutor without host_file requires ray; "
+                    "pass host_file= for the file-backed discovery "
+                    "source.") from e
+            discovery = _ray_discovery_script(self.workdir, self.slots)
+
+        # The pickled fn's defining module must be importable in workers;
+        # the parent's sys.path (e.g. a test dir pytest inserted) may not
+        # be in PYTHONPATH, so propagate it.
+        pypath = os.pathsep.join(
+            [p for p in sys.path if p] +
+            [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+             if p])
+        driver = ElasticDriver(
+            command=[sys.executable, "-m",
+                     "horovod_tpu.ray._elastic_worker", payload,
+                     results_dir],
+            extra_env={"PYTHONPATH": pypath},
+            discovery_script=discovery,
+            min_np=self.min_workers,
+            max_np=self.max_workers,
+            cpu=self.cpu,
+            slots=self.slots,
+            heartbeat_timeout_s=self.heartbeat_timeout_s,
+            rendezvous=self.network_rendezvous,
+        )
+        rc = driver.run()
+        if rc != 0:
+            raise RuntimeError(f"elastic run failed (exit {rc})")
+        results = {}
+        for name in os.listdir(results_dir):
+            if not name.startswith("rank_"):
+                continue
+            with open(os.path.join(results_dir, name), "rb") as f:
+                results[int(name[len("rank_"):])] = pickle.load(f)
+        # Return exactly the FINAL membership epoch's ranks: a worker from
+        # an earlier (larger) epoch may have finished and written a rank
+        # beyond the final size before the scale-down landed.
+        from ..elastic.notify import read_assignment
+        doc = read_assignment(driver.assignment_path)
+        final_size = doc["size"] if doc else len(results)
+        missing = [r for r in range(final_size) if r not in results]
+        if missing:
+            raise RuntimeError(
+                f"missing results for final-epoch rank(s) {missing}")
+        return [results[r] for r in range(final_size)]

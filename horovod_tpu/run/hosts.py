@@ -32,7 +32,10 @@ def _parse_slots(text: str, item: str, strict: bool,
     if n < 1:
         if strict:
             raise ValueError(f"bad host spec {item!r}: slots must be >= 1")
-        raise _NotSlots()
+        # Lenient (elastic discovery): "host:0" means a DRAINED host --
+        # zero slots removes its workers; it must not be reparsed as a
+        # phantom hostname with default slots.
+        return max(n, 0)
     return n
 
 

@@ -24,7 +24,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.request import Request, urlopen
-from urllib.error import HTTPError
+from urllib.error import HTTPError, URLError
 
 from .secret import check_digest, compute_digest
 
@@ -144,6 +144,13 @@ class KVClient:
                 return resp.status, resp.read()
         except HTTPError as e:
             return e.code, b""
+        except (URLError, TimeoutError, OSError) as e:
+            # Normalize every transport failure to ConnectionError so
+            # callers' "driver down/restarting, retry" handling sees one
+            # type (urllib raises URLError/TimeoutError, not
+            # ConnectionError).
+            raise ConnectionError(
+                f"rendezvous {method} {path}: {e}") from e
 
     def _check(self, op: str, code: int) -> None:
         if code == 403:

@@ -1,0 +1,119 @@
+"""GP Bayesian autotuner tests (ParameterManager + bayesian_optimization
+parity: gaussian_process.cc / bayesian_optimization.cc behavior)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.autotune import Autotuner
+from horovod_tpu.autotune.gp import (BayesianOptimizer, GaussianProcess,
+                                     expected_improvement)
+from horovod_tpu.core.config import Config
+
+
+def test_gp_interpolates_and_is_uncertain_away_from_data():
+    gp = GaussianProcess(length_scale=0.3, noise=1e-6)
+    X = np.array([[0.0], [0.5], [1.0]])
+    y = np.array([0.0, 1.0, 0.0])
+    gp.fit(X, y)
+    mu, sigma = gp.predict(X)
+    np.testing.assert_allclose(mu, y, atol=1e-2)
+    assert sigma.max() < 0.1  # confident at the data
+    mu2, sigma2 = gp.predict(np.array([[0.25]]))
+    assert sigma2[0] > sigma.max()  # less confident between points
+    assert 0.0 < mu2[0] < 1.0
+
+
+def test_expected_improvement_prefers_high_mean_and_high_uncertainty():
+    mu = np.array([1.0, 2.0, 1.0])
+    sigma = np.array([0.1, 0.1, 2.0])
+    ei = expected_improvement(mu, sigma, best=1.5)
+    assert ei[1] > ei[0]  # higher mean wins over equal uncertainty
+    assert ei[2] > ei[0]  # exploration: high variance beats low
+
+
+def test_bayesian_optimizer_finds_peak_on_grid():
+    # Objective peaked at grid point 7 of 12.
+    grid = [[float(i)] for i in range(12)]
+    opt = BayesianOptimizer(grid, warmup=4)
+    truth = lambda i: -(i - 7.0) ** 2  # noqa: E731
+    for _ in range(9):
+        i = opt.suggest()
+        assert i is not None
+        opt.observe(i, truth(i))
+    assert opt.best_index is not None
+    assert abs(opt.best_index - 7) <= 1
+
+
+def test_autotuner_converges_to_best_throughput(tmp_path):
+    """Feed synthetic step times where 32 MiB @ 1ms is fastest; the tuner
+    must lock in at (or adjacent to) the peak and log every sample."""
+    log = tmp_path / "at.csv"
+    cfg = Config(autotune=True, autotune_log=str(log))
+    t = Autotuner(cfg, steps_per_sample=1)
+    peak = (32 * 1024 * 1024, 1.0)
+
+    def step_time(thr, cyc):
+        # Smooth bowl in log-threshold and cycle distance around the peak.
+        d = (abs(np.log2(thr / peak[0])) + abs(np.log2(cyc / peak[1])))
+        return 0.01 * (1.0 + 0.3 * d)
+
+    guard = 0
+    while not t.done and guard < 100:
+        t.record_step(step_time(t.fusion_threshold(), t.cycle_time_ms()),
+                      nbytes=100 * 1024 * 1024)
+        guard += 1
+    assert t.done
+    # Best within a factor of 4 of the true peak threshold.
+    assert peak[0] / 4 <= t.fusion_threshold() <= peak[0] * 4
+    text = log.read_text()
+    assert text.startswith("fusion_threshold_bytes,cycle_time_ms,")
+    assert "# best," in text
+
+
+def test_autotuner_warm_start_skips_resampling(tmp_path):
+    log = tmp_path / "warm.csv"
+    cfg = Config(autotune=True, autotune_log=str(log))
+    t1 = Autotuner(cfg, steps_per_sample=1)
+    while not t1.done:
+        t1.record_step(0.01 if t1.fusion_threshold() == 32 * 1024 * 1024
+                       else 0.02, nbytes=1 << 20)
+    best = (t1.fusion_threshold(), t1.cycle_time_ms())
+    # Second run warm-starts from the log: already at max_samples, so it
+    # finishes immediately with the same best.
+    t2 = Autotuner(cfg, steps_per_sample=1)
+    assert t2.done
+    assert (t2.fusion_threshold(), t2.cycle_time_ms()) == best
+
+
+def test_autotuner_warm_start_preserves_log_rows(tmp_path):
+    """A warm-started run must not truncate the persisted samples."""
+    log = tmp_path / "keep.csv"
+    cfg = Config(autotune=True, autotune_log=str(log))
+    t1 = Autotuner(cfg, steps_per_sample=1)
+    while not t1.done:
+        t1.record_step(0.01, nbytes=1 << 20)
+    rows1 = [l for l in log.read_text().splitlines()
+             if l and not l.startswith(("fusion", "#"))]
+    t2 = Autotuner(cfg, steps_per_sample=1)
+    assert t2.done  # warm start covers the whole budget
+    rows2 = [l for l in log.read_text().splitlines()
+             if l and not l.startswith(("fusion", "#"))]
+    assert rows2 == rows1  # log survives the restart intact
+
+
+def test_autotuner_skips_cycle_axis_without_torch_shim(monkeypatch):
+    import sys
+    monkeypatch.delitem(sys.modules, "horovod_tpu.torch_api",
+                        raising=False)
+    monkeypatch.delitem(sys.modules, "horovod_tpu.torch", raising=False)
+    t = Autotuner(Config(autotune=True), steps_per_sample=1)
+    cycles = {c for _, c in t.grid}
+    assert cycles == {Config().cycle_time}
+
+
+def test_autotuner_tunes_cycle_axis_with_torch_shim(monkeypatch):
+    import sys
+    monkeypatch.setitem(sys.modules, "horovod_tpu.torch_api",
+                        sys.modules[__name__])  # any module object works
+    t = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert len({c for _, c in t.grid}) > 1

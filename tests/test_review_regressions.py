@@ -90,11 +90,12 @@ def test_autotuner_sweeps_and_locks_in(n_devices, tmp_path):
         lambda p, b: jnp.mean((b[0] @ p["w"] + p["b"] - b[1]) ** 2), opt)
     batch = hv.shard_batch((np.ones((n_devices * 2, 16), np.float32),
                             np.ones((n_devices * 2, 16), np.float32)))
-    n_steps = 2 * len(tuner.candidates) + 2
+    n_steps = 2 * tuner.max_samples + 2
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, batch)
     assert tuner.done
     assert tuner.fusion_threshold() in tuner.candidates
+    assert tuner.cycle_time_ms() > 0
     assert log.exists() and "best" in log.read_text()
     hv.shutdown()
 
