@@ -105,10 +105,12 @@ def main() -> int:
         print(f"model: {args.model}  devices: {n}  "
               f"global batch: {global_batch}  image: {size}")
 
+    loss = None
     for _ in range(args.num_warmup):
         params, batch_stats, opt_state, loss = step(params, batch_stats,
                                                     opt_state, batch)
-    float(loss)  # device->host fetch: the only reliable fence (bench.py)
+    if loss is not None:
+        float(loss)  # device->host fetch: the only reliable fence (bench.py)
 
     t0 = time.perf_counter()
     for _ in range(args.num_iters):

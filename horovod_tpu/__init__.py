@@ -49,6 +49,9 @@ from .optim.functions import (  # noqa: F401
     broadcast_parameters, broadcast_optimizer_state, broadcast_object,
 )
 from . import elastic  # noqa: F401
+from .utils.checkpoint import (  # noqa: F401
+    save_checkpoint, restore_checkpoint, latest_checkpoint, checkpoint_path,
+)
 from .training import (  # noqa: F401
     make_train_step, make_eval_step, shard_batch, shard_batch_from_local,
     replicate, batch_sharding, replicated_sharding, sync_batch_norm,

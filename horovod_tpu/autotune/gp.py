@@ -81,14 +81,13 @@ class BayesianOptimizer:
     """
 
     def __init__(self, grid: Sequence[Sequence[float]],
-                 warmup: int = 4, seed: int = 0):
+                 warmup: int = 4):
         self.grid = np.atleast_2d(np.asarray(grid, np.float64))
         lo = self.grid.min(0)
         span = self.grid.max(0) - lo
         span[span == 0] = 1.0
         self._norm = (self.grid - lo) / span
         self.warmup = warmup
-        self._rng = np.random.RandomState(seed)
         self._X: List[int] = []    # sampled grid indices
         self._y: List[float] = []
 

@@ -20,17 +20,24 @@ class HostDiscoveryScript:
         self.script = script
         self.default_slots = default_slots
         self.timeout = timeout
+        self._last: Dict[str, int] = {}
 
     def find_available_hosts_and_slots(self) -> Dict[str, int]:
-        """Run the script; returns {host: slots}.  Failures -> empty set
-        (treated as 'no hosts currently available')."""
+        """Run the script; returns {host: slots}.
+
+        A FAILING script (crash, nonzero exit, timeout) returns the last
+        successful result: one transient discovery hiccup (e.g. a slow
+        cluster API) must not read as "all hosts gone" and tear down a
+        healthy job below min-np.  Only a successful empty listing means
+        no hosts.
+        """
         try:
             out = subprocess.run([self.script], capture_output=True,
                                  text=True, timeout=self.timeout)
         except (OSError, subprocess.TimeoutExpired):
-            return {}
+            return dict(self._last)
         if out.returncode != 0:
-            return {}
+            return dict(self._last)
         hosts: Dict[str, int] = {}
         for line in out.stdout.splitlines():
             line = line.strip()
@@ -38,6 +45,7 @@ class HostDiscoveryScript:
                 continue
             host, slots = self._parse_line(line)
             hosts[host] = slots
+        self._last = dict(hosts)
         return hosts
 
     def _parse_line(self, line: str):

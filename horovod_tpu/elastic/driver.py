@@ -38,10 +38,12 @@ class ElasticDriver:
                  elastic_timeout_s: float = 600.0,
                  heartbeat_timeout_s: float = 0.0,
                  rendezvous: bool = False,
-                 extra_env: Optional[Dict[str, str]] = None):
+                 extra_env: Optional[Dict[str, str]] = None,
+                 discovery_timeout_s: float = 10.0):
         self.command = list(command)
         self.discovery = HostDiscoveryScript(discovery_script,
-                                             default_slots=slots)
+                                             default_slots=slots,
+                                             timeout=discovery_timeout_s)
         self.min_np = min_np
         self.max_np = max_np
         self.cpu = cpu

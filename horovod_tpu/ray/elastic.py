@@ -74,6 +74,17 @@ class ElasticRayExecutor:
         self.network_rendezvous = network_rendezvous
         self.workdir = tempfile.mkdtemp(prefix="hvd_tpu_ray_elastic_")
 
+    def close(self) -> None:
+        """Remove the working directory (pickled payload + results)."""
+        import shutil
+        shutil.rmtree(self.workdir, ignore_errors=True)
+
+    def __enter__(self) -> "ElasticRayExecutor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def run(self, fn: Callable, args: tuple = (),
             kwargs: Optional[dict] = None) -> List[Any]:
         """Run ``fn(*args, **kwargs)`` elastically; rank-ordered results
@@ -115,6 +126,7 @@ class ElasticRayExecutor:
                      results_dir],
             extra_env={"PYTHONPATH": pypath},
             discovery_script=discovery,
+            discovery_timeout_s=30.0 if self.host_file is None else 10.0,
             min_np=self.min_workers,
             max_np=self.max_workers,
             cpu=self.cpu,

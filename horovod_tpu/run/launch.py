@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print build capabilities and exit")
     p.add_argument("--no-tag-output", action="store_true",
                    help="do not prefix worker output with [rank]<stream>")
+    p.add_argument("--probe", action="store_true",
+                   help="pre-launch handshake: every worker slot reports "
+                        "its build/runtime versions and the driver fails "
+                        "fast on skew (reference driver/task service)")
     # Elastic flags (wired to horovod_tpu.elastic driver).
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
@@ -168,6 +172,29 @@ def run_command(args: Optional[List[str]] = None) -> int:
             rendezvous=opts.network_rendezvous,
         )
         return driver.run()
+
+    if opts.probe:
+        from .probe import DriverProbe
+        probe = DriverProbe()
+        wids = [f"slot{r}" for r in range(np_)]
+        procs_ = [probe.spawn_local_probe(w) for w in wids]
+        try:
+            reports = probe.collect(wids)
+            probe.validate(reports)
+            if opts.verbose:
+                for w, r in reports.items():
+                    print(f"# probe {w}: {r['hostname']} "
+                          f"hvd={r['framework_version']} "
+                          f"jax={r['jax_version']}")
+        finally:
+            # Reap best-effort: a hung probe child must not mask the real
+            # collect/validate error or leak the rendezvous server.
+            for pr in procs_:
+                try:
+                    pr.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pr.kill()
+            probe.stop()
 
     port = opts.coordinator_port or free_port()
     lock = threading.Lock()

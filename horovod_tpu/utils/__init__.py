@@ -1,0 +1,5 @@
+"""Utility helpers: checkpoint/resume (SURVEY.md section 5.4)."""
+
+from .checkpoint import (  # noqa: F401
+    checkpoint_path, latest_checkpoint, restore_checkpoint, save_checkpoint,
+)

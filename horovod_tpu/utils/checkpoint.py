@@ -1,0 +1,137 @@
+"""Checkpoint/resume helpers (SURVEY.md section 5.4).
+
+The reference ships no checkpoint format of its own -- its documented
+idiom is "rank 0 saves; on resume everyone restores and
+``broadcast_parameters`` syncs" (examples + ``horovod/torch/functions.py``).
+These helpers codify exactly that for pytrees:
+
+* :func:`save_checkpoint`: rank 0 atomically writes a flat npz of the
+  tree's leaves (keyed by jax keystr); a barrier makes completion global.
+* :func:`restore_checkpoint`: rank 0 reads, then every leaf is broadcast
+  -- correct whether or not the checkpoint path is on a shared
+  filesystem.
+* :func:`latest_checkpoint`: newest ``step``-stamped file in a directory.
+
+For multi-TB sharded model states use orbax directly; this is the parity
+surface for the reference's host-RAM-scale workloads.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+_STEP_KEY = "__hvd_tpu_step__"
+
+
+def _flatten(tree: Any):
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(kp) or "<root>", v) for kp, v in flat], \
+        treedef
+
+
+def checkpoint_path(directory: str, step: int,
+                    prefix: str = "ckpt") -> str:
+    return os.path.join(directory, f"{prefix}_{step:010d}.npz")
+
+
+def save_checkpoint(path: str, tree: Any, *, step: Optional[int] = None,
+                    root_rank: int = 0) -> str:
+    """Rank ``root_rank`` writes ``tree`` to ``path`` (npz, atomic);
+    everyone barriers so a subsequent restore sees a complete file."""
+    from ..core import basics as _basics
+    from ..optim.functions import broadcast_object
+
+    err = None
+    if _basics.rank() == root_rank:
+        try:
+            import jax
+            flat, _ = _flatten(tree)
+            payload = {k: np.asarray(jax.device_get(v)) for k, v in flat}
+            if step is not None:
+                payload[_STEP_KEY] = np.asarray(step, np.int64)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            buf = io.BytesIO()
+            np.savez(buf, **payload)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(buf.getvalue())
+            os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001 - must reach every rank
+            err = f"{type(e).__name__}: {e}"
+    # Error status travels through a collective every rank enters -- a
+    # root-only raise would leave the other ranks stuck in the barrier.
+    err = broadcast_object(err, root_rank=root_rank)
+    if err:
+        raise RuntimeError(f"checkpoint save failed on root: {err}")
+    return path
+
+
+def restore_checkpoint(path: str, like: Any, *,
+                       root_rank: int = 0) -> Tuple[Any, Optional[int]]:
+    """Restore a tree shaped ``like``; returns ``(tree, step)``.
+
+    Rank ``root_rank`` reads the file; every leaf is then broadcast, so
+    only the root needs the file (non-shared-filesystem resume).  Root-
+    side read errors (missing file, missing leaves) are broadcast as a
+    status before any tree collective, so every rank raises instead of
+    the non-roots hanging in a broadcast the root never joins.
+    """
+    import jax
+
+    from ..core import basics as _basics
+    from ..optim.functions import broadcast_, broadcast_object
+
+    flat, treedef = _flatten(like)
+    step = None
+    err = None
+    values = [np.zeros(np.shape(v), np.asarray(v).dtype) for _, v in flat]
+    if _basics.rank() == root_rank:
+        try:
+            with np.load(path) as z:
+                missing = [k for k, _ in flat if k not in z.files]
+                if missing:
+                    raise KeyError(
+                        f"checkpoint {path!r} lacks {len(missing)} "
+                        f"leaf/leaves of the restore target: {missing[:5]}")
+                values = []
+                for k, like_v in flat:
+                    a = z[k]
+                    if a.dtype.kind == "V":
+                        # numpy round-trips ml_dtypes (bfloat16, float8)
+                        # as opaque void records; the bytes are intact, so
+                        # view them back through the target's dtype.
+                        a = a.view(np.dtype(np.asarray(like_v).dtype))
+                    values.append(a)
+                if _STEP_KEY in z.files:
+                    step = int(z[_STEP_KEY])
+        except Exception as e:  # noqa: BLE001 - must reach every rank
+            err = f"{type(e).__name__}: {e}"
+    err = broadcast_object(err, root_rank=root_rank)
+    if err:
+        exc = KeyError if err.startswith("KeyError") else RuntimeError
+        raise exc(f"checkpoint restore failed on root: {err}")
+    tree = jax.tree_util.tree_unflatten(treedef, values)
+    tree = broadcast_(tree, root_rank=root_rank)
+    step = broadcast_object(step, root_rank=root_rank)
+    return tree, step
+
+
+def latest_checkpoint(directory: str,
+                      prefix: str = "ckpt") -> Optional[str]:
+    """Path of the highest-step checkpoint in ``directory`` (None: none)."""
+    if not os.path.isdir(directory):
+        return None
+    best: Tuple[int, Optional[str]] = (-1, None)
+    pat = re.compile(rf"^{re.escape(prefix)}_(\d+)\.npz$")
+    for name in os.listdir(directory):
+        m = pat.match(name)
+        if m:
+            best = max(best, (int(m.group(1)),
+                              os.path.join(directory, name)))
+    return best[1]

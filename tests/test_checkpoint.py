@@ -1,0 +1,43 @@
+"""Checkpoint/resume helpers (rank-0-saves + broadcast idiom)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hv
+
+
+def test_checkpoint_roundtrip_with_step(hvd, tmp_path):
+    tree = {"params": {"w": jnp.arange(12.0).reshape(3, 4),
+                       "b": jnp.ones((4,), jnp.bfloat16)},
+            "counts": jnp.asarray([1, 2, 3], jnp.int32)}
+    path = hv.checkpoint_path(str(tmp_path), step=7)
+    hv.save_checkpoint(path, tree, step=7)
+    like = {"params": {"w": jnp.zeros((3, 4)),
+                       "b": jnp.zeros((4,), jnp.bfloat16)},
+            "counts": jnp.zeros((3,), jnp.int32)}
+    restored, step = hv.restore_checkpoint(path, like)
+    assert step == 7
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]),
+                               np.arange(12.0).reshape(3, 4))
+    assert restored["params"]["b"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(restored["counts"]), [1, 2, 3])
+
+
+def test_restore_missing_leaf_raises(hvd, tmp_path):
+    path = str(tmp_path / "c.npz")
+    hv.save_checkpoint(path, {"w": jnp.ones(3)})
+    with pytest.raises(KeyError, match="lacks"):
+        hv.restore_checkpoint(path, {"w": jnp.zeros(3),
+                                     "extra": jnp.zeros(2)})
+
+
+def test_latest_checkpoint_ordering(hvd, tmp_path):
+    assert hv.latest_checkpoint(str(tmp_path)) is None
+    for s in (3, 12, 7):
+        hv.save_checkpoint(hv.checkpoint_path(str(tmp_path), s),
+                           {"x": jnp.ones(1)}, step=s)
+    latest = hv.latest_checkpoint(str(tmp_path))
+    assert latest is not None and latest.endswith("0000000012.npz")
+    _, step = hv.restore_checkpoint(latest, {"x": jnp.zeros(1)})
+    assert step == 12

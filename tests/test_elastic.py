@@ -195,3 +195,20 @@ def test_elastic_network_rendezvous_live(tmp_path):
     assert mutated, out[-4000:]
     assert proc.returncode == 0, out[-4000:]
     assert "final size 2" in out, out[-4000:]
+
+
+def test_discovery_failure_keeps_last_known_hosts(tmp_path):
+    """A crashing/slow discovery script must not read as 'zero hosts'."""
+    import stat as _stat
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    script = tmp_path / "d.sh"
+    script.write_text("#!/bin/sh\ncat %s\n" % (tmp_path / "hosts"))
+    script.chmod(script.stat().st_mode | _stat.S_IEXEC)
+    (tmp_path / "hosts").write_text("a\nb\n")
+    d = HostDiscoveryScript(str(script))
+    assert d.find_available_hosts_and_slots() == {"a": 1, "b": 1}
+    script.write_text("#!/bin/sh\nexit 3\n")  # transient failure
+    assert d.find_available_hosts_and_slots() == {"a": 1, "b": 1}
+    script.write_text("#!/bin/sh\ncat %s\n" % (tmp_path / "hosts"))
+    (tmp_path / "hosts").write_text("a\n")  # genuine scale-down
+    assert d.find_available_hosts_and_slots() == {"a": 1}

@@ -253,3 +253,50 @@ def test_kv_heartbeat_writer_and_age(monkeypatch):
         assert drv._kv_heartbeat_age("w0") is None  # cleaned up
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Pre-launch driver/task probe
+# ---------------------------------------------------------------------------
+
+
+def test_probe_report_fields():
+    from horovod_tpu.run.probe import probe_report
+    r = probe_report()
+    assert r["framework_version"]
+    assert r["jax_version"]
+    assert "127.0.0.1" in r["addresses"]
+
+
+def test_probe_validate_flags_skew():
+    from horovod_tpu.run.probe import DriverProbe
+    p = DriverProbe.__new__(DriverProbe)
+    ok = {"a": {"framework_version": "1", "jax_version": "2", "python": "3.12"},
+          "b": {"framework_version": "1", "jax_version": "2", "python": "3.12"}}
+    p.validate(ok)
+    bad = {**ok, "c": {"framework_version": "9", "jax_version": "2",
+                       "python": "3.12"}}
+    with pytest.raises(RuntimeError, match="framework_version"):
+        p.validate(bad)
+
+
+@pytest.mark.integration
+def test_probe_end_to_end_local():
+    from horovod_tpu.run.probe import DriverProbe
+    drv = DriverProbe()
+    try:
+        env_probe = [drv.spawn_local_probe(w) for w in ("w0", "w1")]
+        reports = drv.collect(["w0", "w1"], timeout_s=120)
+        drv.validate(reports)
+        assert set(reports) == {"w0", "w1"}
+        for p in env_probe:
+            assert p.wait(timeout=30) == 0
+    finally:
+        drv.stop()
+
+
+def test_lightning_estimator_raises_with_guidance():
+    from horovod_tpu.spark import LightningEstimator
+    with pytest.raises((ImportError, NotImplementedError),
+                       match="TorchEstimator"):
+        LightningEstimator(model=None)
