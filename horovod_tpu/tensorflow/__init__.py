@@ -31,6 +31,7 @@ from ..core.exceptions import (  # noqa: F401
 from ..core.process_sets import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, get_process_set,
 )
+from . import elastic  # noqa: F401  (hvd.elastic.TensorFlowKerasState)
 from ..collectives.reduce_op import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
 )

@@ -1,0 +1,93 @@
+"""``horovod_tpu.tensorflow.elastic``: TensorFlowKerasState + run.
+
+Parity with ``horovod/tensorflow/elastic.py::TensorFlowKerasState``: the
+elastic state object for keras models -- ``commit()`` snapshots
+``model.get_weights()`` (+ optimizer variables and scalar attributes) in
+host memory, ``restore()`` rolls back, ``sync()`` broadcasts rank 0's
+weights to everyone after a rescale.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..elastic.run_loop import run  # noqa: F401
+from ..elastic.state import ObjectState, State  # noqa: F401
+
+
+def _optimizer_weights(optimizer) -> List[np.ndarray]:
+    if optimizer is None:
+        return []
+    vs = getattr(optimizer, "variables", None)
+    if vs is None:
+        return []
+    vals = vs() if callable(vs) else vs
+    return [np.asarray(v) for v in vals]
+
+
+def _set_optimizer_weights(optimizer, weights: List[np.ndarray]) -> None:
+    vs = getattr(optimizer, "variables", None)
+    if vs is None:
+        return
+    vals = vs() if callable(vs) else vs
+    for var, w in zip(vals, weights):
+        var.assign(w)
+
+
+class TensorFlowKerasState(State):
+    """Elastic state over a keras model (+ optimizer + scalars)::
+
+        state = hvd.elastic.TensorFlowKerasState(model, optimizer=opt,
+                                                 batch=0, epoch=0)
+    """
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        super().__init__()
+        self.model = model
+        self.optimizer = optimizer
+        self._scalars = list(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._saved: Dict[str, Any] = {}
+        self.commit()
+
+    def commit(self) -> None:
+        self._check_desync({
+            "weights": self.model.get_weights(),
+            "scalars": {k: getattr(self, k) for k in self._scalars}})
+        self._saved = {
+            "weights": [np.copy(w) for w in self.model.get_weights()],
+            "opt": _optimizer_weights(self.optimizer),
+            "scalars": {k: copy.deepcopy(getattr(self, k))
+                        for k in self._scalars},
+        }
+        self._check_host_updates()
+
+    def restore(self) -> None:
+        self.model.set_weights([np.copy(w)
+                                for w in self._saved["weights"]])
+        if self.optimizer is not None and self._saved["opt"]:
+            _set_optimizer_weights(self.optimizer, self._saved["opt"])
+        for k, v in self._saved["scalars"].items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        from ..optim.functions import broadcast_, broadcast_object
+
+        weights = self.model.get_weights()
+        synced = broadcast_(
+            {str(i): w for i, w in enumerate(weights)}, root_rank=0)
+        self.model.set_weights([np.asarray(synced[str(i)])
+                                for i in range(len(weights))])
+        opt = broadcast_object(_optimizer_weights(self.optimizer),
+                               root_rank=0)
+        if self.optimizer is not None and opt:
+            _set_optimizer_weights(self.optimizer, opt)
+        scalars = broadcast_object(
+            {k: getattr(self, k) for k in self._scalars}, root_rank=0)
+        for k, v in scalars.items():
+            setattr(self, k, v)
+        self.commit()

@@ -43,6 +43,13 @@ from ..collectives.reduce_op import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
 )
 from ..collectives.compression import Compression  # noqa: F401
+from . import elastic_state as elastic  # noqa: F401  (hvd.elastic.TorchState)
+# Make `import horovod_tpu.torch.elastic` work as a module path too (the
+# file is elastic_state.py; register the reference-style names under both
+# the real package and the `horovod_tpu.torch` alias).
+import sys as _sys
+_sys.modules[__name__ + ".elastic"] = elastic
+_sys.modules["horovod_tpu.torch.elastic"] = elastic
 from ..collectives import eager as _eager
 
 

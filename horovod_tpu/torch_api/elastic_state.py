@@ -1,0 +1,101 @@
+"""``horovod_tpu.torch.elastic``: TorchState + the elastic run decorator.
+
+Parity with ``horovod/torch/elastic/state.py::TorchState``: registers a
+``torch.nn.Module`` and/or ``torch.optim.Optimizer`` plus arbitrary
+scalars; ``commit()`` snapshots their ``state_dict()`` into host memory,
+``restore()`` rolls back, and ``sync()`` broadcasts rank 0's copy so
+restarted/rescaled workers adopt the survivors' progress.  The broadcast
+rides the XLA collective plane (tensors via ``broadcast_parameters``-
+style leaf broadcast, everything else pickled).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+import numpy as np
+import torch
+
+from ..elastic.run_loop import run  # noqa: F401  (hvd.elastic.run parity)
+from ..elastic.sampler import ElasticSampler  # noqa: F401
+from ..elastic.state import ObjectState, State
+
+
+def _broadcast_state_dict(sd: Dict[str, Any], root_rank: int = 0):
+    """Broadcast a (possibly nested) state_dict: tensor leaves through the
+    collective plane, the rest by pickle."""
+    from ..optim.functions import broadcast_, broadcast_object
+
+    tensors = {k: v for k, v in sd.items() if torch.is_tensor(v)}
+    rest = {k: v for k, v in sd.items() if not torch.is_tensor(v)}
+    out = dict(broadcast_object(rest, root_rank=root_rank))
+    if tensors:
+        names = sorted(tensors)
+        synced = broadcast_({k: tensors[k].detach().cpu().numpy()
+                             for k in names}, root_rank=root_rank)
+        for k in names:
+            t = torch.as_tensor(np.asarray(synced[k]))
+            out[k] = t.to(tensors[k].dtype)
+    return out
+
+
+class TorchState(State):
+    """Elastic state for torch model/optimizer (+ scalar attributes)::
+
+        state = hvd.elastic.TorchState(model=model, optimizer=opt,
+                                       batch=0, epoch=0)
+    """
+
+    def __init__(self, model: torch.nn.Module = None, optimizer=None,
+                 **kwargs):
+        super().__init__()
+        self.model = model
+        self.optimizer = optimizer
+        self._scalars = list(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._saved: Dict[str, Any] = {}
+        self.commit()
+
+    def _snapshot(self) -> Dict[str, Any]:
+        snap: Dict[str, Any] = {"scalars": {
+            k: copy.deepcopy(getattr(self, k)) for k in self._scalars}}
+        if self.model is not None:
+            snap["model"] = {k: v.detach().cpu().clone() if
+                             torch.is_tensor(v) else copy.deepcopy(v)
+                             for k, v in self.model.state_dict().items()}
+        if self.optimizer is not None:
+            snap["optimizer"] = copy.deepcopy(self.optimizer.state_dict())
+        return snap
+
+    def commit(self) -> None:
+        self._check_desync({
+            "model": self.model.state_dict() if self.model is not None
+            else {},
+            "scalars": {k: getattr(self, k) for k in self._scalars}})
+        self._saved = self._snapshot()
+        self._check_host_updates()
+
+    def restore(self) -> None:
+        if self.model is not None and "model" in self._saved:
+            self.model.load_state_dict(self._saved["model"])
+        if self.optimizer is not None and "optimizer" in self._saved:
+            self.optimizer.load_state_dict(self._saved["optimizer"])
+        for k, v in self._saved.get("scalars", {}).items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        from ..optim.functions import broadcast_object
+
+        if self.model is not None:
+            self.model.load_state_dict(
+                _broadcast_state_dict(self.model.state_dict()))
+        if self.optimizer is not None:
+            self.optimizer.load_state_dict(
+                broadcast_object(self.optimizer.state_dict(), root_rank=0))
+        scalars = broadcast_object(
+            {k: getattr(self, k) for k in self._scalars}, root_rank=0)
+        for k, v in scalars.items():
+            setattr(self, k, v)
+        self.commit()

@@ -74,3 +74,25 @@ def test_keras_callbacks(hvd_tf):
     model.fit(x, y, epochs=2, batch_size=4, verbose=0, callbacks=cbs)
     lr = float(model.optimizer.learning_rate.numpy())
     assert lr == pytest.approx(0.1)
+
+
+def test_tf_keras_state_commit_restore_sync(hvd):
+    htf = tfhvd
+    model = tf.keras.Sequential([tf.keras.layers.Input((4,)),
+                                 tf.keras.layers.Dense(2)])
+    opt = tf.keras.optimizers.SGD(0.1)
+    state = htf.elastic.TensorFlowKerasState(model, optimizer=opt, epoch=1)
+    w0 = [np.copy(w) for w in model.get_weights()]
+    model.set_weights([w + 1.0 for w in model.get_weights()])
+    state.epoch = 9
+    state.restore()
+    for a, b in zip(model.get_weights(), w0):
+        np.testing.assert_allclose(a, b)
+    assert state.epoch == 1
+    model.set_weights([w + 2.0 for w in w0])
+    state.epoch = 2
+    state.commit()
+    state.sync()  # single-process: round-trips through the broadcast plane
+    for a, b in zip(model.get_weights(), w0):
+        np.testing.assert_allclose(a, b + 2.0)
+    assert state.epoch == 2

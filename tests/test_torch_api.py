@@ -185,3 +185,48 @@ def test_native_cycle_batching_fuses_grads(hvd_t):
     # Fusion actually happened: fewer dispatches than tensors.
     assert len(calls) < sum(calls)
     assert losses[-1] < losses[0]
+
+
+def test_torch_state_commit_restore_sync(hvd):
+    ht = thvd
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    state = ht.elastic.TorchState(model=model, optimizer=opt, batch=3)
+    w0 = model.weight.detach().clone()
+    # Mutate everything, then roll back.
+    with torch.no_grad():
+        model.weight.add_(1.0)
+    state.batch = 99
+    state.restore()
+    assert torch.allclose(model.weight, w0)
+    assert state.batch == 3
+    # Train a step so optimizer state exists, commit, perturb, restore.
+    loss = model(torch.ones(2, 4)).sum()
+    loss.backward()
+    opt.step()
+    state.batch = 4
+    state.commit()
+    w1 = model.weight.detach().clone()
+    with torch.no_grad():
+        model.weight.mul_(0.0)
+    state.restore()
+    assert torch.allclose(model.weight, w1)
+    # sync() broadcasts rank 0's copy (single-process: a no-op round trip)
+    state.sync()
+    assert torch.allclose(model.weight, w1)
+    assert state.batch == 4
+
+
+def test_torch_state_elastic_run_decorator(hvd):
+    ht = thvd
+    model = torch.nn.Linear(2, 1)
+    state = ht.elastic.TorchState(model=model, batch=0)
+
+    @ht.elastic.run
+    def train(st):
+        while st.batch < 3:
+            st.batch += 1
+            st.commit()
+        return st.batch
+
+    assert train(state) == 3
