@@ -30,13 +30,18 @@ def timed_training(step, params, opt_state, data, steps: int,
     import jax
 
     params, opt_state, loss = step(params, opt_state, data)  # compile
-    jax.block_until_ready(loss)
+    float(loss)  # device->host fetch.  On the axon-tunnelled TPU
+    # platform (only), block_until_ready can return before execution
+    # completes -- measured in the repo-root bench.py (see its module
+    # docstring); a value fetch is the portable fence.  On CPU/standard
+    # backends block_until_ready is a correct fence (the eager collective
+    # plane relies on it).
     t0 = time.perf_counter()
     losses = []
     for _ in range(steps):
         params, opt_state, loss = step(params, opt_state, data)
         losses.append(loss)  # device array; no host sync in the timed loop
-    jax.block_until_ready(loss)
+    float(loss)  # forces the whole step chain (see above)
     dt = time.perf_counter() - t0
     if rank == 0:
         import horovod_tpu as hvd

@@ -1,3 +1,24 @@
 """Launcher (horovodrun analogue): see horovod_tpu/run/launch.py."""
 
 from .launch import run_command, worker_env, check_build, free_port  # noqa: F401
+
+
+def run(func, args=(), kwargs=None, np=1, cpu=False, slots=1,
+        use_ray=False, verbose=0):
+    """Programmatic launcher (reference ``horovod.run.run()`` API).
+
+    Runs ``func(*args, **kwargs)`` on ``np`` worker processes with the
+    framework env wired (coordinator, ranks); returns the rank-ordered
+    results.  ``cpu=True`` forces the XLA:CPU backend per worker (the
+    local test mesh); on a TPU pod each worker VM's agent calls this with
+    its local slot count instead.
+    """
+    from ..ray import RayExecutor
+
+    ex = RayExecutor(num_workers=np, cpu=cpu, use_ray=use_ray,
+                     slots_per_worker=slots)
+    ex.start()
+    try:
+        return ex.run(func, args=args, kwargs=kwargs or {})
+    finally:
+        ex.shutdown()

@@ -28,11 +28,27 @@ def _optimizer_weights(optimizer) -> List[np.ndarray]:
     return [np.asarray(v) for v in vals]
 
 
-def _set_optimizer_weights(optimizer, weights: List[np.ndarray]) -> None:
+def _set_optimizer_weights(optimizer, weights: List[np.ndarray],
+                           model=None) -> None:
+    if optimizer is None or not weights:
+        return
     vs = getattr(optimizer, "variables", None)
     if vs is None:
         return
     vals = vs() if callable(vs) else vs
+    if len(vals) != len(weights) and model is not None:
+        # A freshly joined worker's optimizer may not be built yet (no
+        # slot variables); build against the model so every broadcast
+        # variable has a home instead of being silently zip-truncated.
+        build = getattr(optimizer, "build", None)
+        if callable(build):
+            build(model.trainable_variables)
+            vals = vs() if callable(vs) else vs
+    if len(vals) != len(weights):
+        raise RuntimeError(
+            f"optimizer variable count mismatch in elastic sync: local "
+            f"{len(vals)} vs broadcast {len(weights)} -- the optimizers "
+            "are structured differently across ranks")
     for var, w in zip(vals, weights):
         var.assign(w)
 
@@ -55,11 +71,15 @@ class TensorFlowKerasState(State):
         self.commit()
 
     def commit(self) -> None:
+        # get_weights() already returns fresh host copies; materialize
+        # once and reuse for both the (usually disabled) desync check and
+        # the snapshot -- commit runs at every batch boundary.
+        weights = self.model.get_weights()
         self._check_desync({
-            "weights": self.model.get_weights(),
+            "weights": weights,
             "scalars": {k: getattr(self, k) for k in self._scalars}})
         self._saved = {
-            "weights": [np.copy(w) for w in self.model.get_weights()],
+            "weights": weights,
             "opt": _optimizer_weights(self.optimizer),
             "scalars": {k: copy.deepcopy(getattr(self, k))
                         for k in self._scalars},
@@ -84,8 +104,7 @@ class TensorFlowKerasState(State):
                                 for i in range(len(weights))])
         opt = broadcast_object(_optimizer_weights(self.optimizer),
                                root_rank=0)
-        if self.optimizer is not None and opt:
-            _set_optimizer_weights(self.optimizer, opt)
+        _set_optimizer_weights(self.optimizer, opt, model=self.model)
         scalars = broadcast_object(
             {k: getattr(self, k) for k in self._scalars}, root_rank=0)
         for k, v in scalars.items():

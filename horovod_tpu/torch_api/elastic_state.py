@@ -32,8 +32,18 @@ def _broadcast_state_dict(sd: Dict[str, Any], root_rank: int = 0):
     out = dict(broadcast_object(rest, root_rank=root_rank))
     if tensors:
         names = sorted(tensors)
-        synced = broadcast_({k: tensors[k].detach().cpu().numpy()
-                             for k in names}, root_rank=root_rank)
+
+        def to_np(t):
+            t = t.detach().cpu()
+            if t.dtype in (torch.bfloat16, torch.float8_e4m3fn,
+                           torch.float8_e5m2):
+                # numpy cannot represent these; upcast losslessly for the
+                # wire -- the receive side casts back to the local dtype.
+                t = t.to(torch.float32)
+            return t.numpy()
+
+        synced = broadcast_({k: to_np(tensors[k]) for k in names},
+                            root_rank=root_rank)
         for k in names:
             t = torch.as_tensor(np.asarray(synced[k]))
             out[k] = t.to(tensors[k].dtype)

@@ -300,3 +300,15 @@ def test_lightning_estimator_raises_with_guidance():
     with pytest.raises((ImportError, NotImplementedError),
                        match="TorchEstimator"):
         LightningEstimator(model=None)
+
+
+def _identity_worker():
+    return (os.environ["HOROVOD_RANK"], os.environ["HOROVOD_SIZE"])
+
+
+@pytest.mark.integration
+def test_programmatic_run_api():
+    """horovod.run.run() parity: launch a function on N procs."""
+    from horovod_tpu.run import run as hvd_run
+    results = hvd_run(_identity_worker, np=2, cpu=True)
+    assert results == [("0", "2"), ("1", "2")]

@@ -230,3 +230,13 @@ def test_torch_state_elastic_run_decorator(hvd):
         return st.batch
 
     assert train(state) == 3
+
+
+def test_torch_state_sync_bf16_model(hvd):
+    ht = thvd
+    model = torch.nn.Linear(3, 2).to(torch.bfloat16)
+    state = ht.elastic.TorchState(model=model, batch=0)
+    w = model.weight.detach().clone()
+    state.sync()  # must not crash on the bf16 -> numpy wire conversion
+    assert model.weight.dtype == torch.bfloat16
+    assert torch.allclose(model.weight.float(), w.float())
