@@ -27,8 +27,6 @@ def timed_training(step, params, opt_state, data, steps: int,
     ``step(params, opt_state, data) -> (params, opt_state, loss)``.
     Returns the final (params, opt_state).
     """
-    import jax
-
     params, opt_state, loss = step(params, opt_state, data)  # compile
     float(loss)  # device->host fetch.  On the axon-tunnelled TPU
     # platform (only), block_until_ready can return before execution

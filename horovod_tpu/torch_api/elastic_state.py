@@ -33,12 +33,18 @@ def _broadcast_state_dict(sd: Dict[str, Any], root_rank: int = 0):
     if tensors:
         names = sorted(tensors)
 
+        # numpy cannot represent these; upcast losslessly for the wire
+        # (the receive side casts back).  getattr: float8 dtypes only
+        # exist in torch >= 2.1.
+        no_numpy = tuple(
+            dt for dt in (torch.bfloat16,
+                          getattr(torch, "float8_e4m3fn", None),
+                          getattr(torch, "float8_e5m2", None))
+            if dt is not None)
+
         def to_np(t):
             t = t.detach().cpu()
-            if t.dtype in (torch.bfloat16, torch.float8_e4m3fn,
-                           torch.float8_e5m2):
-                # numpy cannot represent these; upcast losslessly for the
-                # wire -- the receive side casts back to the local dtype.
+            if t.dtype in no_numpy:
                 t = t.to(torch.float32)
             return t.numpy()
 
