@@ -79,6 +79,19 @@ def axis_index(axes: Optional[AxisSpec] = None):
     return idx
 
 
+def _divide_in_dtype(y, n: int):
+    """Average's division, in the tensor's own dtype.
+
+    Integer tensors use lax.div (C-style truncation toward zero -- the
+    reference reduces in the tensor's dtype); true division would promote
+    to float and change the output dtype.  // is NOT equivalent: it
+    floors, so negative sums would round away from zero.
+    """
+    if jnp.issubdtype(y.dtype, jnp.integer):
+        return lax.div(y, jnp.asarray(n, dtype=y.dtype))
+    return y / jnp.asarray(n, dtype=y.dtype)
+
+
 def allreduce(x,
               op: ReduceOp = Average,
               *,
@@ -107,7 +120,7 @@ def allreduce(x,
         if op is Average:
             n = len(members) if members is not None else \
                 math.prod(lax.axis_size(a) for a in axes)
-            y = y / jnp.asarray(n, dtype=y.dtype)
+            y = _divide_in_dtype(y, n)
     elif op in (Min, Max):
         if mask is not None:
             if jnp.issubdtype(x.dtype, jnp.integer):
@@ -123,7 +136,10 @@ def allreduce(x,
         if mask is not None:
             x = jnp.where(mask, x, jnp.ones((), x.dtype))
         g = lax.all_gather(x, axes, axis=0)
-        y = jnp.prod(g, axis=0)
+        # dtype= keeps the input dtype: jnp.prod would promote small ints
+        # to a 32-bit accumulator (reference collectives reduce in the
+        # tensor's own dtype, wraparound included).
+        y = jnp.prod(g, axis=0, dtype=g.dtype)
     elif op is Adasum:
         from ..adasum.xla import adasum_allreduce
         if len(axes) != 1 or members is not None:
@@ -244,7 +260,7 @@ def reducescatter(x,
         y = lax.psum_scatter(y, a, scatter_dimension=scatter_axis, tiled=True)
     if op is Average:
         n = math.prod(lax.axis_size(a) for a in axes)
-        y = y / jnp.asarray(n, dtype=y.dtype)
+        y = _divide_in_dtype(y, n)
     return y
 
 

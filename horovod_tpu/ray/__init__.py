@@ -46,10 +46,12 @@ class RayExecutor:
     """
 
     def __init__(self, num_workers: int, cpu: bool = False,
-                 use_ray: Optional[bool] = None, slots_per_worker: int = 1):
+                 use_ray: Optional[bool] = None, slots_per_worker: int = 1,
+                 extra_env: Optional[dict] = None):
         self.num_workers = num_workers
         self.cpu = cpu
         self.slots = slots_per_worker
+        self.extra_env = dict(extra_env or {})
         if use_ray is None:
             try:
                 import ray  # noqa: F401
@@ -92,9 +94,10 @@ class RayExecutor:
             raise RuntimeError("call start() first")
         kwargs = kwargs or {}
         port = _free_port()
-        envs = [worker_env(rank=i, size=self.num_workers,
-                           coordinator="127.0.0.1", port=port,
-                           cpu=self.cpu, slots=self.slots)
+        envs = [{**self.extra_env,
+                 **worker_env(rank=i, size=self.num_workers,
+                              coordinator="127.0.0.1", port=port,
+                              cpu=self.cpu, slots=self.slots)}
                 for i in range(self.num_workers)]
         if self.use_ray:
             import ray

@@ -5,7 +5,6 @@ from .launch import run_command, worker_env, check_build, free_port  # noqa: F40
 
 def run(func, args=(), kwargs=None, np=1, cpu=False, slots=1,
         use_ray=False, verbose=0):
-    # verbose threads into worker logging below (HOROVOD_LOG_LEVEL).
     """Programmatic launcher (reference ``horovod.run.run()`` API).
 
     Runs ``func(*args, **kwargs)`` on ``np`` worker processes with the
@@ -14,15 +13,13 @@ def run(func, args=(), kwargs=None, np=1, cpu=False, slots=1,
     local test mesh); on a TPU pod each worker VM's agent calls this with
     its local slot count instead.
     """
-    import os
-
     from ..ray import RayExecutor
 
-    if verbose:
-        os.environ.setdefault("HOROVOD_LOG_LEVEL",
-                              "debug" if verbose > 1 else "info")
+    # verbose reaches workers through their env dict (works for both the
+    # local-process and ray-actor backends; no process-global mutation).
+    extra = {"HOROVOD_LOG_LEVEL": "debug" if verbose > 1 else "info"}         if verbose else {}
     ex = RayExecutor(num_workers=np, cpu=cpu, use_ray=use_ray,
-                     slots_per_worker=slots)
+                     slots_per_worker=slots, extra_env=extra)
     ex.start()
     try:
         return ex.run(func, args=args, kwargs=kwargs or {})

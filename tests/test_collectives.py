@@ -195,3 +195,105 @@ def test_in_step_collectives_inside_shard_map(hvd, n_devices):
     np.testing.assert_allclose(np.asarray(s[0]),
                                np.sum(np.asarray(x), axis=0), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(b[4]), np.asarray(x[2]), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Exhaustive op x dtype sweep (reference test_torch.py's coverage model)
+# ---------------------------------------------------------------------------
+
+_SWEEP_DTYPES = [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int32,
+                 jnp.uint8]
+
+
+def _np_ref(op, rows):
+    """In-dtype sequential reduction: the implementation reduces in the
+    tensor's own dtype (wraparound/overflow included), so the expectation
+    must too -- an exact float64 reference diverges once products wrap."""
+    import numpy as _np
+    f = {"sum": _np.add, "min": _np.minimum, "max": _np.maximum,
+         "prod": _np.multiply}[op]
+    acc = rows[0]
+    for r in rows[1:]:
+        acc = f(acc, r).astype(rows.dtype)
+    return acc
+
+
+@pytest.mark.parametrize("dtype", _SWEEP_DTYPES)
+@pytest.mark.parametrize("op_name,op", [
+    ("sum", hv.Sum), ("min", hv.Min), ("max", hv.Max), ("prod", hv.Product),
+])
+def test_allreduce_op_dtype_sweep(hvd, n_devices, dtype, op_name, op):
+    rng = np.random.RandomState(7)
+    rows = rng.randint(1, 4, size=(n_devices, 2, 3)).astype(np.float64)
+    x = jnp.asarray(rows, dtype)
+    y = hvd.allreduce(x, op, name=f"sweep_{op_name}_{jnp.dtype(dtype).name}")
+    assert y.dtype == jnp.dtype(dtype)
+    expect = _np_ref(op_name, np.asarray(x))
+    for r in range(n_devices):
+        np.testing.assert_allclose(np.asarray(y[r], np.float64),
+                                   np.asarray(expect, np.float64),
+                                   rtol=2e-2)
+
+
+@pytest.mark.parametrize("dtype", _SWEEP_DTYPES)
+def test_allgather_broadcast_reducescatter_alltoall_dtype_sweep(
+        hvd, n_devices, dtype):
+    n = n_devices
+    rng = np.random.RandomState(3)
+    rows = rng.randint(0, 5, size=(n, n, 2)).astype(np.float64)
+    x = jnp.asarray(rows, dtype)
+    name = jnp.dtype(dtype).name
+
+    g = hvd.allgather(x[:, :1], name=f"swp_ag_{name}")
+    assert g.dtype == x.dtype and g.shape == (n, n, 2)
+    np.testing.assert_allclose(np.asarray(g[0], np.float64),
+                               np.asarray(x[:, 0], np.float64))
+
+    b = hvd.broadcast(x, root_rank=1, name=f"swp_bc_{name}")
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(b[r], np.float64),
+                                   np.asarray(x[1], np.float64))
+
+    rs = hvd.reducescatter(x, hv.Sum, name=f"swp_rs_{name}")
+    expect = np.asarray(x, np.float64).sum(0)  # [n, 2] summed over ranks
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(rs[r], np.float64).ravel(),
+                                   expect[r].ravel(), rtol=2e-2)
+
+    a2a = hvd.alltoall(x, name=f"swp_a2a_{name}")
+    for r in range(n):
+        np.testing.assert_allclose(np.asarray(a2a[r], np.float64),
+                                   np.asarray(x[:, r], np.float64))
+
+
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.uint8])
+def test_allreduce_average_int_truncates_in_dtype(hvd, n_devices, dtype):
+    """Integer Average keeps the dtype and truncates (reference
+    semantics), rather than promoting to float."""
+    rows = np.tile(np.array([[1, 2, 7]]), (n_devices, 1))
+    rows[0] = [2, 3, 8]  # sums: n+1, 2n+1, 7n+1 -> avg truncates
+    x = jnp.asarray(rows, dtype)
+    y = hvd.allreduce(x, hvd.Average, name=f"int_avg_{jnp.dtype(dtype).name}")
+    assert y.dtype == jnp.dtype(dtype)
+    n = n_devices
+    expect = np.array([n + 1, 2 * n + 1, 7 * n + 1]) // n
+    np.testing.assert_array_equal(np.asarray(y[0], np.int64), expect)
+
+
+def test_allreduce_average_negative_int_truncates_toward_zero(hvd,
+                                                              n_devices):
+    """C-style truncation, not floor: sum -(n-1) over n ranks -> 0."""
+    rows = np.zeros((n_devices, 1), np.int64)
+    rows[: n_devices - 1] = -1  # sum = -(n-1), |sum| < n
+    x = jnp.asarray(rows, jnp.int32)
+    y = hvd.allreduce(x, hvd.Average, name="neg_int_avg")
+    assert y.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(y[0]), [0])
+
+
+def test_reducescatter_average_int_keeps_dtype(hvd, n_devices):
+    n = n_devices
+    x = jnp.asarray(np.full((n, n, 2), 3), jnp.int32)
+    y = hvd.reducescatter(x, hvd.Average, name="rs_int_avg")
+    assert y.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(y[0]).ravel()[:2], [3, 3])
