@@ -86,6 +86,15 @@ def main():
     ips_per_chip = STEPS * global_batch / dt / n
     # Effective allreduce payload per step: fp32 grads of every param.
     grad_bytes = sum(v.size * 4 for v in jax.tree.leaves(params))
+    # Honest bus-BW bound (SURVEY.md section 7 hard part 4): each step
+    # moves >= 2*(n-1)/n * grad_bytes per chip for a ring allreduce; on
+    # one chip the collective is a no-op, so report the algorithmic bound
+    # only when it means something.
+    if n > 1:
+        bus = 2 * (n - 1) / n * grad_bytes * STEPS / dt
+        print(f"# allreduce bus BW >= {bus/2**30:.2f} GiB/s/chip "
+              "(lower bound from step time; includes compute overlap)",
+              file=sys.stderr)
     print(f"# {STEPS} steps in {dt:.2f}s; grad payload "
           f"{grad_bytes/2**20:.1f} MiB/step", file=sys.stderr)
     print(json.dumps({

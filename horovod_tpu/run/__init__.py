@@ -13,11 +13,16 @@ def run(func, args=(), kwargs=None, np=1, cpu=False, slots=1,
     local test mesh); on a TPU pod each worker VM's agent calls this with
     its local slot count instead.
     """
+    import os
+
     from ..ray import RayExecutor
 
     # verbose reaches workers through their env dict (works for both the
     # local-process and ray-actor backends; no process-global mutation).
-    extra = {"HOROVOD_LOG_LEVEL": "debug" if verbose > 1 else "info"}         if verbose else {}
+    # An explicit user HOROVOD_LOG_LEVEL wins over the verbose default.
+    extra = {}
+    if verbose and "HOROVOD_LOG_LEVEL" not in os.environ:
+        extra = {"HOROVOD_LOG_LEVEL": "debug" if verbose > 1 else "info"}
     ex = RayExecutor(num_workers=np, cpu=cpu, use_ray=use_ray,
                      slots_per_worker=slots, extra_env=extra)
     ex.start()

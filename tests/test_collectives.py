@@ -209,9 +209,8 @@ def _np_ref(op, rows):
     """In-dtype sequential reduction: the implementation reduces in the
     tensor's own dtype (wraparound/overflow included), so the expectation
     must too -- an exact float64 reference diverges once products wrap."""
-    import numpy as _np
-    f = {"sum": _np.add, "min": _np.minimum, "max": _np.maximum,
-         "prod": _np.multiply}[op]
+    f = {"sum": np.add, "min": np.minimum, "max": np.maximum,
+         "prod": np.multiply}[op]
     acc = rows[0]
     for r in rows[1:]:
         acc = f(acc, r).astype(rows.dtype)

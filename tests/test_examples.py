@@ -1,0 +1,42 @@
+"""Smoke-run every example workload on the CPU mesh (reference CI runs
+its examples per framework; BASELINE.json names these five configs)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable] + args, env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    return out.stdout
+
+
+@pytest.mark.integration
+def test_bert_pretrain_example_cpu():
+    out = _run([os.path.join(REPO, "examples", "bert_pretrain.py"),
+                "--cpu-devices", "4", "--steps", "6"])
+    assert "final loss" in out
+
+
+@pytest.mark.integration
+def test_llama_lora_example_cpu():
+    out = _run([os.path.join(REPO, "examples", "llama_lora.py"),
+                "--cpu-devices", "4", "--steps", "6"])
+    assert "final loss" in out
+
+
+@pytest.mark.integration
+def test_synthetic_benchmark_resnet50_cpu():
+    out = _run([os.path.join(REPO, "examples", "synthetic_benchmark.py"),
+                "--model", "resnet50", "--cpu-devices", "4",
+                "--image-size", "64", "--batch-size", "2",
+                "--num-iters", "2", "--fp32"])
+    assert "images/s/chip" in out
