@@ -114,87 +114,88 @@ def test_commit_raises_hosts_updated(tmp_path, hvd):
     assert s.x == 42  # commit snapshots BEFORE the interrupt check
 
 
-@pytest.mark.integration
-def test_elastic_scale_down_live(tmp_path):
-    """3 workers -> discovery drops one -> survivors re-rendezvous at size
-    2 and finish."""
+def _write_hosts(path, content):
+    """Atomic rewrite: the driver polls `cat hosts.txt` every second, and a
+    read of a truncated-but-unwritten file is a legal 'zero hosts' listing
+    that would abort the job below min-np."""
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(content)
+    os.replace(tmp, str(path))
+
+
+def _run_elastic_live(tmp_path, initial, mutated, expect_final, target=40,
+                      extra_args=()):
+    """Shared live-rescale harness: start the elastic launcher, mutate the
+    discovery listing once training demonstrably progresses, assert the
+    run finishes at the expected final size."""
+    import threading
+
     hosts = tmp_path / "hosts.txt"
-    hosts.write_text("a\nb\nc\n")
+    _write_hosts(hosts, initial)
     disc = tmp_path / "disc.sh"
     disc.write_text(f"#!/bin/sh\ncat {hosts}\n")
     disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
 
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["ELASTIC_TARGET_BATCHES"] = "60"
+    env["ELASTIC_TARGET_BATCHES"] = str(target)
     env["ELASTIC_BATCH_DELAY_S"] = "0.4"
     proc = subprocess.Popen(
         [sys.executable, "-m", "horovod_tpu.run",
-         "--host-discovery-script", str(disc), "--min-np", "2", "--cpu",
-         sys.executable, os.path.join(REPO, "examples", "elastic_train.py")],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+         "--host-discovery-script", str(disc), "--min-np", "2",
+         *extra_args, "--cpu",
+         sys.executable, os.path.join(REPO, "examples",
+                                      "elastic_train.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    # Watchdog: readline blocks, so a silently wedged child would hang the
+    # test forever; killing the child makes the reader see EOF.
+    watchdog = threading.Timer(240, proc.kill)
+    watchdog.start()
     lines = []
+    mutated_flag = False
     try:
-        # Mutate discovery only once training demonstrably progresses, so
-        # the rescale lands mid-run regardless of machine load.
-        deadline = time.time() + 240
-        mutated = False
         for line in proc.stdout:
             lines.append(line)
-            if not mutated and " batch 5 " in line:
-                hosts.write_text("a\nb\n")  # drop host c mid-run
-                mutated = True
-            if time.time() > deadline:
-                raise TimeoutError("no progress")
+            if not mutated_flag and " batch 5 " in line:
+                _write_hosts(hosts, mutated)
+                mutated_flag = True
         proc.wait(timeout=60)
     finally:
+        watchdog.cancel()
         if proc.poll() is None:
             proc.kill()
+        proc.wait(timeout=30)
+        proc.stdout.close()
     out = "".join(lines)
-    assert mutated, out[-4000:]
+    assert mutated_flag, out[-4000:]
     assert proc.returncode == 0, out[-4000:]
-    assert "final size 2" in out, out[-4000:]
+    assert f"final size {expect_final}" in out, out[-4000:]
+
+
+@pytest.mark.integration
+def test_elastic_scale_down_live(tmp_path):
+    """3 workers -> discovery drops one -> survivors re-rendezvous at size
+    2 and finish."""
+    _run_elastic_live(tmp_path, "a\nb\nc\n", "a\nb\n", expect_final=2,
+                      target=60)
 
 
 @pytest.mark.integration
 def test_elastic_network_rendezvous_live(tmp_path):
     """Same scale-down flow, but membership + heartbeats ride the
     HMAC-signed HTTP KV rendezvous instead of the assignment file."""
-    hosts = tmp_path / "hosts.txt"
-    hosts.write_text("a\nb\nc\n")
-    disc = tmp_path / "disc.sh"
-    disc.write_text(f"#!/bin/sh\ncat {hosts}\n")
-    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+    _run_elastic_live(tmp_path, "a\nb\nc\n", "a\nb\n", expect_final=2,
+                      extra_args=("--network-rendezvous",
+                                  "--heartbeat-timeout", "30"))
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["ELASTIC_TARGET_BATCHES"] = "40"
-    env["ELASTIC_BATCH_DELAY_S"] = "0.4"
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "horovod_tpu.run",
-         "--host-discovery-script", str(disc), "--min-np", "2",
-         "--network-rendezvous", "--heartbeat-timeout", "30", "--cpu",
-         sys.executable, os.path.join(REPO, "examples", "elastic_train.py")],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-    lines = []
-    try:
-        deadline = time.time() + 240
-        mutated = False
-        for line in proc.stdout:
-            lines.append(line)
-            if not mutated and " batch 5 " in line:
-                hosts.write_text("a\nb\n")
-                mutated = True
-            if time.time() > deadline:
-                raise TimeoutError("no progress")
-        proc.wait(timeout=60)
-    finally:
-        if proc.poll() is None:
-            proc.kill()
-    out = "".join(lines)
-    assert mutated, out[-4000:]
-    assert proc.returncode == 0, out[-4000:]
-    assert "final size 2" in out, out[-4000:]
+
+@pytest.mark.integration
+def test_elastic_scale_up_live(tmp_path):
+    """2 workers -> discovery adds a third -> everyone re-rendezvouses at
+    size 3 and finishes together (newcomer adopts survivors' progress)."""
+    _run_elastic_live(tmp_path, "a\nb\n", "a\nb\nc\n", expect_final=3)
 
 
 def test_discovery_failure_keeps_last_known_hosts(tmp_path):
