@@ -37,6 +37,21 @@ def main():
         assert np.allclose(got, expect), (got, expect)
     print(f"rank {rank}: allreduce OK -> {got[0, 0]}")
 
+    if jax.process_count() > 1:
+        # Ragged allgather: every DEVICE rank contributes (g+1) rows of
+        # value g (works with --slots > 1: one array per local rank).
+        s = jax.local_device_count()
+        gids = [jax.process_index() * s + i for i in range(s)]
+        got_v = hvd.allgatherv(
+            [np.full((g + 1, 2), float(g), np.float32) for g in gids])
+        world = n  # hvd.size() == total device ranks
+        assert got_v.shape == (world * (world + 1) // 2, 2), got_v.shape
+        off = 0
+        for g in range(world):
+            assert np.allclose(got_v[off:off + g + 1], float(g)), got_v
+            off += g + 1
+        print(f"rank {rank}: allgatherv OK {got_v.shape}")
+
     val = hvd.broadcast_object({"from": rank, "tag": 42}, root_rank=0)
     assert val["tag"] == 42 and val["from"] == 0, val
     print(f"rank {rank}: broadcast_object OK")

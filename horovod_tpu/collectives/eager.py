@@ -285,10 +285,70 @@ def grouped_allreduce(xs: Sequence, op: ReduceOp = Average, *, name=None,
 def allgather(x, *, name=None, process_set=None):
     """Each rank contributes its slice; all receive the concatenation.
 
-    Rank-stacked input ``[n, d0, ...]`` -> output ``[n, n*d0, ...]``."""
+    Rank-stacked input ``[n, d0, ...]`` -> output ``[n, n*d0, ...]``.
+    First dimensions must match; ragged inputs go through
+    :func:`allgatherv` (the reference's ``hvd.allgather`` supports both
+    through one entry point because its negotiation already exchanges
+    sizes; here the ragged path is explicit)."""
     def per_rank(t):
         return _ops.allgather(t, axes=(HVD_AXIS,), axis=0)
     return _run("allgather", x, name, process_set, per_rank, "gather")
+
+
+def allgather_value(a, *, name=None, process_set=None) -> np.ndarray:
+    """Framework-shim helper: gather ONE per-process value (replicated
+    across this process's local ranks) with ragged first dims allowed.
+    Single-controller mode treats every rank as holding ``a``."""
+    k = local_rank_count(process_set)
+    return allgatherv([np.asarray(a)] * k, name=name,
+                      process_set=process_set)
+
+
+def allgatherv(arrs, *, name=None, process_set=None) -> np.ndarray:
+    """Ragged allgather: per-rank arrays whose FIRST dims differ.
+
+    Reference semantics (``MPIAllgather``/``NCCLAllgather`` with unequal
+    first dims -- the reference gathers sizes during negotiation, then
+    runs a gatherv): sizes are exchanged first, data is padded to the max
+    and gathered, and every rank receives the dim-0 concatenation in rank
+    order as a HOST array (ragged shapes cannot live on-device under
+    XLA's static shapes).
+
+    ``arrs``: single process -- a sequence of per-rank arrays (length =
+    set size); multi-process -- this process's local per-rank sequence
+    (usually one array, which may be passed bare).
+    """
+    ps = _ps.get_process_set(process_set)
+    if hasattr(arrs, "shape"):  # a bare array (ndarray / jax.Array)
+        arrs = [arrs]
+    arrs = [np.asarray(a) for a in arrs]
+    k = local_rank_count(ps)
+    if len(arrs) != k:
+        raise ValueError(
+            f"allgatherv takes one array per local rank: expected {k}, "
+            f"got {len(arrs)}")
+    tail_shapes = {a.shape[1:] for a in arrs}
+    dtypes = {a.dtype for a in arrs}
+    if len(tail_shapes) > 1 or len(dtypes) > 1:
+        raise ValueError("allgatherv arrays may differ only in dim 0; got "
+                         f"shapes {[a.shape for a in arrs]}, "
+                         f"dtypes {sorted(map(str, dtypes))}")
+    # Phase 1: exchange sizes (the reference's negotiation does this).
+    sizes = np.asarray([[a.shape[0]] for a in arrs], np.int32)
+    all_sizes = local_result(
+        allgather(sizes, name=f"{name or 'allgatherv'}.sizes",
+                  process_set=ps))[0].ravel()
+    max_len = int(all_sizes.max())
+    # Phase 2: pad to the max and gather (one static-shape collective).
+    tail = arrs[0].shape[1:]
+    padded = np.zeros((k, max_len) + tail, arrs[0].dtype)
+    for i, a in enumerate(arrs):
+        padded[i, :a.shape[0]] = a
+    g = allgather(padded, name=f"{name or 'allgatherv'}.data",
+                  process_set=ps)
+    rows = local_result(g)[0].reshape((ps.size(), max_len) + tail)
+    return np.concatenate([rows[r, :all_sizes[r]]
+                           for r in range(ps.size())], axis=0)
 
 
 def broadcast(x, root_rank: int = 0, *, name=None, process_set=None):

@@ -90,9 +90,11 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
 
 def allgather(tensor, name: Optional[str] = None,
               process_set=None) -> tf.Tensor:
-    out = _eager.allgather(_to_stack(tensor), name=name,
-                           process_set=process_set)
-    return _from_row(out, tensor)
+    """Reference parity: first dims MAY differ across ranks (sizes are
+    exchanged first, like the reference's negotiation)."""
+    out = _eager.allgather_value(np.asarray(tensor), name=name,
+                                 process_set=process_set)
+    return tf.convert_to_tensor(out)
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,

@@ -112,9 +112,14 @@ def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
 
 def allgather(tensor: torch.Tensor, name: Optional[str] = None,
               process_set=None) -> torch.Tensor:
-    out = _eager.allgather(_to_stack(tensor), name=name,
-                           process_set=process_set)
-    return _from_row(out, tensor)
+    """Reference parity: first dimensions MAY differ across ranks (the
+    reference's negotiation exchanges sizes; here the ragged-capable
+    allgatherv path does the same size exchange)."""
+    out = _eager.allgather_value(tensor.detach().cpu().numpy(),
+                                 name=name, process_set=process_set)
+    # out is a fresh process-owned ndarray (np.concatenate result): no
+    # defensive copy needed.
+    return torch.from_numpy(out).to(tensor.dtype)
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int,

@@ -296,3 +296,23 @@ def test_reducescatter_average_int_keeps_dtype(hvd, n_devices):
     y = hvd.reducescatter(x, hvd.Average, name="rs_int_avg")
     assert y.dtype == jnp.int32
     np.testing.assert_array_equal(np.asarray(y[0]).ravel()[:2], [3, 3])
+
+
+def test_allgatherv_ragged_single_process(hvd, n_devices):
+    """Variable first dims (reference hvd.allgather semantics)."""
+    rng = np.random.RandomState(0)
+    arrs = [rng.randn(r + 1, 3).astype(np.float32)
+            for r in range(n_devices)]
+    out = hv.allgatherv(arrs, name="agv")
+    assert out.shape == (sum(r + 1 for r in range(n_devices)), 3)
+    off = 0
+    for r in range(n_devices):
+        np.testing.assert_allclose(out[off:off + r + 1], arrs[r])
+        off += r + 1
+
+
+def test_allgatherv_rejects_mismatched_tails(hvd, n_devices):
+    arrs = [np.zeros((2, 3), np.float32)] * (n_devices - 1) + \
+        [np.zeros((2, 4), np.float32)]
+    with pytest.raises(ValueError, match="dim 0"):
+        hv.allgatherv(arrs)

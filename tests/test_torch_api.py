@@ -240,3 +240,11 @@ def test_torch_state_sync_bf16_model(hvd):
     state.sync()  # must not crash on the bf16 -> numpy wire conversion
     assert model.weight.dtype == torch.bfloat16
     assert torch.allclose(model.weight.float(), w.float())
+
+
+def test_torch_allgather_equal_dims_still_works(hvd):
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    out = thvd.allgather(t, name="tag")
+    n = thvd.size()
+    assert out.shape == (2 * n, 3)
+    np.testing.assert_allclose(out[:2].numpy(), t.numpy())
