@@ -96,3 +96,12 @@ def test_tf_keras_state_commit_restore_sync(hvd):
     for a, b in zip(model.get_weights(), w0):
         np.testing.assert_allclose(a, b + 2.0)
     assert state.epoch == 2
+
+
+def test_tf_allgather_equal_dims(hvd):
+    htf = tfhvd
+    t = tf.constant(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = htf.allgather(t, name="tf_ag")
+    n = htf.size()
+    assert out.shape == (2 * n, 3)
+    np.testing.assert_allclose(out.numpy()[:2], t.numpy())
