@@ -152,6 +152,25 @@ def run_command(args: Optional[List[str]] = None) -> int:
                 f"Got: {', '.join(h for h, _ in hosts)}")
         if np_ is None:
             np_ = total_slots(hosts)
+    elif np_ is None and not opts.host_discovery_script:
+        # No explicit -np/-H: inside an LSF allocation, derive the process
+        # count from the scheduler like the reference's horovodrun does
+        # (util/lsf.py).  An explicit -np always wins, so per-VM launches
+        # with a shared --coordinator stay possible on multi-host jobs.
+        from .lsf import get_compute_hosts, using_lsf
+        if using_lsf():
+            from .hosts import all_local, total_slots
+            try:
+                hosts = get_compute_hosts()
+            except ValueError as e:
+                parser.error(str(e))
+            if not all_local(hosts):
+                parser.error(
+                    "LSF allocation spans multiple hosts: run hvdrun on "
+                    "each worker VM with -np <local slots> and a shared "
+                    "--coordinator. Hosts: "
+                    f"{', '.join(h for h, _ in hosts)}")
+            np_ = total_slots(hosts)
     if np_ is None:
         np_ = 1
     if opts.host_discovery_script:

@@ -1,0 +1,69 @@
+"""LSF cluster detection for the launcher.
+
+Parity with ``horovod/runner/util/lsf.py`` (LSF environment probing) and
+the spirit of ``horovod/runner/js_run.py``: when ``hvdrun`` starts inside
+an LSF job with no explicit ``-H``/``--hostfile``, the host list is
+derived from the scheduler's environment —
+
+- ``LSB_DJOB_RANKFILE``: one hostname per allocated slot (repeats mean
+  multiple slots on that host); preferred when present because it
+  reflects the actual rank layout ``jsrun``/``blaunch`` would use.
+- ``LSB_MCPU_HOSTS``: ``"host1 n1 host2 n2 ..."`` alternating host /
+  core-count pairs.
+
+The reference execs ``jsrun`` to fan out; this launcher instead spawns
+local controller processes, so on a multi-host LSF allocation each worker
+VM runs ``hvdrun`` with its local slots and a shared ``--coordinator``
+(see ``launch.py``).  The parsing surface is what carries over.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import List, Tuple
+
+
+def using_lsf() -> bool:
+    """True when running inside an LSF job (``LSB_JOBID`` set)."""
+    return "LSB_JOBID" in os.environ
+
+
+def get_compute_hosts() -> List[Tuple[str, int]]:
+    """``(host, slots)`` list from the LSF environment.
+
+    Slot counts come from the scheduler itself (rank-file line repeats /
+    MCPU core counts).  Raises ``ValueError`` if no usable LSF host
+    information is found or the format is malformed.
+    """
+    rankfile = os.environ.get("LSB_DJOB_RANKFILE")
+    if rankfile and os.path.exists(rankfile):
+        with open(rankfile) as f:
+            hosts = [h for h in (raw.strip() for raw in f) if h]
+        # The first entry is the batch/launch node, not a compute slot —
+        # LSF convention, and what the reference's LSFUtils excludes too.
+        counts: "OrderedDict[str, int]" = OrderedDict()
+        for host in hosts[1:]:
+            counts[host] = counts.get(host, 0) + 1
+        if counts:
+            return list(counts.items())
+
+    mcpu = os.environ.get("LSB_MCPU_HOSTS", "").split()
+    if mcpu:
+        if len(mcpu) % 2:
+            raise ValueError(
+                f"malformed LSB_MCPU_HOSTS (odd token count): {mcpu!r}")
+        out: "OrderedDict[str, int]" = OrderedDict()
+        for host, n in zip(mcpu[::2], mcpu[1::2]):
+            try:
+                slots = int(n)
+            except ValueError:
+                raise ValueError(
+                    f"malformed LSB_MCPU_HOSTS slot count {n!r}")
+            if slots > 0:
+                out[host] = out.get(host, 0) + slots
+        if out:
+            return list(out.items())
+
+    raise ValueError("LSF job detected (LSB_JOBID set) but neither "
+                     "LSB_DJOB_RANKFILE nor LSB_MCPU_HOSTS is usable")

@@ -22,28 +22,8 @@ from typing import Any, Callable, List, Optional
 from .store import LocalStore, Store  # noqa: F401
 from .estimator import (  # noqa: F401
     EstimatorParams, JaxEstimator, JaxModel, KerasEstimator, KerasModel,
-    TorchEstimator, TorchModel,
+    LightningEstimator, TorchEstimator, TorchModel,
 )
-
-
-def LightningEstimator(*args, **kwargs):
-    """Reference ``horovod.spark.lightning.TorchEstimator`` surface.
-
-    PyTorch Lightning is not installed in TPU images; use
-    :class:`TorchEstimator` (same fit/transform contract over a plain
-    ``torch.nn.Module``) or the flagship :class:`JaxEstimator`.
-    """
-    try:
-        import pytorch_lightning  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "LightningEstimator requires pytorch_lightning, which is not "
-            "installed in this environment; use TorchEstimator or "
-            "JaxEstimator instead.") from e
-    raise NotImplementedError(
-        "LightningEstimator: LightningModule training is not bridged to "
-        "the TPU backend; wrap your model as torch.nn.Module and use "
-        "TorchEstimator.")
 
 
 def _require_pyspark():

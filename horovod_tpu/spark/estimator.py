@@ -36,7 +36,8 @@ import numpy as np
 from .store import LocalStore, Store
 
 __all__ = ["EstimatorParams", "JaxEstimator", "JaxModel", "TorchEstimator",
-           "TorchModel", "KerasEstimator", "KerasModel"]
+           "TorchModel", "KerasEstimator", "KerasModel",
+           "LightningEstimator"]
 
 
 # ---------------------------------------------------------------------------
@@ -335,7 +336,14 @@ class JaxModel:
 # Torch estimator (rides horovod_tpu.torch shim)
 # ---------------------------------------------------------------------------
 
-def _torch_worker(spec) -> List[float]:
+def _run_torch_training(spec, make_optimizer, compute_loss,
+                        float_labels: Optional[bool]) -> List[float]:
+    """Shared torch-shim worker scaffold: init + shard load + broadcast,
+    the distributed batch loop, rank-0 checkpoint through the Store, and
+    orderly teardown.  ``make_optimizer(model)`` sources the base
+    optimizer; ``compute_loss(model, xb, yb, batch_idx)`` returns the
+    per-batch loss tensor.
+    """
     import torch
 
     import horovod_tpu.torch as hvd
@@ -344,28 +352,24 @@ def _torch_worker(spec) -> List[float]:
     store = LocalStore(spec["store_prefix"])
     shard = _load_shard(store.get_train_data_path(hvd.rank()))
     model = pickle.loads(spec["model"])
+    model.train()
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
-    base_opt = torch.optim.SGD(model.parameters(), lr=spec["lr"],
-                               momentum=0.9) if spec["opt"] == "sgd" else \
-        torch.optim.Adam(model.parameters(), lr=spec["lr"])
     opt = hvd.DistributedOptimizer(
-        base_opt, named_parameters=model.named_parameters())
-    loss_fn = torch.nn.MSELoss() if spec["loss"] == "mse" else \
-        torch.nn.CrossEntropyLoss()
+        make_optimizer(model), named_parameters=model.named_parameters())
 
     x = torch.as_tensor(shard["features"], dtype=torch.float32)
     y = torch.as_tensor(shard["labels"])
-    if spec["loss"] != "mse":
+    if float_labels is None:  # infer: float labels stay, others are classes
+        float_labels = y.dtype in (torch.float32, torch.float64)
+    if not float_labels:
         y = y.long()
     n, bs = len(x), max(1, min(spec["batch_size"], len(x)))
     history = []
     for _ in range(spec["epochs"]):
         ep = []
-        for i in range(0, n - bs + 1, bs):
+        for bi, i in enumerate(range(0, n - bs + 1, bs)):
             opt.zero_grad()
-            out = model(x[i:i + bs])
-            loss = loss_fn(out.squeeze() if spec["loss"] == "mse"
-                           else out, y[i:i + bs])
+            loss = compute_loss(model, x[i:i + bs], y[i:i + bs], bi)
             loss.backward()
             opt.step()
             ep.append(float(loss))
@@ -377,6 +381,26 @@ def _torch_worker(spec) -> List[float]:
                     buf.getvalue())
     _orderly_teardown(hvd)
     return history
+
+
+def _torch_worker(spec) -> List[float]:
+    import torch
+
+    def make_optimizer(model):
+        if spec["opt"] == "sgd":
+            return torch.optim.SGD(model.parameters(), lr=spec["lr"],
+                                   momentum=0.9)
+        return torch.optim.Adam(model.parameters(), lr=spec["lr"])
+
+    mse = spec["loss"] == "mse"
+    loss_fn = torch.nn.MSELoss() if mse else torch.nn.CrossEntropyLoss()
+
+    def compute_loss(model, xb, yb, bi):
+        out = model(xb)
+        return loss_fn(out.squeeze() if mse else out, yb)
+
+    return _run_torch_training(spec, make_optimizer, compute_loss,
+                               float_labels=mse)
 
 
 class TorchEstimator(_EstimatorBase):
@@ -421,6 +445,74 @@ class TorchModel:
                 torch.as_tensor(x, dtype=torch.float32)).numpy()
 
     predict = transform
+
+
+# ---------------------------------------------------------------------------
+# Lightning estimator (LightningModule protocol over the torch shim)
+# ---------------------------------------------------------------------------
+
+def _first_optimizer(cfg):
+    """``configure_optimizers()`` -> the (single) optimizer to drive.
+
+    Accepts the LightningModule return shapes: an optimizer, a list/tuple
+    of optimizers (optionally paired with schedulers), or a dict with an
+    ``"optimizer"`` key.
+    """
+    if isinstance(cfg, dict):
+        return cfg["optimizer"]
+    if isinstance(cfg, (list, tuple)):
+        head = cfg[0]
+        if isinstance(head, (list, tuple)):  # ([opts], [scheds])
+            return head[0]
+        return _first_optimizer(head) if isinstance(head, dict) else head
+    return cfg
+
+
+def _lightning_worker(spec) -> List[float]:
+    """Mini Trainer loop speaking the LightningModule protocol:
+    ``configure_optimizers`` -> DistributedOptimizer wrap,
+    ``training_step((x, y), i)`` -> backward -> step.  Works with real
+    ``pytorch_lightning.LightningModule`` objects and with any
+    ``torch.nn.Module`` implementing the two methods.
+    """
+    def make_optimizer(model):
+        return _first_optimizer(model.configure_optimizers())
+
+    def compute_loss(model, xb, yb, bi):
+        out = model.training_step((xb, yb), bi)
+        return out["loss"] if isinstance(out, dict) else out
+
+    return _run_torch_training(spec, make_optimizer, compute_loss,
+                               float_labels=None)
+
+
+class LightningEstimator(_EstimatorBase):
+    """Reference ``horovod.spark.lightning.TorchEstimator`` parity: trains
+    a LightningModule-protocol model (``training_step`` +
+    ``configure_optimizers``) across workers with the torch shim's
+    DistributedOptimizer.  ``pytorch_lightning`` itself is optional — the
+    worker drives the protocol directly, so plain modules implementing it
+    work too."""
+
+    def __init__(self, model, **kwargs):
+        super().__init__(**kwargs)
+        if not (callable(getattr(model, "training_step", None))
+                and callable(getattr(model, "configure_optimizers", None))):
+            raise TypeError(
+                "LightningEstimator needs a model implementing "
+                "training_step(batch, batch_idx) and "
+                "configure_optimizers() (a pytorch_lightning."
+                "LightningModule, or any torch.nn.Module with those "
+                "methods)")
+        self.model = model
+
+    _worker_fn = staticmethod(_lightning_worker)
+
+    def _make_worker_spec(self) -> dict:
+        return {"model": pickle.dumps(self.model)}
+
+    def _make_model(self, ckpt: bytes, history) -> "TorchModel":
+        return TorchModel(ckpt, history)
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,9 @@ from ..core.process_sets import (  # noqa: F401
     ProcessSet, add_process_set, remove_process_set, get_process_set,
 )
 from . import elastic  # noqa: F401  (hvd.elastic.TensorFlowKerasState)
+from .sync_batch_norm import (  # noqa: F401
+    SyncBatchNorm, SyncBatchNormalization,
+)
 from ..collectives.reduce_op import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
 )
@@ -174,22 +177,30 @@ class DistributedGradientTape(tf.GradientTape):
 
 
 def DistributedOptimizer(optimizer, compression=Compression.none,
-                         op: ReduceOp = Average, process_set=None):
+                         op: ReduceOp = Average, process_set=None,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = True):
     """Keras-3 optimizer wrapper: allreduce grads in ``apply_gradients``.
 
     Reference: ``horovod/tensorflow/__init__.py::DistributedOptimizer``
     (wrap ``compute_gradients``); Keras 3 funnels everything through
     ``apply_gradients``, so the reduction hooks there.
+
+    ``backward_passes_per_step > 1`` reproduces the reference's local
+    gradient aggregation (``gradient_aggregation_eager.py``): gradients
+    accumulate into local buffers for N-1 calls with NO communication and
+    NO variable update; the Nth call allreduces the aggregate (averaged
+    over N when ``average_aggregated_gradients``) and applies it.
     """
     base = optimizer.__class__
+    bpps = int(backward_passes_per_step)
+    if bpps < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
 
     class _Distributed(base):
         _hvd_wrapped = True
 
-        def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            grads_and_vars = list(grads_and_vars)
-            grads = [g for g, _ in grads_and_vars]
-            tvars = [v for _, v in grads_and_vars]
+        def _hvd_reduce_and_apply(self, grads, tvars, args, kwargs):
             idx = [i for i, g in enumerate(grads) if g is not None]
             if idx:
                 reduced = grouped_allreduce(
@@ -199,6 +210,59 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
                     grads[i] = g
             return super().apply_gradients(zip(grads, tvars), *args,
                                            **kwargs)
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            tvars = [v for _, v in grads_and_vars]
+            if bpps == 1:
+                return self._hvd_reduce_and_apply(grads, tvars, args,
+                                                  kwargs)
+
+            if not hasattr(self, "_hvd_agg_counter"):
+                self._hvd_agg_counter = tf.Variable(
+                    0, dtype=tf.int64, trainable=False,
+                    name="hvd_agg_counter")
+                self._hvd_agg_bufs = [
+                    None if g is None else tf.Variable(
+                        tf.zeros(g.shape, g.dtype), trainable=False,
+                        name=f"hvd_agg_{i}")
+                    for i, g in enumerate(grads)]
+            for buf, g in zip(self._hvd_agg_bufs, grads):
+                if buf is not None and g is not None:
+                    buf.assign_add(tf.convert_to_tensor(g))
+            self._hvd_agg_counter.assign_add(1)
+
+            def _boundary():
+                scale = 1.0 / bpps if average_aggregated_gradients else 1.0
+                agg = [None if b is None
+                       else tf.cast(scale, b.dtype) * b.read_value()
+                       for b in self._hvd_agg_bufs]
+                with tf.control_dependencies(
+                        [a for a in agg if a is not None]):
+                    for b in self._hvd_agg_bufs:
+                        if b is not None:
+                            b.assign(tf.zeros_like(b))
+                    self._hvd_agg_counter.assign(0)
+                self._hvd_reduce_and_apply(agg, tvars, args, kwargs)
+                return tf.constant(True)
+
+            def _skip():
+                return tf.constant(False)
+
+            if tf.executing_eagerly():
+                applied = (_boundary()
+                           if int(self._hvd_agg_counter) >= bpps
+                           else _skip())
+            else:
+                # Slot variables must exist BEFORE tf.cond traces the
+                # apply branch (variable creation is illegal inside cond).
+                if hasattr(self, "build") and not getattr(self, "built",
+                                                          True):
+                    self.build(tvars)
+                applied = tf.cond(self._hvd_agg_counter >= bpps,
+                                  _boundary, _skip)
+            return applied
 
     optimizer.__class__ = _Distributed
     return optimizer

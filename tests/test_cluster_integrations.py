@@ -290,3 +290,44 @@ def test_elastic_ray_executor_runs_function(tmp_path):
     assert len(results) == 2
     assert [r["rank"] for r in results] == [0, 1]
     assert all(r["batches"] == 6 and r["size"] == 2 for r in results)
+
+
+class _LightningStyleMLP(__import__("torch").nn.Module):
+    """LightningModule protocol without the pytorch_lightning dependency."""
+
+    def __init__(self):
+        import torch
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(4, 16), torch.nn.ReLU(), torch.nn.Linear(16, 3))
+
+    def forward(self, x):
+        return self.net(x)
+
+    def training_step(self, batch, batch_idx):
+        import torch
+        x, y = batch
+        return {"loss": torch.nn.functional.cross_entropy(self(x), y)}
+
+    def configure_optimizers(self):
+        import torch
+        return torch.optim.Adam(self.parameters(), lr=0.05)
+
+
+def test_lightning_estimator_rejects_plain_module():
+    from horovod_tpu.spark import LightningEstimator
+    with pytest.raises(TypeError, match="training_step"):
+        LightningEstimator(model=_TorchMLP())
+
+
+@pytest.mark.integration
+def test_lightning_estimator_fit_transform(tmp_path):
+    from horovod_tpu.spark import LightningEstimator, LocalStore
+    x, y = _blobs(n=64)
+    est = LightningEstimator(model=_LightningStyleMLP(), num_proc=2,
+                             batch_size=8, epochs=12,
+                             store=LocalStore(str(tmp_path)))
+    fitted = est.fit({"features": x, "labels": y})
+    assert fitted.history[-1] < fitted.history[0]
+    preds = fitted.transform(x).argmax(-1)
+    assert (preds == y).mean() > 0.8

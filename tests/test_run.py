@@ -295,10 +295,11 @@ def test_probe_end_to_end_local():
         drv.stop()
 
 
-def test_lightning_estimator_raises_with_guidance():
+def test_lightning_estimator_requires_protocol():
+    # LightningEstimator is functional (no pytorch_lightning needed) but
+    # demands the LightningModule protocol methods up front.
     from horovod_tpu.spark import LightningEstimator
-    with pytest.raises((ImportError, NotImplementedError),
-                       match="TorchEstimator"):
+    with pytest.raises(TypeError, match="training_step"):
         LightningEstimator(model=None)
 
 
@@ -312,3 +313,40 @@ def test_programmatic_run_api():
     from horovod_tpu.run import run as hvd_run
     results = hvd_run(_identity_worker, np=2, cpu=True)
     assert results == [("0", "2"), ("1", "2")]
+
+
+# -- LSF detection (reference horovod/runner/util/lsf.py) -----------------
+
+def test_lsf_mcpu_hosts(monkeypatch):
+    from horovod_tpu.run import lsf
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.delenv("LSB_DJOB_RANKFILE", raising=False)
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4 nodeB 4 nodeA 2")
+    assert lsf.using_lsf()
+    assert lsf.get_compute_hosts() == [("nodeA", 6), ("nodeB", 4)]
+
+
+def test_lsf_rankfile_preferred(monkeypatch, tmp_path):
+    from horovod_tpu.run import lsf
+    rf = tmp_path / "rankfile"
+    # First entry is the batch/launch node: excluded from compute slots.
+    rf.write_text("batch01\nh1\nh1\nh2\n")
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "ignored 9")
+    assert lsf.get_compute_hosts() == [("h1", 2), ("h2", 1)]
+
+
+def test_lsf_malformed(monkeypatch):
+    from horovod_tpu.run import lsf
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.delenv("LSB_DJOB_RANKFILE", raising=False)
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4 nodeB")
+    with pytest.raises(ValueError):
+        lsf.get_compute_hosts()
+
+
+def test_lsf_not_detected(monkeypatch):
+    from horovod_tpu.run import lsf
+    monkeypatch.delenv("LSB_JOBID", raising=False)
+    assert not lsf.using_lsf()

@@ -105,3 +105,71 @@ def test_tf_allgather_equal_dims(hvd):
     n = htf.size()
     assert out.shape == (2 * n, 3)
     np.testing.assert_allclose(out.numpy()[:2], t.numpy())
+
+
+def test_backward_passes_per_step_eager(hvd_tf):
+    v = tf.Variable([10.0])
+    opt = hvd_tf.DistributedOptimizer(keras.optimizers.SGD(1.0),
+                                      backward_passes_per_step=2)
+    opt.apply_gradients([(tf.constant([1.0]), v)])
+    np.testing.assert_allclose(v.numpy(), [10.0])  # pass 1: no update
+    opt.apply_gradients([(tf.constant([3.0]), v)])
+    # pass 2: apply mean over the 2 local passes -> 10 - (1+3)/2 = 8
+    np.testing.assert_allclose(v.numpy(), [8.0], rtol=1e-6)
+    opt.apply_gradients([(tf.constant([2.0]), v)])
+    np.testing.assert_allclose(v.numpy(), [8.0])  # next cycle, pass 1
+
+
+def test_backward_passes_per_step_fit(hvd_tf):
+    model = keras.Sequential([keras.layers.Dense(1, input_shape=(4,))])
+    opt = hvd_tf.DistributedOptimizer(keras.optimizers.SGD(0.1),
+                                      backward_passes_per_step=2)
+    model.compile(optimizer=opt, loss="mse")
+    x = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+    y = (x @ np.ones((4, 1))).astype(np.float32)
+    h = model.fit(x, y, epochs=4, batch_size=8, verbose=0)
+    assert h.history["loss"][-1] < h.history["loss"][0]
+
+
+def test_sync_batch_norm_matches_local(hvd_tf):
+    # Single-process: the cross-rank average of replicated stats is the
+    # identity, so SyncBatchNormalization == BatchNormalization exactly.
+    rng = np.random.RandomState(1)
+    x = tf.constant(rng.randn(16, 8).astype(np.float32))
+    sbn = hvd_tf.SyncBatchNormalization(momentum=0.9)
+    bn = keras.layers.BatchNormalization(momentum=0.9)
+    y_sync = sbn(x, training=True)
+    y_ref = bn(x, training=True)
+    np.testing.assert_allclose(y_sync.numpy(), y_ref.numpy(), atol=1e-5)
+    np.testing.assert_allclose(sbn.moving_mean.numpy(),
+                               bn.moving_mean.numpy(), atol=1e-5)
+
+
+def test_sync_batch_norm_in_fit(hvd_tf):
+    model = keras.Sequential([
+        keras.Input((4,)),
+        keras.layers.Dense(8),
+        hvd_tf.SyncBatchNormalization(),
+        keras.layers.Dense(1),
+    ])
+    model.compile(optimizer=keras.optimizers.SGD(0.05), loss="mse")
+    x = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+    y = (x @ np.ones((4, 1))).astype(np.float32)
+    h = model.fit(x, y, epochs=3, batch_size=16, verbose=0)
+    assert h.history["loss"][-1] < h.history["loss"][0]
+
+
+def test_sync_batch_norm_config_roundtrip(hvd_tf):
+    sbn = hvd_tf.SyncBatchNormalization(momentum=0.8, process_set=None)
+    clone = hvd_tf.SyncBatchNormalization.from_config(sbn.get_config())
+    assert clone.momentum == pytest.approx(0.8)
+    assert clone._hvd_process_set is None
+    # Named process set round-trips by name through the registry.
+    import horovod_tpu as hvd
+    ps = hvd.add_process_set(range(hvd.size()), name="sbn_cfg_test")
+    try:
+        sbn2 = hvd_tf.SyncBatchNormalization(process_set=ps)
+        clone2 = hvd_tf.SyncBatchNormalization.from_config(sbn2.get_config())
+        assert clone2._hvd_process_set.name == "sbn_cfg_test"
+    finally:
+        hvd.remove_process_set(ps)
