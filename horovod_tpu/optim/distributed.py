@@ -48,6 +48,20 @@ def allreduce_gradients(grads,
                            postscale_factor=postscale_factor)
         return compression.decompress(r, ctx)
 
+    # Axis sizes are static at trace time: a one-device reduction is the
+    # identity (every reduce op over a single member returns its input), so
+    # skip the pack/unpack copies and apply the collective leaf-wise -- XLA
+    # deletes the size-1 psum and fuses the scale/compression casts into
+    # the surrounding update.  The reference pays its fusion-buffer memcpys
+    # even at np=1; knowing the world size at trace time is exactly what
+    # lets the TPU build not to.
+    try:
+        world = _ops.axis_size(axes)
+    except Exception:  # outside a traced mesh context: keep the fused path
+        world = None
+    if world == 1:
+        return jax.tree.map(collective, grads)
+
     return fused_tree_collective(grads, collective, fusion_threshold)
 
 

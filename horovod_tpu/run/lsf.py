@@ -40,14 +40,23 @@ def get_compute_hosts() -> List[Tuple[str, int]]:
     if rankfile and os.path.exists(rankfile):
         with open(rankfile) as f:
             hosts = [h for h in (raw.strip() for raw in f) if h]
-        # The first entry is the batch/launch node, not a compute slot —
-        # LSF convention, and what the reference's LSFUtils excludes too.
+        # On CSM/jsrun systems the first line is the batch/launch node,
+        # which holds no compute slot; on plain LSF (bsub -n N) there is
+        # no separate batch line and every line is a slot.  Distinguish
+        # the two: drop the first line only when its host never recurs
+        # and other hosts exist (the batch-node signature).
+        if len(hosts) > 1 and hosts[0] not in hosts[1:]:
+            hosts = hosts[1:]
         counts: "OrderedDict[str, int]" = OrderedDict()
-        for host in hosts[1:]:
+        for host in hosts:
             counts[host] = counts.get(host, 0) + 1
         if counts:
             return list(counts.items())
 
+    # Non-CSM fallback: every LSB_MCPU_HOSTS entry carries an allocated
+    # core count, so all entries (including the submission host's) are
+    # genuine compute slots; jsrun-style systems with a slotless batch
+    # node provide the rankfile above, which is preferred.
     mcpu = os.environ.get("LSB_MCPU_HOSTS", "").split()
     if mcpu:
         if len(mcpu) % 2:

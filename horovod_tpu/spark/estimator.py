@@ -360,7 +360,7 @@ def _run_torch_training(spec, make_optimizer, compute_loss,
     x = torch.as_tensor(shard["features"], dtype=torch.float32)
     y = torch.as_tensor(shard["labels"])
     if float_labels is None:  # infer: float labels stay, others are classes
-        float_labels = y.dtype in (torch.float32, torch.float64)
+        float_labels = y.dtype.is_floating_point
     if not float_labels:
         y = y.long()
     n, bs = len(x), max(1, min(spec["batch_size"], len(x)))

@@ -245,24 +245,24 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
                             b.assign(tf.zeros_like(b))
                     self._hvd_agg_counter.assign(0)
                 self._hvd_reduce_and_apply(agg, tvars, args, kwargs)
-                return tf.constant(True)
+                return tf.convert_to_tensor(self.iterations)
 
             def _skip():
-                return tf.constant(False)
+                return tf.convert_to_tensor(self.iterations)
 
             if tf.executing_eagerly():
-                applied = (_boundary()
-                           if int(self._hvd_agg_counter) >= bpps
-                           else _skip())
-            else:
-                # Slot variables must exist BEFORE tf.cond traces the
-                # apply branch (variable creation is illegal inside cond).
-                if hasattr(self, "build") and not getattr(self, "built",
-                                                          True):
-                    self.build(tvars)
-                applied = tf.cond(self._hvd_agg_counter >= bpps,
-                                  _boundary, _skip)
-            return applied
+                # Both paths return iterations, like the bpps==1 path and
+                # the Keras base apply_gradients contract.
+                return (_boundary()
+                        if int(self._hvd_agg_counter) >= bpps
+                        else _skip())
+            # Slot variables must exist BEFORE tf.cond traces the
+            # apply branch (variable creation is illegal inside cond).
+            if hasattr(self, "build") and not getattr(self, "built",
+                                                      True):
+                self.build(tvars)
+            return tf.cond(self._hvd_agg_counter >= bpps,
+                           _boundary, _skip)
 
     optimizer.__class__ = _Distributed
     return optimizer

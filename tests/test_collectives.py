@@ -316,3 +316,36 @@ def test_allgatherv_rejects_mismatched_tails(hvd, n_devices):
         [np.zeros((2, 4), np.float32)]
     with pytest.raises(ValueError, match="dim 0"):
         hv.allgatherv(arrs)
+
+
+def test_allreduce_gradients_size1_identity(hvd):
+    """A 1-device mesh reduction short-circuits the fusion pack/unpack but
+    must keep the exact collective semantics (scaling + compression)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from horovod_tpu.optim.distributed import allreduce_gradients
+    from horovod_tpu.collectives.compression import Compression
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    grads = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+             "b": jnp.full((4,), 2.0, jnp.float32)}
+
+    def f(g):
+        return allreduce_gradients(g, hvd.Average, axes=("dp",),
+                                   prescale_factor=2.0)
+
+    out = jax.jit(jax.shard_map(f, mesh=mesh1, in_specs=P(),
+                                out_specs=P(), check_vma=False))(grads)
+    np.testing.assert_allclose(np.asarray(out["a"]),
+                               np.asarray(grads["a"]) * 2.0)
+    np.testing.assert_allclose(np.asarray(out["b"]),
+                               np.asarray(grads["b"]) * 2.0)
+
+    def fc(g):
+        return allreduce_gradients(g, hvd.Sum, axes=("dp",),
+                                   compression=Compression.bf16)
+
+    out = jax.jit(jax.shard_map(fc, mesh=mesh1, in_specs=P(),
+                                out_specs=P(), check_vma=False))(grads)
+    # bf16 round-trip semantics preserved (values here are bf16-exact)
+    np.testing.assert_allclose(np.asarray(out["a"]), np.asarray(grads["a"]))

@@ -329,12 +329,22 @@ def test_lsf_mcpu_hosts(monkeypatch):
 def test_lsf_rankfile_preferred(monkeypatch, tmp_path):
     from horovod_tpu.run import lsf
     rf = tmp_path / "rankfile"
-    # First entry is the batch/launch node: excluded from compute slots.
+    # CSM-style: first line is the slotless batch/launch node -> excluded.
     rf.write_text("batch01\nh1\nh1\nh2\n")
     monkeypatch.setenv("LSB_JOBID", "123")
     monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
     monkeypatch.setenv("LSB_MCPU_HOSTS", "ignored 9")
     assert lsf.get_compute_hosts() == [("h1", 2), ("h2", 1)]
+
+
+def test_lsf_rankfile_plain_single_host(monkeypatch, tmp_path):
+    # Plain LSF (bsub -n 4): no separate batch line; every line is a slot.
+    from horovod_tpu.run import lsf
+    rf = tmp_path / "rankfile"
+    rf.write_text("hostA\nhostA\nhostA\nhostA\n")
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
+    assert lsf.get_compute_hosts() == [("hostA", 4)]
 
 
 def test_lsf_malformed(monkeypatch):
