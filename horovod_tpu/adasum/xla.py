@@ -44,6 +44,41 @@ def _pair(a, b):
     return out
 
 
+def adasum_allreduce_hierarchical(x, dcn_axis: str = "dcn",
+                                  ici_axis: str = "ici"):
+    """Two-level Adasum on a ``(dcn, ici)`` mesh.
+
+    TPU mapping of the reference's hybrid ``adasum_gpu_operations.cc``
+    (node-local NCCL ReduceScatter -> cross-node Adasum over MPI ->
+    node-local NCCL Allgather): slice-local ``psum_scatter`` over ICI,
+    Adasum recursive doubling over DCN on each shard, ``all_gather`` back
+    over ICI.  Like the reference hybrid, the mixing coefficients are
+    computed independently per scattered shard.
+
+    The intra-slice reduction is the MEAN (Adasum mixing is homogeneous --
+    ``adasum(ca, cb) = c adasum(a, b)`` -- so sum vs. mean only scales the
+    result; the mean keeps data-parallel gradient magnitude independent of
+    slice size).
+    """
+    n_ici = lax.axis_size(ici_axis)
+    if n_ici == 1:
+        return adasum_allreduce(x, axis=dcn_axis)
+    shape = x.shape
+    flat = x.ravel()
+    pad = (-flat.size) % n_ici
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0,
+                             tiled=True)
+    shard = shard / jnp.asarray(n_ici, shard.dtype)
+    mixed = adasum_allreduce(shard, axis=dcn_axis)
+    out = lax.all_gather(mixed, ici_axis, axis=0, tiled=True)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
 def adasum_allreduce(x, axis: str = "hvd"):
     """Adasum-allreduce ``x`` across the (power-of-two) flat mesh axis."""
     n = lax.axis_size(axis)

@@ -141,11 +141,22 @@ def allreduce(x,
         # tensor's own dtype, wraparound included).
         y = jnp.prod(g, axis=0, dtype=g.dtype)
     elif op is Adasum:
-        from ..adasum.xla import adasum_allreduce
-        if len(axes) != 1 or members is not None:
+        from ..adasum.xla import (adasum_allreduce,
+                                  adasum_allreduce_hierarchical)
+        if members is not None:
             raise NotImplementedError(
-                "Adasum currently requires a flat mesh and the global set")
-        y = adasum_allreduce(x, axis=axes[0])
+                "Adasum currently requires the global process set")
+        if len(axes) == 1:
+            y = adasum_allreduce(x, axis=axes[0])
+        elif len(axes) == 2:
+            # Hierarchical (dcn, ici) mesh: the reference's hybrid Adasum
+            # (intra-node ReduceScatter -> cross-node Adasum -> Allgather,
+            # adasum_gpu_operations.cc).
+            y = adasum_allreduce_hierarchical(x, dcn_axis=axes[0],
+                                              ici_axis=axes[1])
+        else:
+            raise NotImplementedError(
+                "Adasum supports flat or 2-level (dcn, ici) meshes")
     else:
         raise ValueError(f"unknown reduce op {op}")
     if postscale_factor != 1.0:

@@ -40,12 +40,14 @@ def get_compute_hosts() -> List[Tuple[str, int]]:
     if rankfile and os.path.exists(rankfile):
         with open(rankfile) as f:
             hosts = [h for h in (raw.strip() for raw in f) if h]
-        # On CSM/jsrun systems the first line is the batch/launch node,
-        # which holds no compute slot; on plain LSF (bsub -n N) there is
-        # no separate batch line and every line is a slot.  Distinguish
-        # the two: drop the first line only when its host never recurs
-        # and other hosts exist (the batch-node signature).
-        if len(hosts) > 1 and hosts[0] not in hosts[1:]:
+        # On CSM/jsrun systems the first line is the slotless batch/launch
+        # node; on plain LSF (bsub -n N) every line is a compute slot.
+        # LSB_SUB_HOST names the submission host, so use it as the
+        # authoritative batch-node marker instead of guessing from line
+        # patterns (which misfires on one-slot-per-host allocations).
+        sub_host = os.environ.get("LSB_SUB_HOST")
+        if (len(hosts) > 1 and sub_host and hosts[0] == sub_host
+                and hosts[0] not in hosts[1:]):
             hosts = hosts[1:]
         counts: "OrderedDict[str, int]" = OrderedDict()
         for host in hosts:

@@ -329,22 +329,37 @@ def test_lsf_mcpu_hosts(monkeypatch):
 def test_lsf_rankfile_preferred(monkeypatch, tmp_path):
     from horovod_tpu.run import lsf
     rf = tmp_path / "rankfile"
-    # CSM-style: first line is the slotless batch/launch node -> excluded.
+    # CSM-style: first line is the submission/batch node (LSB_SUB_HOST),
+    # which holds no compute slot -> excluded.
     rf.write_text("batch01\nh1\nh1\nh2\n")
     monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_SUB_HOST", "batch01")
     monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
     monkeypatch.setenv("LSB_MCPU_HOSTS", "ignored 9")
     assert lsf.get_compute_hosts() == [("h1", 2), ("h2", 1)]
 
 
 def test_lsf_rankfile_plain_single_host(monkeypatch, tmp_path):
-    # Plain LSF (bsub -n 4): no separate batch line; every line is a slot.
+    # Plain LSF (bsub -n 4): no separate batch line; every line is a slot
+    # even when the job was submitted from hostA itself.
     from horovod_tpu.run import lsf
     rf = tmp_path / "rankfile"
     rf.write_text("hostA\nhostA\nhostA\nhostA\n")
     monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_SUB_HOST", "hostA")
     monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
     assert lsf.get_compute_hosts() == [("hostA", 4)]
+
+
+def test_lsf_rankfile_one_slot_per_host(monkeypatch, tmp_path):
+    # span[ptile=1]: every host appears once; none may be dropped.
+    from horovod_tpu.run import lsf
+    rf = tmp_path / "rankfile"
+    rf.write_text("h1\nh2\nh3\n")
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.delenv("LSB_SUB_HOST", raising=False)
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
+    assert lsf.get_compute_hosts() == [("h1", 1), ("h2", 1), ("h3", 1)]
 
 
 def test_lsf_malformed(monkeypatch):
