@@ -71,7 +71,8 @@ def adasum_allreduce_hierarchical(x, dcn_axis: str = "dcn",
             [flat, jnp.zeros((pad,), flat.dtype)])
     shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0,
                              tiled=True)
-    shard = shard / jnp.asarray(n_ici, shard.dtype)
+    from ..collectives.ops import _divide_in_dtype
+    shard = _divide_in_dtype(shard, n_ici)  # keep the wire dtype (ints too)
     mixed = adasum_allreduce(shard, axis=dcn_axis)
     out = lax.all_gather(mixed, ici_axis, axis=0, tiled=True)
     if pad:

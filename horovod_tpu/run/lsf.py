@@ -42,13 +42,20 @@ def get_compute_hosts() -> List[Tuple[str, int]]:
             hosts = [h for h in (raw.strip() for raw in f) if h]
         # On CSM/jsrun systems the first line is the slotless batch/launch
         # node; on plain LSF (bsub -n N) every line is a compute slot.
-        # LSB_SUB_HOST names the submission host, so use it as the
-        # authoritative batch-node marker instead of guessing from line
-        # patterns (which misfires on one-slot-per-host allocations).
-        sub_host = os.environ.get("LSB_SUB_HOST")
-        if (len(hosts) > 1 and sub_host and hosts[0] == sub_host
-                and hosts[0] not in hosts[1:]):
-            hosts = hosts[1:]
+        # Drop the first line when it is clearly the launch node: it never
+        # recurs AND (it matches LSB_SUB_HOST, or later hosts hold multiple
+        # slots while it holds one -- the CSM signature).  A one-slot-per-
+        # host allocation (span[ptile=1]) has no recurring hosts at all, so
+        # nothing is dropped there.  The residual ambiguity (a slotless
+        # launch node heading an otherwise ptile=1 rankfile) is
+        # undecidable from the file alone; pass -H explicitly in that case.
+        rest = hosts[1:]
+        first_is_launch = (
+            len(hosts) > 1 and hosts[0] not in rest
+            and (hosts[0] == os.environ.get("LSB_SUB_HOST")
+                 or any(rest.count(h) > 1 for h in set(rest))))
+        if first_is_launch:
+            hosts = rest
         counts: "OrderedDict[str, int]" = OrderedDict()
         for host in hosts:
             counts[host] = counts.get(host, 0) + 1

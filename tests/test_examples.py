@@ -40,3 +40,19 @@ def test_synthetic_benchmark_resnet50_cpu():
                 "--image-size", "64", "--batch-size", "2",
                 "--num-iters", "2", "--fp32"])
     assert "images/s/chip" in out
+
+
+@pytest.mark.integration
+def test_long_context_example_cpu():
+    out = _run([os.path.join(REPO, "examples", "long_context.py"),
+                "--cpu-devices", "8", "--seq-len", "256", "--steps", "8",
+                "--compare-single-device"])
+    assert "PARITY OK" in out
+
+
+@pytest.mark.integration
+def test_long_context_example_ulysses_cpu():
+    out = _run([os.path.join(REPO, "examples", "long_context.py"),
+                "--cpu-devices", "8", "--seq-len", "256", "--steps", "8",
+                "--mode", "ulysses"])
+    assert "final loss" in out

@@ -375,3 +375,15 @@ def test_lsf_not_detected(monkeypatch):
     from horovod_tpu.run import lsf
     monkeypatch.delenv("LSB_JOBID", raising=False)
     assert not lsf.using_lsf()
+
+
+def test_lsf_rankfile_csm_without_subhost(monkeypatch, tmp_path):
+    # CSM signature without LSB_SUB_HOST: unique first host + multi-slot
+    # compute hosts -> the launch node line is dropped.
+    from horovod_tpu.run import lsf
+    rf = tmp_path / "rankfile"
+    rf.write_text("batch01\nh1\nh1\nh2\n")
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.delenv("LSB_SUB_HOST", raising=False)
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
+    assert lsf.get_compute_hosts() == [("h1", 2), ("h2", 1)]
