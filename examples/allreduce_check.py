@@ -56,6 +56,11 @@ def main():
     assert val["tag"] == 42 and val["from"] == 0, val
     print(f"rank {rank}: broadcast_object OK")
 
+    objs = hvd.allgather_object({"rank": rank, "payload": "x" * (rank + 1)})
+    assert len(objs) >= world, objs
+    assert {o["rank"] for o in objs} == set(range(world)), objs
+    print(f"rank {rank}: allgather_object OK ({len(objs)} objects)")
+
     params = hvd.broadcast_parameters(
         {"w": np.full((4, 4), float(rank), np.float32)}, root_rank=0)
     w = np.asarray(params["w"])

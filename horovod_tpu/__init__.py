@@ -46,7 +46,8 @@ from .optim.distributed import (  # noqa: F401
     DistributedOptimizer, DistributedAdasumOptimizer, allreduce_gradients,
 )
 from .optim.functions import (  # noqa: F401
-    broadcast_parameters, broadcast_optimizer_state, broadcast_object,
+    allgather_object, broadcast_parameters, broadcast_optimizer_state,
+    broadcast_object,
 )
 from . import elastic  # noqa: F401
 from .utils.checkpoint import (  # noqa: F401

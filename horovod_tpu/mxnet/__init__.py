@@ -1,13 +1,21 @@
 """``horovod_tpu.mxnet``: MXNet API shim (reference ``horovod/mxnet/``).
 
 MXNet reached end-of-life upstream (retired by Apache in 2023) and is not
-installed in TPU images; the reference still ships the binding, so the
-surface exists here for parity.  Core identity functions work without
-MXNet (they don't touch NDArrays); the tensor APIs require the ``mxnet``
-package and raise with guidance otherwise.
+installed in TPU images, but the reference ships the binding
+(``horovod/mxnet/__init__.py``, ``mpi_ops.py``: tensor collectives,
+``DistributedOptimizer`` wrapping ``mx.optimizer.Optimizer.update``,
+``DistributedTrainer`` wrapping ``gluon.Trainer._allreduce_grads``,
+``broadcast_parameters``), so the full surface exists here.  NDArrays
+bridge through numpy onto the XLA mesh exactly like the TF shim's
+tensors; everything below works when the ``mxnet`` package is importable
+and raises with guidance otherwise.
 """
 
 from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
 
 from ..core.basics import (  # noqa: F401
     init, shutdown, is_initialized, size, rank, local_size, local_rank,
@@ -18,13 +26,7 @@ from ..collectives.reduce_op import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
 )
 from ..collectives.compression import Compression  # noqa: F401
-
-_TENSOR_APIS = (
-    "allreduce", "allreduce_", "grouped_allreduce", "allgather",
-    "broadcast", "broadcast_", "alltoall", "reducescatter",
-    "broadcast_parameters", "broadcast_object", "DistributedOptimizer",
-    "DistributedTrainer",
-)
+from ..collectives import eager as _eager
 
 
 def _require_mxnet():
@@ -39,11 +41,158 @@ def _require_mxnet():
             "horovod_tpu.tensorflow instead.") from e
 
 
-def __getattr__(name: str):
-    if name in _TENSOR_APIS:
-        _require_mxnet()
-        raise NotImplementedError(
-            f"horovod_tpu.mxnet.{name}: MXNet NDArray bridging is not "
-            f"implemented for the TPU backend (MXNet is EOL); the "
-            f"reference surface is documented for parity only.")
-    raise AttributeError(name)
+def _to_stack(nd) -> np.ndarray:
+    return _eager.replicated_stack(nd.asnumpy())
+
+
+def _from_row(mx, out, ctx):
+    row = np.array(np.asarray(out.addressable_shards[0].data)[0])
+    return mx.nd.array(row, ctx=ctx)
+
+
+def allreduce(tensor, average: Optional[bool] = None, name=None,
+              op: Optional[ReduceOp] = None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0, process_set=None):
+    """``hvd.allreduce`` for NDArrays (reference ``mxnet/mpi_ops.py``)."""
+    mx = _require_mxnet()
+    if op is None:
+        op = Sum if average is False else Average
+    out = _eager.allreduce(_to_stack(tensor), op, name=name,
+                           process_set=process_set,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+    return _from_row(mx, out, tensor.context)
+
+
+def allreduce_(tensor, average: Optional[bool] = None, name=None,
+               op: Optional[ReduceOp] = None, process_set=None):
+    """In-place variant: writes the reduced value back into ``tensor``."""
+    result = allreduce(tensor, average=average, name=name, op=op,
+                       process_set=process_set)
+    tensor[:] = result
+    return tensor
+
+
+def grouped_allreduce(tensors, average: Optional[bool] = None, name=None,
+                      op: Optional[ReduceOp] = None, process_set=None):
+    mx = _require_mxnet()
+    if op is None:
+        op = Sum if average is False else Average
+    outs = _eager.grouped_allreduce([_to_stack(t) for t in tensors], op,
+                                    name=name, process_set=process_set)
+    return [_from_row(mx, o, t.context) for o, t in zip(outs, tensors)]
+
+
+def allgather(tensor, name=None, process_set=None):
+    """Ragged-capable allgather (first dims may differ across ranks)."""
+    mx = _require_mxnet()
+    out = _eager.allgather_value(tensor.asnumpy(), name=name,
+                                 process_set=process_set)
+    return mx.nd.array(np.asarray(out), ctx=tensor.context)
+
+
+def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
+    mx = _require_mxnet()
+    out = _eager.broadcast(_to_stack(tensor), root_rank, name=name,
+                           process_set=process_set)
+    return _from_row(mx, out, tensor.context)
+
+
+def broadcast_(tensor, root_rank: int = 0, name=None, process_set=None):
+    tensor[:] = broadcast(tensor, root_rank, name=name,
+                          process_set=process_set)
+    return tensor
+
+
+def alltoall(tensor, name=None, process_set=None):
+    mx = _require_mxnet()
+    out = _eager.alltoall(_to_stack(tensor), name=name,
+                          process_set=process_set)
+    return _from_row(mx, out, tensor.context)
+
+
+def reducescatter(tensor, op: ReduceOp = Average, name=None,
+                  process_set=None):
+    mx = _require_mxnet()
+    out = _eager.reducescatter(_to_stack(tensor), op, name=name,
+                               process_set=process_set)
+    return _from_row(mx, out, tensor.context)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a gluon param dict from root (reference
+    ``horovod/mxnet/__init__.py::broadcast_parameters``)."""
+    _require_mxnet()
+    if not hasattr(params, "items"):
+        raise ValueError("broadcast_parameters expects a dict-like of "
+                         "name -> Parameter/NDArray")
+    for name, p in sorted(params.items()):
+        nd = p.data() if hasattr(p, "data") else p
+        broadcast_(nd, root_rank, name=f"broadcast.{name}")
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
+    from ..optim.functions import broadcast_object as _bo
+    return _bo(obj, root_rank, process_set=process_set)
+
+
+def allgather_object(obj, name=None, process_set=None) -> list:
+    from ..optim.functions import allgather_object as _ago
+    return _ago(obj, name=name, process_set=process_set)
+
+
+def DistributedOptimizer(optimizer, op: ReduceOp = Average,
+                         process_set=None):
+    """Wrap ``mx.optimizer.Optimizer`` so ``update()`` sees reduced grads
+    (reference ``horovod/mxnet/__init__.py::DistributedOptimizer``)."""
+    _require_mxnet()
+
+    class _Distributed(optimizer.__class__):
+        def __init__(self):
+            self.__dict__.update(optimizer.__dict__)
+
+        def _do_allreduce(self, index, grad):
+            if isinstance(index, (tuple, list)):
+                grouped = grouped_allreduce(
+                    list(grad), op=op, name=f"grad.{index[0]}",
+                    process_set=process_set)
+                for g, r in zip(grad, grouped):
+                    g[:] = r
+            else:
+                allreduce_(grad, name=f"grad.{index}", op=op,
+                           process_set=process_set)
+
+        def update(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            super().update(index, weight, grad, state)
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            super().update_multi_precision(index, weight, grad, state)
+
+    return _Distributed()
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       process_set=None):
+    """Gluon trainer whose ``_allreduce_grads`` runs the mesh collective
+    (reference ``horovod/mxnet/__init__.py::DistributedTrainer``)."""
+    mx = _require_mxnet()
+
+    class _Trainer(mx.gluon.Trainer):
+        def __init__(self):
+            super().__init__(params, optimizer,
+                             optimizer_params or {}, kvstore=None)
+            # Reference behavior: the optimizer's rescale_grad divides by
+            # world size, so the collective must SUM (not average) or the
+            # update would be scaled by 1/size^2.
+            self._scale /= size()
+
+        def _allreduce_grads(self):
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for g in param.list_grad():
+                        allreduce_(g, name=f"grad.{i}", op=Sum,
+                                   process_set=process_set)
+
+    return _Trainer()

@@ -78,3 +78,26 @@ def broadcast_object(obj: Any, root_rank: int = 0, *,
     out = _one_row(_eager.broadcast(
         _eager.replicated_stack(buf, ps), root_rank, process_set=ps))
     return pickle.loads(out.tobytes())
+
+
+def allgather_object(obj: Any, *, name=None, process_set=None) -> list:
+    """Gather one picklable object per rank; all ranks receive the
+    rank-ordered list (``horovod/torch/functions.py::allgather_object``).
+
+    Byte payloads ride the ragged allgather (sizes exchanged first, like
+    the reference's size-prefixed gather); single-controller mode returns
+    ``size()`` copies of the local object.
+    """
+    import io
+
+    ps = _ps.get_process_set(process_set)
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # One ragged gather is enough: allgatherv exchanges sizes internally,
+    # and pickle streams are self-delimiting, so the concatenation splits
+    # itself back into per-rank objects.
+    data = _eager.allgather_value(payload, name=name, process_set=ps)
+    buf = io.BytesIO(np.asarray(data).tobytes())
+    out = []
+    while buf.tell() < len(buf.getbuffer()):
+        out.append(pickle.load(buf))
+    return out

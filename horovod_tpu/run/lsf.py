@@ -50,10 +50,16 @@ def get_compute_hosts() -> List[Tuple[str, int]]:
         # launch node heading an otherwise ptile=1 rankfile) is
         # undecidable from the file alone; pass -H explicitly in that case.
         rest = hosts[1:]
+        sub_host = os.environ.get("LSB_SUB_HOST")
+        # The slot-shape fallback only applies when LSB_SUB_HOST is absent:
+        # when it IS set and differs from hosts[0], hosts[0] is a genuine
+        # compute host (e.g. an uneven plain-LSF spread), not the launch
+        # node.
         first_is_launch = (
             len(hosts) > 1 and hosts[0] not in rest
-            and (hosts[0] == os.environ.get("LSB_SUB_HOST")
-                 or any(rest.count(h) > 1 for h in set(rest))))
+            and (hosts[0] == sub_host
+                 or (sub_host is None
+                     and any(rest.count(h) > 1 for h in set(rest)))))
         if first_is_launch:
             hosts = rest
         counts: "OrderedDict[str, int]" = OrderedDict()

@@ -142,6 +142,11 @@ def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
     return _bo(obj, root_rank, process_set=process_set)
 
 
+def allgather_object(obj, name=None, process_set=None) -> list:
+    from ..optim.functions import allgather_object as _ago
+    return _ago(obj, name=name, process_set=process_set)
+
+
 class DistributedGradientTape(tf.GradientTape):
     """``tf.GradientTape`` whose ``gradient()`` allreduces the result.
 

@@ -256,5 +256,12 @@ def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
     return _bo(obj, root_rank, process_set=process_set)
 
 
+def allgather_object(obj, name=None, process_set=None) -> list:
+    """Rank-ordered list of every rank's object (reference
+    ``horovod/torch/functions.py::allgather_object``)."""
+    from ..optim.functions import allgather_object as _ago
+    return _ago(obj, name=name, process_set=process_set)
+
+
 from .optimizer import DistributedOptimizer  # noqa: E402,F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: E402,F401

@@ -67,3 +67,16 @@ def test_hierarchical_mesh_single_process(n_devices):
     assert m.shape["dcn"] == 1
     assert m.shape["ici"] == n_devices
     horovod_tpu.shutdown()
+
+
+def test_allgather_object(hvd, n_devices):
+    objs = hvd.allgather_object({"rank_data": [1, 2, 3], "s": "hello"})
+    assert len(objs) == n_devices
+    assert all(o == {"rank_data": [1, 2, 3], "s": "hello"} for o in objs)
+
+
+def test_allgather_object_torch_shim(hvd):
+    import horovod_tpu.torch as thvd
+    objs = thvd.allgather_object(("x", 42))
+    assert len(objs) == thvd.size()
+    assert objs[0] == ("x", 42)

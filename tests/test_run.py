@@ -387,3 +387,15 @@ def test_lsf_rankfile_csm_without_subhost(monkeypatch, tmp_path):
     monkeypatch.delenv("LSB_SUB_HOST", raising=False)
     monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
     assert lsf.get_compute_hosts() == [("h1", 2), ("h2", 1)]
+
+
+def test_lsf_rankfile_uneven_plain_with_subhost(monkeypatch, tmp_path):
+    # Uneven plain-LSF spread with LSB_SUB_HOST set to a login node: the
+    # unique first host is a genuine compute slot and must be kept.
+    from horovod_tpu.run import lsf
+    rf = tmp_path / "rankfile"
+    rf.write_text("nodeA\nnodeB\nnodeB\n")
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_SUB_HOST", "login01")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
+    assert lsf.get_compute_hosts() == [("nodeA", 1), ("nodeB", 2)]
