@@ -52,6 +52,7 @@ from .optim.functions import (  # noqa: F401
 from . import elastic  # noqa: F401
 from .utils.checkpoint import (  # noqa: F401
     save_checkpoint, restore_checkpoint, latest_checkpoint, checkpoint_path,
+    save_checkpoint_sharded, restore_checkpoint_sharded,
 )
 from .training import (  # noqa: F401
     make_train_step, make_eval_step, shard_batch, shard_batch_from_local,

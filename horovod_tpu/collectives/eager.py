@@ -180,6 +180,16 @@ def local_result(out) -> np.ndarray:
     return np.concatenate([np.asarray(s.data) for s in shards])
 
 
+def one_row(out) -> np.ndarray:
+    """One locally-addressable rank's row of a rank-stacked result.
+
+    After a broadcast/allreduce every row is identical, so any local
+    shard serves; used by the framework shims and the broadcast helpers
+    (works multi-process, where the global array spans non-addressable
+    devices)."""
+    return np.array(np.asarray(out.addressable_shards[0].data)[0])
+
+
 # ---------------------------------------------------------------------------
 # Handle table (HandleManager analogue, horovod/torch/handle_manager.cc).
 # ---------------------------------------------------------------------------

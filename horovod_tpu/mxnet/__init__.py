@@ -46,8 +46,7 @@ def _to_stack(nd) -> np.ndarray:
 
 
 def _from_row(mx, out, ctx):
-    row = np.array(np.asarray(out.addressable_shards[0].data)[0])
-    return mx.nd.array(row, ctx=ctx)
+    return mx.nd.array(_eager.one_row(out), ctx=ctx)
 
 
 def allreduce(tensor, average: Optional[bool] = None, name=None,

@@ -19,13 +19,7 @@ from ..core import process_sets as _ps
 
 
 def _one_row(out) -> np.ndarray:
-    """One rank's row of a rank-stacked result.
-
-    After a broadcast every row is identical, so any locally-addressable
-    shard will do -- this also works in multi-process mode, where the
-    global array spans non-addressable devices.
-    """
-    return np.asarray(out.addressable_shards[0].data)[0]
+    return _eager.one_row(out)
 
 
 def broadcast_(tree: Any, root_rank: int = 0, *, process_set=None) -> Any:

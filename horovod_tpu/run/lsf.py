@@ -51,15 +51,19 @@ def get_compute_hosts() -> List[Tuple[str, int]]:
         # undecidable from the file alone; pass -H explicitly in that case.
         rest = hosts[1:]
         sub_host = os.environ.get("LSB_SUB_HOST")
-        # The slot-shape fallback only applies when LSB_SUB_HOST is absent:
-        # when it IS set and differs from hosts[0], hosts[0] is a genuine
-        # compute host (e.g. an uneven plain-LSF spread), not the launch
-        # node.
+
+        def _stem(h):  # FQDN vs short-name tolerant compare
+            return h.split(".", 1)[0].lower()
+
+        # The slot-shape fallback only applies when LSB_SUB_HOST is absent
+        # or matches (by hostname stem): when it IS set and names a
+        # different machine, hosts[0] is a genuine compute host (e.g. an
+        # uneven plain-LSF spread from a login node), not the launch node.
+        sub_matches = sub_host is None or _stem(hosts[0]) == _stem(sub_host)
         first_is_launch = (
-            len(hosts) > 1 and hosts[0] not in rest
-            and (hosts[0] == sub_host
-                 or (sub_host is None
-                     and any(rest.count(h) > 1 for h in set(rest)))))
+            len(hosts) > 1 and hosts[0] not in rest and sub_matches
+            and (sub_host is not None
+                 or any(rest.count(h) > 1 for h in set(rest))))
         if first_is_launch:
             hosts = rest
         counts: "OrderedDict[str, int]" = OrderedDict()

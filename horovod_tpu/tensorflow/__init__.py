@@ -47,8 +47,7 @@ def _to_stack(t) -> np.ndarray:
 
 
 def _from_row(out, like) -> tf.Tensor:
-    row = np.array(np.asarray(out.addressable_shards[0].data)[0])
-    return tf.convert_to_tensor(row, dtype=like.dtype if
+    return tf.convert_to_tensor(_eager.one_row(out), dtype=like.dtype if
                                 hasattr(like, "dtype") else None)
 
 

@@ -58,9 +58,8 @@ def _to_stack(t: torch.Tensor) -> np.ndarray:
 
 
 def _from_row(out, like: torch.Tensor) -> torch.Tensor:
-    row = np.asarray(out.addressable_shards[0].data)[0]
-    # Copy: the buffer is jax-owned (and may be non-writable).
-    return torch.from_numpy(np.array(row)).to(like.dtype)
+    # one_row copies: the buffer is jax-owned (and may be non-writable).
+    return torch.from_numpy(_eager.one_row(out)).to(like.dtype)
 
 
 # -- tensor collectives ------------------------------------------------------

@@ -2,4 +2,5 @@
 
 from .checkpoint import (  # noqa: F401
     checkpoint_path, latest_checkpoint, restore_checkpoint, save_checkpoint,
+    restore_checkpoint_sharded, save_checkpoint_sharded,
 )

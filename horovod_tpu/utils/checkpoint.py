@@ -135,3 +135,54 @@ def latest_checkpoint(directory: str,
             best = max(best, (int(m.group(1)),
                               os.path.join(directory, name)))
     return best[1]
+
+
+def save_checkpoint_sharded(directory: str, tree: Any, *,
+                            step: int = 0) -> str:
+    """Sharded orbax checkpoint: every host writes its own shards.
+
+    The pod-scale complement to :func:`save_checkpoint` (SURVEY.md 5.4:
+    "orbax-style sharded checkpoint" for states too large for rank-0
+    gather-and-write).  Synchronous and collective -- every process must
+    call it with the same ``step``.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(directory,
+                                        f"sharded_{step:010d}"))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=True)
+    return path
+
+
+def restore_checkpoint_sharded(directory: str, like: Any, *,
+                               step: Optional[int] = None
+                               ) -> Tuple[Any, Optional[int]]:
+    """Restore an orbax sharded checkpoint onto ``like``'s shardings.
+
+    ``like`` supplies structure, dtypes, AND shardings (jax.Arrays on the
+    mesh restore distributed, exactly as saved).  ``step=None`` picks the
+    newest step under ``directory``.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    if step is None:
+        pat = re.compile(r"^sharded_(\d+)$")
+        steps = [int(m.group(1)) for name in
+                 (os.listdir(directory) if os.path.isdir(directory) else [])
+                 if (m := pat.match(name))]
+        if not steps:
+            return None, None
+        step = max(steps)
+    path = os.path.abspath(os.path.join(directory,
+                                        f"sharded_{step:010d}"))
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            np.shape(x), np.asarray(x).dtype if not hasattr(x, "dtype")
+            else x.dtype,
+            sharding=x.sharding if hasattr(x, "sharding") else None),
+        like)
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(path, abstract)
+    return tree, step

@@ -41,3 +41,28 @@ def test_latest_checkpoint_ordering(hvd, tmp_path):
     assert latest is not None and latest.endswith("0000000012.npz")
     _, step = hv.restore_checkpoint(latest, {"x": jnp.zeros(1)})
     assert step == 12
+
+
+def test_orbax_sharded_roundtrip(hvd, tmp_path):
+    """Sharded orbax checkpoint preserves values AND shardings."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = hv.mesh()
+    sharded = jax.device_put(jnp.arange(32.0).reshape(8, 4),
+                             NamedSharding(mesh, P("hvd")))
+    tree = {"w": hv.replicate(jnp.ones((3, 3))),
+            "data": sharded, "scale": jnp.float32(0.5)}
+    d = str(tmp_path / "sharded")
+    hv.save_checkpoint_sharded(d, tree, step=7)
+    hv.save_checkpoint_sharded(d, tree, step=9)
+    out, step = hv.restore_checkpoint_sharded(d, tree)
+    assert step == 9
+    np.testing.assert_allclose(np.asarray(out["data"]),
+                               np.asarray(tree["data"]))
+    assert out["data"].sharding == tree["data"].sharding
+    out7, step7 = hv.restore_checkpoint_sharded(d, tree, step=7)
+    assert step7 == 7
+    none_tree, none_step = hv.restore_checkpoint_sharded(
+        str(tmp_path / "empty"), tree)
+    assert none_tree is None and none_step is None

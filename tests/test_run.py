@@ -399,3 +399,14 @@ def test_lsf_rankfile_uneven_plain_with_subhost(monkeypatch, tmp_path):
     monkeypatch.setenv("LSB_SUB_HOST", "login01")
     monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
     assert lsf.get_compute_hosts() == [("nodeA", 1), ("nodeB", 2)]
+
+
+def test_lsf_rankfile_fqdn_subhost(monkeypatch, tmp_path):
+    # FQDN rankfile vs short-name LSB_SUB_HOST still drops the launch node.
+    from horovod_tpu.run import lsf
+    rf = tmp_path / "rankfile"
+    rf.write_text("launch01.cluster.com\nh1\nh1\n")
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_SUB_HOST", "launch01")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
+    assert lsf.get_compute_hosts() == [("h1", 2)]
