@@ -20,6 +20,15 @@ architecture here:
 * ``HOROVOD_AUTOTUNE=1`` enables, ``HOROVOD_AUTOTUNE_LOG`` persists the
   sampled configurations as CSV and warm-starts the next run (reference
   warm-start file behavior).
+
+The reference's ParameterManager additionally flips the hierarchical-
+allreduce/-allgather flags and the response-cache toggle.  Those knobs
+collapse under XLA: the (dcn, ici) mesh is fixed at ``init`` and a
+reduction over both axes IS the hierarchical algorithm (XLA schedules
+the two-level exchange; there is no per-op flat-vs-hierarchical choice
+to search), and the executable cache has no bitvector fast path to
+toggle -- a hit is always strictly cheaper than a retrace.  So the
+tunable surface here is exactly the two knobs that still exist.
 """
 
 from __future__ import annotations
