@@ -1,9 +1,12 @@
 """Elastic training demo/integration workload.
 
-Counts "batches" with a tiny matmul train step, committing every batch;
-tolerates rescale (HostsUpdatedInterrupt) and peer failure (rollback).
-Used by the elastic integration tests with a mutating discovery script,
-mirroring the reference's ``test_elastic_torch.py`` localhost harness.
+Counts "batches" with a tiny matmul train step (or, with
+``ELASTIC_MODEL=resnet50``, the BASELINE "Elastic ResNet-50 on a
+preemptible slice" workload: the flax RN50 behind the same protocol),
+committing every batch; tolerates rescale (``HostsUpdatedInterrupt``)
+and peer failure (rollback).  Used by the elastic integration tests with
+a mutating discovery script, mirroring the reference's
+``test_elastic_torch.py`` localhost harness.
 """
 
 import sys as _sys
@@ -27,21 +30,70 @@ def main():
 
     hvd.init()
 
+    model_name = os.environ.get("ELASTIC_MODEL", "matmul")
+    image_size = int(os.environ.get("ELASTIC_IMAGE_SIZE", "64"))
+
+    # Model/optimizer/data are world-size independent and built once;
+    # data() takes the CURRENT size at each batch.  The compiled STEP is
+    # rebuilt per train() entry because it binds the mesh, which changes
+    # on every rescale re-init.
+    if model_name == "resnet50":
+        from horovod_tpu.models import ResNet50
+        model = ResNet50(num_classes=100, dtype=jnp.float32)
+        opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+
+        def make_step():
+            return hvd.make_flax_train_step(model.apply, opt)
+
+        def data(n):
+            x = jnp.ones((2 * n, image_size, image_size, 3), jnp.float32)
+            y = jnp.zeros((2 * n,), jnp.int32)
+            return hvd.shard_batch((x, y))
+
+        v0 = model.init(
+            jax.random.PRNGKey(0),
+            jnp.ones((2, image_size, image_size, 3), jnp.float32),
+            train=True)
+        init_params = jax.device_get(v0["params"])
+        extra = {"batch_stats": jax.device_get(v0["batch_stats"])}
+    else:
+        opt = hvd.DistributedOptimizer(optax.sgd(0.01))
+
+        def make_step():
+            return hvd.make_train_step(
+                lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2), opt)
+
+        def data(n):
+            x = jnp.ones((2 * n, 4), jnp.float32)
+            y = jnp.zeros((2 * n, 4), jnp.float32)
+            return hvd.shard_batch((x, y))
+
+        init_params = {"w": jnp.zeros((4, 4), jnp.float32)}
+        extra = {}
+
     @elastic.run
     def train(state):
         import horovod_tpu as hvd  # re-read size after potential re-init
-        opt = hvd.DistributedOptimizer(optax.sgd(0.01))
-        step_fn = hvd.make_train_step(
-            lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2), opt)
+        step_fn = make_step()  # binds the CURRENT (post-rescale) mesh
         params = hvd.replicate(jax.tree.map(jnp.asarray, state.params))
-        opt_state = opt.init(params)
-        n = hvd.size()
+        # Momentum buffers survive rescale/rollback like the params do:
+        # opt_state is part of the committed state, not rebuilt.
+        opt_state = hvd.replicate(jax.tree.map(jnp.asarray,
+                                               state.opt_state))
+        if model_name == "resnet50":
+            stats = hvd.replicate(jax.tree.map(
+                jnp.asarray, state.extra["batch_stats"]))
         while state.batch < target:
-            x = jnp.ones((2 * n, 4), jnp.float32)
-            y = jnp.zeros((2 * n, 4), jnp.float32)
-            batch = hvd.shard_batch((x, y))
-            params, opt_state, loss = step_fn(params, opt_state, batch)
+            n = hvd.size()
+            batch = data(n)
+            if model_name == "resnet50":
+                params, stats, opt_state, loss = step_fn(
+                    params, stats, opt_state, batch)
+                state.extra["batch_stats"] = jax.device_get(stats)
+            else:
+                params, opt_state, loss = step_fn(params, opt_state, batch)
             state.params = jax.device_get(params)
+            state.opt_state = jax.device_get(opt_state)
             state.batch += 1
             print(f"rank {hvd.rank()}/{n} batch {state.batch} "
                   f"loss {float(loss):.4f}", flush=True)
@@ -50,7 +102,10 @@ def main():
         return state.batch
 
     state = elastic.JaxState(
-        params={"w": jnp.zeros((4, 4), jnp.float32)}, batch=0)
+        params=init_params,
+        opt_state=jax.device_get(
+            opt.init(jax.tree.map(jnp.asarray, init_params))),
+        batch=0, extra=extra)
     done = train(state)
     print(f"rank {hvd.rank()}: finished at batch {done} "
           f"(final size {hvd.size()})", flush=True)

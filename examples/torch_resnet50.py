@@ -1,0 +1,138 @@
+"""ResNet-50 through the PyTorch API shim.
+
+BASELINE.json config: "ResNet-50 ImageNet (horovod.torch and
+horovod.tensorflow2)" -- this is the torch half.  torchvision is not in
+the image, so a standard bottleneck ResNet-50 is defined inline; the
+training loop is the reference's torch idiom (SURVEY.md 4.2):
+``broadcast_parameters`` -> ``DistributedOptimizer(named_parameters=...)``
+with per-gradient async allreduce hooks batched by the native cycle
+scheduler -> ``opt.step()`` draining the handles.
+
+Run::
+
+    python examples/torch_resnet50.py --cpu-devices 4 --image-size 64 --steps 3
+"""
+
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
+
+import argparse
+import time
+
+from _harness import setup_devices
+
+
+def build_resnet50(num_classes: int = 1000):
+    """Standard ImageNet ResNet-50 (He et al. 2015), compact torch form."""
+    import torch
+    from torch import nn
+
+    class Bottleneck(nn.Module):
+        expansion = 4
+
+        def __init__(self, cin, width, stride=1):
+            super().__init__()
+            cout = width * self.expansion
+            self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(width)
+            self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(width)
+            self.conv3 = nn.Conv2d(width, cout, 1, bias=False)
+            self.bn3 = nn.BatchNorm2d(cout)
+            self.relu = nn.ReLU(inplace=True)
+            self.down = None
+            if stride != 1 or cin != cout:
+                self.down = nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            r = x if self.down is None else self.down(x)
+            y = self.relu(self.bn1(self.conv1(x)))
+            y = self.relu(self.bn2(self.conv2(y)))
+            y = self.bn3(self.conv3(y))
+            return self.relu(y + r)
+
+    class ResNet50(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem = nn.Sequential(
+                nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+                nn.ReLU(inplace=True), nn.MaxPool2d(3, 2, 1))
+            layers, cin = [], 64
+            for width, blocks, stride in ((64, 3, 1), (128, 4, 2),
+                                          (256, 6, 2), (512, 3, 2)):
+                for b in range(blocks):
+                    layers.append(Bottleneck(cin, width,
+                                             stride if b == 0 else 1))
+                    cin = width * Bottleneck.expansion
+            self.body = nn.Sequential(*layers)
+            self.head = nn.Linear(cin, num_classes)
+
+        def forward(self, x):
+            y = self.body(self.stem(x))
+            y = torch.flatten(torch.nn.functional.adaptive_avg_pool2d(
+                y, 1), 1)
+            return self.head(y)
+
+    return ResNet50()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--compression", choices=("none", "fp16", "bf16"),
+                   default="none")
+    p.add_argument("--cpu-devices", type=int, default=0)
+    args = p.parse_args()
+
+    setup_devices(args.cpu_devices)
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(1234)  # identical init everywhere; broadcast verifies
+    model = build_resnet50(args.classes)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    compression = {"none": hvd.Compression.none,
+                   "fp16": hvd.Compression.fp16,
+                   "bf16": hvd.Compression.bf16}[args.compression]
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=args.lr, momentum=0.9),
+        named_parameters=model.named_parameters(),
+        compression=compression)
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    g = torch.Generator().manual_seed(hvd.rank())
+    x = torch.randn(args.batch_size, 3, args.image_size, args.image_size,
+                    generator=g)
+    y = torch.randint(0, args.classes, (args.batch_size,), generator=g)
+
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        opt.zero_grad()
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss.detach()))
+    dt = time.perf_counter() - t0
+
+    imgs = args.steps * args.batch_size * hvd.size()
+    print(f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+    print(f"{imgs / dt:.1f} images/s total "
+          f"({args.steps} steps, size {hvd.size()}, torch shim)")
+    assert np.isfinite(losses[-1])
+    print("torch resnet50 OK")
+
+
+if __name__ == "__main__":
+    main()

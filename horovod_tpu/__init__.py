@@ -55,8 +55,9 @@ from .utils.checkpoint import (  # noqa: F401
     save_checkpoint_sharded, restore_checkpoint_sharded,
 )
 from .training import (  # noqa: F401
-    make_train_step, make_eval_step, shard_batch, shard_batch_from_local,
-    replicate, batch_sharding, replicated_sharding, sync_batch_norm,
+    make_train_step, make_flax_train_step, make_eval_step, shard_batch,
+    shard_batch_from_local, replicate, batch_sharding,
+    replicated_sharding, sync_batch_norm,
 )
 
 __version__ = "0.1.0"

@@ -125,10 +125,11 @@ def _write_hosts(path, content):
 
 
 def _run_elastic_live(tmp_path, initial, mutated, expect_final, target=40,
-                      extra_args=()):
+                      extra_args=(), env_extra=None, delay="0.4"):
     """Shared live-rescale harness: start the elastic launcher, mutate the
-    discovery listing once training demonstrably progresses, assert the
-    run finishes at the expected final size."""
+    discovery listing once training demonstrably progresses (pass
+    ``mutated=None`` for a static-membership run), assert the run
+    finishes at the expected final size."""
     import threading
 
     hosts = tmp_path / "hosts.txt"
@@ -140,7 +141,9 @@ def _run_elastic_live(tmp_path, initial, mutated, expect_final, target=40,
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["ELASTIC_TARGET_BATCHES"] = str(target)
-    env["ELASTIC_BATCH_DELAY_S"] = "0.4"
+    env["ELASTIC_BATCH_DELAY_S"] = delay
+    if env_extra:
+        env.update(env_extra)
     proc = subprocess.Popen(
         [sys.executable, "-m", "horovod_tpu.run",
          "--host-discovery-script", str(disc), "--min-np", "2",
@@ -158,7 +161,8 @@ def _run_elastic_live(tmp_path, initial, mutated, expect_final, target=40,
     try:
         for line in proc.stdout:
             lines.append(line)
-            if not mutated_flag and " batch 5 " in line:
+            if mutated is not None and not mutated_flag \
+                    and " batch 5 " in line:
                 _write_hosts(hosts, mutated)
                 mutated_flag = True
         proc.wait(timeout=60)
@@ -169,7 +173,7 @@ def _run_elastic_live(tmp_path, initial, mutated, expect_final, target=40,
         proc.wait(timeout=30)
         proc.stdout.close()
     out = "".join(lines)
-    assert mutated_flag, out[-4000:]
+    assert mutated is None or mutated_flag, out[-4000:]
     assert proc.returncode == 0, out[-4000:]
     assert f"final size {expect_final}" in out, out[-4000:]
 
@@ -213,3 +217,13 @@ def test_discovery_failure_keeps_last_known_hosts(tmp_path):
     script.write_text("#!/bin/sh\ncat %s\n" % (tmp_path / "hosts"))
     (tmp_path / "hosts").write_text("a\n")  # genuine scale-down
     assert d.find_available_hosts_and_slots() == {"a": 1}
+
+
+@pytest.mark.integration
+def test_elastic_resnet50_variant(tmp_path):
+    """BASELINE's elastic-RN50 workload: the flax ResNet-50 behind the
+    same commit/restore protocol (static 2-host membership smoke)."""
+    _run_elastic_live(tmp_path, "a\nb\n", None, expect_final=2, target=2,
+                      env_extra={"ELASTIC_MODEL": "resnet50",
+                                 "ELASTIC_IMAGE_SIZE": "32"},
+                      delay="0.05")

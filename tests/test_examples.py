@@ -56,3 +56,19 @@ def test_long_context_example_ulysses_cpu():
                 "--cpu-devices", "8", "--seq-len", "256", "--steps", "8",
                 "--mode", "ulysses"])
     assert "final loss" in out
+
+
+@pytest.mark.integration
+def test_torch_resnet50_example_cpu():
+    out = _run([os.path.join(REPO, "examples", "torch_resnet50.py"),
+                "--cpu-devices", "2", "--image-size", "64",
+                "--batch-size", "2", "--steps", "2"])
+    assert "torch resnet50 OK" in out
+
+
+@pytest.mark.integration
+def test_tf2_resnet50_example_cpu():
+    out = _run([os.path.join(REPO, "examples", "tf2_resnet50.py"),
+                "--cpu-devices", "2", "--image-size", "64",
+                "--batch-size", "2", "--steps", "2"])
+    assert "tf2 resnet50 OK" in out
