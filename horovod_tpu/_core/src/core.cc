@@ -27,8 +27,13 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
+#if defined(__linux__)
+#include <pthread.h>
+#include <sched.h>
+#endif
 #include <list>
 #include <map>
 #include <mutex>
@@ -195,7 +200,31 @@ class CycleScheduler {
     flush_ = false;
     running_ = true;
     thread_ = std::thread([this] { Loop(); });
+    PinThread(thread_);
     return 0;
+  }
+
+  // HOROVOD_THREAD_AFFINITY parity (reference env_parser.cc +
+  // operations.cc): pin the background cycle thread to the named CPU so
+  // it never migrates onto the cores feeding the device.  The reference
+  // accepts a comma-separated per-thread list; this runtime has ONE
+  // cycle thread, so the FIRST element applies.  Ignored when unset,
+  // malformed, or out of range.
+  static void PinThread(std::thread& t) {
+#if defined(__linux__)
+    const char* env = std::getenv("HOROVOD_THREAD_AFFINITY");
+    if (!env || !*env) return;
+    char* end = nullptr;
+    long cpu = std::strtol(env, &end, 10);
+    if (end == env || (*end != '\0' && *end != ',') ||
+        cpu < 0 || cpu >= CPU_SETSIZE) return;
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    CPU_SET(static_cast<int>(cpu), &set);
+    pthread_setaffinity_np(t.native_handle(), sizeof(set), &set);
+#else
+    (void)t;
+#endif
   }
 
   void Stop() {

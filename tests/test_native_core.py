@@ -266,3 +266,29 @@ def test_deterministic_rapid_flush_then_enqueue_race():
         sched.stop()
     assert len(batches) == 200
     assert all(len(b) == 4 for b in batches)
+
+
+def test_thread_affinity_env(monkeypatch):
+    """HOROVOD_THREAD_AFFINITY pins the native cycle thread: the
+    scheduler must start (PinThread runs in Start), batch, and stop with
+    the env set -- including the reference's comma-separated form and a
+    malformed value, both of which must be non-fatal."""
+    import threading
+    import time
+
+    for value in ("0", "0,1", "not-a-cpu"):
+        batches, done = [], threading.Event()
+
+        def on_batch(payloads, batches=batches, done=done):
+            batches.append(payloads)
+            done.set()
+
+        monkeypatch.setenv("HOROVOD_THREAD_AFFINITY", value)
+        sched = _core.NativeScheduler(on_batch, cycle_ms=20.0)
+        try:
+            sched.enqueue(("g", 0), name="g0", dtype_code=1, nbytes=8)
+            assert done.wait(5.0), f"no batch under affinity={value!r}"
+            time.sleep(0.05)
+        finally:
+            sched.stop()
+        assert [p for b in batches for p in b] == [("g", 0)]
