@@ -20,6 +20,7 @@ from .core.basics import (  # noqa: F401
     init, shutdown, is_initialized, mesh, reduce_axes,
     size, rank, local_size, local_rank, cross_size, cross_rank,
     is_homogeneous, nccl_built, mpi_built, gloo_built, tpu_built,
+    cuda_built, rocm_built, start_timeline, stop_timeline,
     mpi_threads_supported,
 )
 from .core.exceptions import (  # noqa: F401

@@ -235,6 +235,14 @@ def nccl_built() -> bool:
     return False
 
 
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
 def mpi_built() -> bool:
     return False
 
@@ -249,3 +257,32 @@ def tpu_built() -> bool:
 
 def mpi_threads_supported() -> bool:
     return False
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Start (or restart) timeline capture at runtime
+    (``hvd.start_timeline`` parity; the env-driven path is
+    ``HOROVOD_TIMELINE`` at init).  Like the reference, requires
+    ``init()`` first -- init would otherwise silently replace (and leak)
+    a pre-init timeline via its ``HOROVOD_TIMELINE`` path."""
+    from ..timeline import Timeline
+    from .exceptions import NotInitializedError
+
+    if not is_initialized():
+        raise NotInitializedError(
+            "hvd.start_timeline() requires hvd.init() first")
+    st = global_state()
+    with st.lock:
+        if st.timeline is not None:
+            st.timeline.close()
+        st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+
+
+def stop_timeline() -> None:
+    """Stop timeline capture and finalize the trace file
+    (``hvd.stop_timeline`` parity)."""
+    st = global_state()
+    with st.lock:
+        if st.timeline is not None:
+            st.timeline.close()
+            st.timeline = None

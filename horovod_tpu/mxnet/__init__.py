@@ -20,6 +20,7 @@ import numpy as np
 from ..core.basics import (  # noqa: F401
     init, shutdown, is_initialized, size, rank, local_size, local_rank,
     cross_size, cross_rank, nccl_built, mpi_built, gloo_built, tpu_built,
+    cuda_built, rocm_built, start_timeline, stop_timeline,
     mpi_threads_supported,
 )
 from ..collectives.reduce_op import (  # noqa: F401

@@ -23,6 +23,7 @@ import tensorflow as tf
 from ..core.basics import (  # noqa: F401
     init, shutdown, is_initialized, size, rank, local_size, local_rank,
     cross_size, cross_rank, is_homogeneous, nccl_built, mpi_built,
+    cuda_built, rocm_built, start_timeline, stop_timeline,
     gloo_built, tpu_built, mpi_threads_supported,
 )
 from ..core.exceptions import (  # noqa: F401

@@ -1,6 +1,7 @@
 """Core lifecycle/identity tests (parity: reference test_torch.py basics)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -80,3 +81,23 @@ def test_allgather_object_torch_shim(hvd):
     objs = thvd.allgather_object(("x", 42))
     assert len(objs) == thvd.size()
     assert objs[0] == ("x", 42)
+
+
+def test_built_probes_and_runtime_timeline(hvd, tmp_path):
+    assert not hvd.cuda_built()
+    assert not hvd.rocm_built()
+    assert hvd.tpu_built()
+    # Runtime timeline start/stop (hvd.start_timeline parity).
+    for shim in ("torch_api", "tensorflow", "keras", "mxnet"):
+        import importlib
+        m = importlib.import_module(f"horovod_tpu.{shim}")
+        assert callable(m.start_timeline) and callable(m.stop_timeline)
+    path = str(tmp_path / "tl.json")
+    hvd.start_timeline(path, mark_cycles=True)
+    hvd.allreduce(jnp.ones((hvd.size(), 2)), hvd.Sum, name="tl_probe")
+    hvd.stop_timeline()
+    import json
+    with open(path) as f:
+        events = json.load(f)
+    assert any(e.get("name", "").startswith("tl_probe")
+               or "tl_probe" in str(e) for e in events), events[:5]
