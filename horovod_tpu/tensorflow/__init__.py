@@ -164,6 +164,7 @@ class DistributedGradientTape(tf.GradientTape):
         self._hvd_compression = compression
         self._hvd_op = op
         self._hvd_process_set = process_set
+        self._hvd_sparse_as_dense = sparse_as_dense
 
     def gradient(self, target, sources, output_gradients=None,
                  unconnected_gradients=tf.UnconnectedGradients.NONE):
@@ -171,6 +172,16 @@ class DistributedGradientTape(tf.GradientTape):
                                  unconnected_gradients)
         flat = tf.nest.flatten(grads)
         idx = [i for i, g in enumerate(flat) if g is not None]
+        for i in idx:
+            if isinstance(flat[i], tf.IndexedSlices):
+                # Embedding-style sparse grads: densify before the dense
+                # allreduce (reference sparse_as_dense), or refuse loudly.
+                if not self._hvd_sparse_as_dense:
+                    raise ValueError(
+                        "IndexedSlices gradient with sparse_as_dense="
+                        "False; dense allreduce needs sparse_as_dense="
+                        "True")
+                flat[i] = tf.convert_to_tensor(flat[i])
         if idx:
             reduced = grouped_allreduce(
                 [tf.convert_to_tensor(flat[i]) for i in idx],
@@ -184,7 +195,8 @@ class DistributedGradientTape(tf.GradientTape):
 def DistributedOptimizer(optimizer, compression=Compression.none,
                          op: ReduceOp = Average, process_set=None,
                          backward_passes_per_step: int = 1,
-                         average_aggregated_gradients: bool = True):
+                         average_aggregated_gradients: bool = True,
+                         sparse_as_dense: bool = True):
     """Keras-3 optimizer wrapper: allreduce grads in ``apply_gradients``.
 
     Reference: ``horovod/tensorflow/__init__.py::DistributedOptimizer``
@@ -207,6 +219,16 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
 
         def _hvd_reduce_and_apply(self, grads, tvars, args, kwargs):
             idx = [i for i, g in enumerate(grads) if g is not None]
+            for i in idx:
+                if isinstance(grads[i], tf.IndexedSlices):
+                    # Same policy as DistributedGradientTape: densify
+                    # for the dense allreduce only with explicit opt-in.
+                    if not sparse_as_dense:
+                        raise ValueError(
+                            "IndexedSlices gradient with sparse_as_dense"
+                            "=False; dense allreduce needs "
+                            "sparse_as_dense=True")
+                    grads[i] = tf.convert_to_tensor(grads[i])
             if idx:
                 reduced = grouped_allreduce(
                     [tf.convert_to_tensor(grads[i]) for i in idx],

@@ -110,6 +110,24 @@ def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
     return [_from_row(o, t) for o, t in zip(outs, tensors)]
 
 
+def grouped_allreduce_async(tensors: List[torch.Tensor], average=None,
+                            name=None, op=None, process_set=None,
+                            compression=Compression.none) -> int:
+    """One handle for the whole group (``hvd.grouped_allreduce_async``
+    parity); ``synchronize(handle)`` returns the list of results."""
+    op = _resolve_op(average, op)
+    outs = _eager.grouped_allreduce([_to_stack(t) for t in tensors], op,
+                                    name=name, process_set=process_set,
+                                    compression=compression)
+    return _handles.alloc(outs, list(tensors), inplace=False)
+
+
+def grouped_allreduce_async_(tensors: List[torch.Tensor], **kwargs) -> int:
+    h = grouped_allreduce_async(tensors, **kwargs)
+    _handles.mark_inplace(h)
+    return h
+
+
 def allgather(tensor: torch.Tensor, name: Optional[str] = None,
               process_set=None) -> torch.Tensor:
     """Reference parity: first dimensions MAY differ across ranks (the
@@ -184,9 +202,16 @@ class _HandleTable:
         out, like, _ = self._entries[h]
         self._entries[h] = (out, like, True)
 
-    def synchronize(self, h: int) -> torch.Tensor:
+    def synchronize(self, h: int) -> "torch.Tensor | List[torch.Tensor]":
         out, like, inplace = self._entries.pop(h)
         result = _eager.synchronize(h)
+        if isinstance(like, (list, tuple)):  # grouped handle
+            values = [_from_row(r, t) for r, t in zip(result, like)]
+            if inplace:
+                for t, v in zip(like, values):
+                    t.copy_(v)
+                return list(like)
+            return values
         value = _from_row(result, like)
         if inplace:
             like.copy_(value)
@@ -200,7 +225,9 @@ class _HandleTable:
 _handles = _HandleTable()
 
 
-def synchronize(handle: int) -> torch.Tensor:
+def synchronize(handle: int) -> "torch.Tensor | List[torch.Tensor]":
+    """Single-tensor handles return the tensor; grouped handles (from
+    ``grouped_allreduce_async[_]``) return the list of results."""
     return _handles.synchronize(handle)
 
 

@@ -27,7 +27,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     """Mixin providing hooks + synchronize; never instantiated directly."""
 
     def _init_distributed(self, named_parameters, compression, op,
-                          backward_passes_per_step, process_set) -> None:
+                          backward_passes_per_step, process_set,
+                          sparse_as_dense) -> None:
+        self._sparse_as_dense = sparse_as_dense
         # Every param needs a UNIQUE name: in multi-process mode the
         # native scheduler cuts fused buckets in name-sorted order, so
         # duplicate names would let bucket layouts diverge across ranks
@@ -82,6 +84,23 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if self._counter[p] < self.backward_passes_per_step:
                 return  # local accumulation pass: no comm
             self._counter[p] = 0
+            if p.grad.is_sparse:
+                # Reference parity (horovod/torch/optimizer.py
+                # sparse_as_dense): dense allreduce after densify, or an
+                # explicit error -- never a silent wrong result.  NOTE:
+                # a .grad object is only sparse when autograd CREATED it
+                # (after zero_grad(set_to_none=True), the torch default);
+                # while the wrap-time dense zero buffer is alive, sparse
+                # outputs accumulate into it and reduce densely, so the
+                # strict error surfaces at the first post-zero_grad
+                # backward, not step 1.
+                if not self._sparse_as_dense:
+                    raise ValueError(
+                        "sparse gradient encountered (e.g. Embedding("
+                        "sparse=True)); pass sparse_as_dense=True to "
+                        "DistributedOptimizer to densify before the "
+                        "collective")
+                p.grad = p.grad.to_dense()
             if self.backward_passes_per_step > 1:
                 p.grad.div_(self.backward_passes_per_step)
             name = self._param_names.get(p)
@@ -148,12 +167,15 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op: ReduceOp = Average,
-                         process_set=None) -> torch.optim.Optimizer:
+                         process_set=None,
+                         sparse_as_dense: bool = False
+                         ) -> torch.optim.Optimizer:
     """Wrap a torch optimizer so ``step()`` sees globally-reduced grads."""
     named = list(named_parameters) if named_parameters is not None else None
     optimizer.__class__ = type(
         "Distributed" + optimizer.__class__.__name__,
         (_DistributedOptimizer, optimizer.__class__), {})
     optimizer._init_distributed(named, compression, op,
-                                backward_passes_per_step, process_set)
+                                backward_passes_per_step, process_set,
+                                sparse_as_dense)
     return optimizer

@@ -248,3 +248,46 @@ def test_torch_allgather_equal_dims_still_works(hvd):
     n = thvd.size()
     assert out.shape == (2 * n, 3)
     np.testing.assert_allclose(out[:2].numpy(), t.numpy())
+
+
+def test_grouped_allreduce_async_roundtrip(hvd_t):
+    ts = [torch.ones(3) * (i + 1) for i in range(3)]
+    h = hvd_t.grouped_allreduce_async(ts, op=hvd_t.Sum,
+                                          name="gaa")
+    outs = hvd_t.synchronize(h)
+    n = hvd_t.size()
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(), np.full(3, (i + 1) * n))
+    # In-place variant writes back into the inputs.
+    ts2 = [torch.ones(2) * 3.0, torch.ones(2) * 5.0]
+    h2 = hvd_t.grouped_allreduce_async_(ts2, name="gaa_")
+    hvd_t.synchronize(h2)  # Average over identical rows == identity
+    np.testing.assert_allclose(ts2[0].numpy(), [3.0, 3.0])
+    np.testing.assert_allclose(ts2[1].numpy(), [5.0, 5.0])
+
+
+def test_sparse_grad_requires_flag(hvd_t):
+    # After zero_grad(set_to_none=True) -- the torch default -- a sparse
+    # backward materializes a sparse .grad; without sparse_as_dense the
+    # hook must refuse loudly (reference semantics), not mis-reduce.
+    emb = torch.nn.Embedding(8, 4, sparse=True)
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.1),
+        named_parameters=emb.named_parameters())
+    opt.zero_grad()
+    with pytest.raises(ValueError, match="sparse_as_dense"):
+        emb(torch.tensor([1, 2])).sum().backward()
+
+
+def test_sparse_as_dense_trains(hvd_t):
+    emb = torch.nn.Embedding(8, 4, sparse=True)
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.5),
+        named_parameters=emb.named_parameters(), sparse_as_dense=True)
+    before = emb.weight.detach().clone()
+    opt.zero_grad()
+    emb(torch.tensor([1, 2])).sum().backward()
+    opt.step()
+    after = emb.weight.detach()
+    assert not torch.allclose(before[1], after[1])
+    assert torch.allclose(before[0], after[0])  # untouched row
