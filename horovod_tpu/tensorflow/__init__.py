@@ -158,8 +158,10 @@ class DistributedGradientTape(tf.GradientTape):
 
     def __init__(self, tape: tf.GradientTape,
                  compression=Compression.none, op: ReduceOp = Average,
-                 process_set=None, sparse_as_dense: bool = True):
-        # Adopt the wrapped tape's recording state.
+                 process_set=None, sparse_as_dense: bool = False):
+        # Adopt the wrapped tape's recording state.  sparse_as_dense
+        # defaults OFF like the reference: densifying an embedding grad
+        # can be a huge silent memory cost, so it is explicit opt-in.
         self.__dict__.update(tape.__dict__)
         self._hvd_compression = compression
         self._hvd_op = op
@@ -196,7 +198,7 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
                          op: ReduceOp = Average, process_set=None,
                          backward_passes_per_step: int = 1,
                          average_aggregated_gradients: bool = True,
-                         sparse_as_dense: bool = True):
+                         sparse_as_dense: bool = False):
     """Keras-3 optimizer wrapper: allreduce grads in ``apply_gradients``.
 
     Reference: ``horovod/tensorflow/__init__.py::DistributedOptimizer``
@@ -257,6 +259,12 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
                     for i, g in enumerate(grads)]
             for buf, g in zip(self._hvd_agg_bufs, grads):
                 if buf is not None and g is not None:
+                    if isinstance(g, tf.IndexedSlices) \
+                            and not sparse_as_dense:
+                        raise ValueError(
+                            "IndexedSlices gradient with sparse_as_dense"
+                            "=False; dense aggregation needs "
+                            "sparse_as_dense=True")
                     buf.assign_add(tf.convert_to_tensor(g))
             self._hvd_agg_counter.assign_add(1)
 

@@ -128,6 +128,59 @@ def grouped_allreduce_async_(tensors: List[torch.Tensor], **kwargs) -> int:
     return h
 
 
+def sparse_allreduce_async(tensor: torch.Tensor,
+                           name: Optional[str] = None,
+                           op: ReduceOp = Average,
+                           process_set=None):
+    """Allreduce a ``torch.sparse_coo`` tensor WITHOUT densifying
+    (reference ``horovod/torch/mpi_ops.py::sparse_allreduce_async``):
+    each rank's indices+values are allgathered (ragged) and summed by
+    coalescing, so the wire cost scales with nnz, not the dense shape.
+    Returns a handle; ``synchronize(handle)`` yields the coalesced
+    sparse result.
+    """
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce_async expects a sparse tensor; "
+                         "use allreduce for dense tensors")
+    if op not in (Average, Sum):
+        raise ValueError("sparse allreduce supports Average/Sum only")
+    t = tensor.detach().cpu().coalesce()
+    sd = t.sparse_dim()
+    tail = tuple(t.values().shape[1:])
+    width = sd + int(np.prod(tail, dtype=np.int64))  # prod(()) == 1
+    # One ragged row per nonzero: [index dims..., value elements...] in
+    # f64 (exact for int32 indices and f32 values on the wire).
+    if t._nnz():
+        payload = np.concatenate(
+            [t.indices().numpy().T.astype(np.float64),
+             t.values().numpy().reshape(t._nnz(), -1).astype(np.float64)],
+            axis=1)
+    else:
+        payload = np.zeros((0, width), np.float64)
+    gathered = _eager.allgather_value(payload, name=name,
+                                      process_set=process_set)
+    world = get_process_set(process_set).size()
+
+    def assemble():
+        g = np.asarray(gathered)
+        idx = torch.as_tensor(g[:, :sd].T.copy(), dtype=torch.long)
+        vals = torch.as_tensor(g[:, sd:].copy(), dtype=torch.float64)
+        vals = vals.reshape((len(g),) + tail)
+        # coalesce() sums duplicate coordinates (the reduction itself) in
+        # f64; Average divides the SUM, and the cast back to the input
+        # dtype comes last -- same order as the dense path, so integer
+        # averages truncate toward zero identically.
+        summed = torch.sparse_coo_tensor(idx, vals,
+                                         tensor.shape).coalesce()
+        values = summed.values() / world if op is Average \
+            else summed.values()
+        return torch.sparse_coo_tensor(summed.indices(),
+                                       values.to(tensor.dtype),
+                                       tensor.shape).coalesce()
+
+    return _handles.alloc_custom(assemble)
+
+
 def allgather(tensor: torch.Tensor, name: Optional[str] = None,
               process_set=None) -> torch.Tensor:
     """Reference parity: first dimensions MAY differ across ranks (the
@@ -198,6 +251,13 @@ class _HandleTable:
         self._entries[h] = (out, like, inplace)
         return h
 
+    def alloc_custom(self, assemble) -> int:
+        """Handle whose synchronize() returns ``assemble()`` (used by
+        sparse allreduce, whose result is built host-side)."""
+        h = _eager._alloc_handle(np.zeros(()))  # done-immediately marker
+        self._entries[h] = (assemble, None, False)
+        return h
+
     def mark_inplace(self, h: int) -> None:
         out, like, _ = self._entries[h]
         self._entries[h] = (out, like, True)
@@ -205,6 +265,8 @@ class _HandleTable:
     def synchronize(self, h: int) -> "torch.Tensor | List[torch.Tensor]":
         out, like, inplace = self._entries.pop(h)
         result = _eager.synchronize(h)
+        if like is None and callable(out):  # custom (sparse) handle
+            return out()
         if isinstance(like, (list, tuple)):  # grouped handle
             values = [_from_row(r, t) for r, t in zip(result, like)]
             if inplace:

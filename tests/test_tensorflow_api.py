@@ -177,11 +177,12 @@ def test_sync_batch_norm_config_roundtrip(hvd_tf):
 
 def test_gradient_tape_densifies_indexed_slices(hvd_tf):
     # Embedding-style grads arrive as IndexedSlices; sparse_as_dense=True
-    # (default) densifies before the dense allreduce.
+    # densifies before the dense allreduce (default False, reference
+    # parity: densification is explicit opt-in).
     emb = tf.Variable(tf.ones((8, 4)))
     with tf.GradientTape() as tape:
         loss = tf.reduce_sum(tf.gather(emb, [1, 2]))
-    dtape = hvd_tf.DistributedGradientTape(tape)
+    dtape = hvd_tf.DistributedGradientTape(tape, sparse_as_dense=True)
     (grad,) = dtape.gradient(loss, [emb])
     assert not isinstance(grad, tf.IndexedSlices)
     expect = np.zeros((8, 4)); expect[1] = expect[2] = 1.0
@@ -189,6 +190,6 @@ def test_gradient_tape_densifies_indexed_slices(hvd_tf):
 
     with tf.GradientTape() as tape2:
         loss2 = tf.reduce_sum(tf.gather(emb, [0]))
-    strict = hvd_tf.DistributedGradientTape(tape2, sparse_as_dense=False)
+    strict = hvd_tf.DistributedGradientTape(tape2)  # default: refuse
     with pytest.raises(ValueError, match="sparse_as_dense"):
         strict.gradient(loss2, [emb])

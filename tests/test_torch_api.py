@@ -291,3 +291,23 @@ def test_sparse_as_dense_trains(hvd_t):
     after = emb.weight.detach()
     assert not torch.allclose(before[1], after[1])
     assert torch.allclose(before[0], after[0])  # untouched row
+
+
+def test_sparse_allreduce_async(hvd_t):
+    # Single-process replicated semantics: every rank holds the same
+    # sparse tensor, so Average == the original and Sum == value * size.
+    dense = torch.zeros(6, 3)
+    dense[1] = 2.0
+    dense[4] = -1.0
+    sp = dense.to_sparse_coo()
+    h = hvd_t.sparse_allreduce_async(sp, name="spar")
+    out = hvd_t.synchronize(h)
+    assert out.is_sparse
+    np.testing.assert_allclose(out.to_dense().numpy(), dense.numpy(),
+                               rtol=1e-6)
+    h2 = hvd_t.sparse_allreduce_async(sp, name="spar_sum", op=hvd_t.Sum)
+    out2 = hvd_t.synchronize(h2)
+    np.testing.assert_allclose(out2.to_dense().numpy(),
+                               dense.numpy() * hvd_t.size(), rtol=1e-6)
+    with pytest.raises(ValueError, match="sparse tensor"):
+        hvd_t.sparse_allreduce_async(dense)
