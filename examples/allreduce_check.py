@@ -69,6 +69,61 @@ def main():
     assert w.shape == (4, 4), w.shape  # shape must survive sync
     assert np.allclose(w, 0.0), w
     print(f"rank {rank}: broadcast_parameters OK {w.shape}")
+
+    if jax.process_count() > 1:
+        s = jax.local_device_count()
+        # alltoall: device rank g sends row chunk j to rank j; every rank
+        # ends with [rank of sender] per chunk.
+        stack = np.stack([np.full((world,), float(jax.process_index() * s
+                                                  + i), np.float32)
+                          for i in range(s)])
+        a2a = hvd.local_result(hvd.alltoall(stack, name="a2a_check"))
+        assert a2a.shape == (s, world), a2a.shape
+        # Sender g put its own id in every chunk, so every receiver ends
+        # with [0, 1, ..., world-1].
+        expect = np.tile(np.arange(world, dtype=np.float32), (s, 1))
+        assert np.allclose(a2a, expect), (a2a, expect)
+        print(f"rank {rank}: alltoall OK")
+
+        # reducescatter: each device rank gets its 1/world slice of the
+        # sum.
+        rs_in = np.stack([np.arange(world * 2, dtype=np.float32)
+                          for _ in range(s)])
+        rs = hvd.local_result(hvd.reducescatter(rs_in, hvd.Sum,
+                                                name="rs_check"))
+        assert rs.shape == (s, 2), rs.shape
+        base = np.arange(world * 2, dtype=np.float32) * world
+        for i in range(s):
+            g = jax.process_index() * s + i
+            assert np.allclose(rs[i], base[2 * g:2 * g + 2]), rs
+        print(f"rank {rank}: reducescatter OK")
+
+        # grouped allreduce with bf16 wire compression.
+        outs = hvd.grouped_allreduce(
+            [np.full((s, 3), float(rank), np.float32),
+             np.full((s, 2), 2.0 * rank, np.float32)],
+            hvd.Sum, name="grp_check")
+        total = sum(range(jax.process_count())) * s
+        assert np.allclose(hvd.local_result(outs[0]), total), outs[0]
+        assert np.allclose(hvd.local_result(outs[1]), 2 * total), outs[1]
+        print(f"rank {rank}: grouped_allreduce OK")
+
+        # Process-set collective: every process registers the set, but
+        # only MEMBERS call the collective (reference per-rank model --
+        # a non-member never reaches the op).
+        ps = hvd.add_process_set(range(s), name="first_proc")
+        # Membership is by DEVICE rank; this process participates iff it
+        # owns at least one member device (slots > 1 aware).
+        from horovod_tpu.collectives.eager import local_rank_count
+        if local_rank_count(ps) > 0:
+            val = hvd.local_result(hvd.allreduce(
+                np.full((s, 2), float(rank), np.float32), hvd.Average,
+                name="ps_check", process_set=ps))
+            assert np.allclose(val, 0.0), val
+        hvd.barrier()  # align before deregistering on every process
+        hvd.remove_process_set(ps)
+        print(f"rank {rank}: process_set allreduce OK")
+
     hvd.barrier()
     print(f"rank {rank}: barrier OK")
 

@@ -51,12 +51,17 @@ def _is_multiprocess(mesh: Mesh) -> bool:
 
 def local_rank_count(ps=None) -> int:
     """Number of this process's devices in the set (= rows this process
-    contributes to a rank-stacked eager input in multi-process mode)."""
+    contributes to a rank-stacked eager input in multi-process mode).
+
+    Returns 0 when this process owns NO member device -- including the
+    case where every member device belongs to one OTHER process (a
+    "single-process" member mesh seen from a non-member)."""
     ps = _ps.get_process_set(ps)
     mesh = ps.flat_mesh()
-    if not _is_multiprocess(mesh):
-        return int(mesh.devices.size)
     me = jax.process_index()
+    if not _is_multiprocess(mesh):  # all devices owned by ONE process
+        owner = mesh.devices.flat[0].process_index
+        return int(mesh.devices.size) if owner == me else 0
     return sum(1 for d in mesh.devices.flat if d.process_index == me)
 
 

@@ -93,8 +93,12 @@ def add_process_set(ranks: Sequence[int], name: Optional[str] = None) -> Process
         return ps
 
 
-def remove_process_set(name: str) -> None:
+def remove_process_set(name_or_set) -> None:
+    """Deregister a set by name or ProcessSet object (the reference's
+    ``hvd.remove_process_set`` takes the object)."""
     _require_init()
+    name = name_or_set.name if isinstance(name_or_set, ProcessSet) \
+        else name_or_set
     if name == GLOBAL_PROCESS_SET_NAME:
         raise ProcessSetError("cannot remove the global process set")
     st = global_state()

@@ -257,14 +257,15 @@ def DistributedOptimizer(optimizer, compression=Compression.none,
                         tf.zeros(g.shape, g.dtype), trainable=False,
                         name=f"hvd_agg_{i}")
                     for i, g in enumerate(grads)]
+            # Validate BEFORE any buffer mutation: a mid-loop raise after
+            # partial assign_adds would double-count on the next pass.
+            if not sparse_as_dense and any(
+                    isinstance(g, tf.IndexedSlices) for g in grads):
+                raise ValueError(
+                    "IndexedSlices gradient with sparse_as_dense=False; "
+                    "dense aggregation needs sparse_as_dense=True")
             for buf, g in zip(self._hvd_agg_bufs, grads):
                 if buf is not None and g is not None:
-                    if isinstance(g, tf.IndexedSlices) \
-                            and not sparse_as_dense:
-                        raise ValueError(
-                            "IndexedSlices gradient with sparse_as_dense"
-                            "=False; dense aggregation needs "
-                            "sparse_as_dense=True")
                     buf.assign_add(tf.convert_to_tensor(g))
             self._hvd_agg_counter.assign_add(1)
 

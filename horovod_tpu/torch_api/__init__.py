@@ -138,6 +138,11 @@ def sparse_allreduce_async(tensor: torch.Tensor,
     coalescing, so the wire cost scales with nnz, not the dense shape.
     Returns a handle; ``synchronize(handle)`` yields the coalesced
     sparse result.
+
+    Dispatch note: the ragged gather's size exchange is synchronous on
+    the calling thread (only the host-side assembly is deferred to
+    ``synchronize``), so unlike the dense ``*_async`` ops this one does
+    not overlap with subsequent enqueues.
     """
     if not tensor.is_sparse:
         raise ValueError("sparse_allreduce_async expects a sparse tensor; "

@@ -101,3 +101,12 @@ def test_built_probes_and_runtime_timeline(hvd, tmp_path):
         events = json.load(f)
     assert any(e.get("name", "").startswith("tl_probe")
                or "tl_probe" in str(e) for e in events), events[:5]
+
+
+def test_remove_process_set_accepts_object(hvd):
+    import horovod_tpu as h
+    ps = h.add_process_set([0], name="rm_by_obj")
+    h.remove_process_set(ps)  # reference signature: the ProcessSet itself
+    ps2 = h.add_process_set([0, 1] if hvd.size() > 1 else [0],
+                            name="rm_by_obj")  # re-register must succeed
+    h.remove_process_set("rm_by_obj")  # name form still works
