@@ -38,12 +38,16 @@ from ..parallel import mesh as _mesh
 logger = logging.getLogger("horovod_tpu")
 
 
-def _setup_logging(level: str) -> None:
+def _setup_logging(level: str, hide_timestamp: bool = False) -> None:
     lvl = {"trace": logging.DEBUG, "debug": logging.DEBUG,
            "info": logging.INFO, "warning": logging.WARNING,
            "error": logging.ERROR, "fatal": logging.CRITICAL}.get(
                level.lower(), logging.WARNING)
-    logging.basicConfig(level=lvl)
+    # HOROVOD_LOG_HIDE_TIMESTAMP parity (reference logging.cc):
+    # timestamps on by default, hideable via the parsed config.
+    fmt = "%(name)s %(levelname)s: %(message)s" if hide_timestamp else \
+        "%(asctime)s %(name)s %(levelname)s: %(message)s"
+    logging.basicConfig(level=lvl, format=fmt)
     logger.setLevel(lvl)
 
 
@@ -70,7 +74,7 @@ def init(
         if st.initialized:
             return
         cfg = config if config is not None else load_config()
-        _setup_logging(cfg.log_level)
+        _setup_logging(cfg.log_level, cfg.log_hide_timestamp)
 
         if cfg.force_cpu:
             # Must run before any backend initialization; the TPU plugin's

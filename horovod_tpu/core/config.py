@@ -87,8 +87,9 @@ class Config:
     # Elastic.
     elastic_timeout: float = 600.0
 
-    # Logging (HOROVOD_LOG_LEVEL).
+    # Logging (HOROVOD_LOG_LEVEL, HOROVOD_LOG_HIDE_TIMESTAMP).
     log_level: str = "warning"
+    log_hide_timestamp: bool = False
 
     # Launcher-provided identity (HOROVOD_RANK/SIZE/... parity); -1 = unset.
     env_rank: int = -1
@@ -140,6 +141,7 @@ def load_config() -> Config:
             _env_float("STALL_SHUTDOWN_TIME", 0.0)),
         elastic_timeout=_env_float("ELASTIC_TIMEOUT", 600.0),
         log_level=_env("LOG_LEVEL", "warning") or "warning",
+        log_hide_timestamp=_env_bool("LOG_HIDE_TIMESTAMP"),
         env_rank=_env_int("RANK", -1),
         env_size=_env_int("SIZE", -1),
         env_local_rank=_env_int("LOCAL_RANK", -1),

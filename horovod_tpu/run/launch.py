@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeline-filename", default=None,
                    help="write a Chrome-trace timeline per rank "
                         "(rank suffix appended)")
+    p.add_argument("--timeline-mark-cycles", action="store_true",
+                   help="mark scheduler cycles in the timeline "
+                        "(HOROVOD_TIMELINE_MARK_CYCLES)")
     p.add_argument("--autotune", action="store_true",
                    help="enable fusion-threshold autotuning in workers")
     p.add_argument("--fusion-threshold-mb", type=int, default=None,
@@ -123,6 +126,10 @@ def run_command(args: Optional[List[str]] = None) -> int:
     if opts.check_build:
         print(check_build())
         return 0
+
+    if opts.timeline_mark_cycles and not opts.timeline_filename:
+        print("# warning: --timeline-mark-cycles has no effect without "
+              "--timeline-filename", file=sys.stderr)
 
     cmd = list(opts.command)
     if cmd and cmd[0] == "--":
@@ -225,6 +232,8 @@ def run_command(args: Optional[List[str]] = None) -> int:
             cpu=opts.cpu, slots=opts.slots))
         if opts.timeline_filename:
             env["HOROVOD_TIMELINE"] = f"{opts.timeline_filename}.{rank}"
+            if opts.timeline_mark_cycles:
+                env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
         if opts.autotune:
             env["HOROVOD_AUTOTUNE"] = "1"
         if opts.fusion_threshold_mb is not None:

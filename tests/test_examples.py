@@ -72,3 +72,10 @@ def test_tf2_resnet50_example_cpu():
                 "--cpu-devices", "2", "--image-size", "64",
                 "--batch-size", "2", "--steps", "2"])
     assert "tf2 resnet50 OK" in out
+
+
+@pytest.mark.integration
+def test_allreduce_benchmark_cpu():
+    out = _run([os.path.join(REPO, "examples", "allreduce_benchmark.py"),
+                "--cpu-devices", "4", "--sizes-mb", "1", "--iters", "2"])
+    assert "bus>=" in out
