@@ -108,13 +108,16 @@ def check_build() -> str:
         "    [ ] NCCL (not applicable: no GPU in the loop)",
         "    [ ] MPI  (not applicable: JAX coordination service instead)",
         "Available features:",
-        "    [X] fused allreduce / grouped_allreduce / allgather /",
-        "        broadcast / alltoall / reducescatter / barrier",
-        "    [X] Adasum",
+        "    [X] fused allreduce / grouped_allreduce[_async] /",
+        "        allgather(+ragged) / broadcast / alltoall /",
+        "        reducescatter / barrier / sparse allreduce (torch)",
+        "    [X] Adasum (flat + hierarchical dcn x ici)",
         "    [X] fp16/bf16 gradient compression",
-        "    [X] autotune (fusion threshold)",
-        "    [X] timeline (Chrome trace)",
+        "    [X] autotune (fusion threshold, GP Bayesian)",
+        "    [X] timeline (Chrome trace, runtime start/stop)",
         "    [X] elastic (commit/restore + rescale)",
+        "    [X] checkpointing (rank-0 npz + orbax sharded)",
+        "    [X] sequence parallelism (ring + Ulysses attention)",
         f"jax {jax.__version__}",
     ]
     return "\n".join(lines)
@@ -127,9 +130,11 @@ def run_command(args: Optional[List[str]] = None) -> int:
         print(check_build())
         return 0
 
-    if opts.timeline_mark_cycles and not opts.timeline_filename:
+    if opts.timeline_mark_cycles and not (
+            opts.timeline_filename or os.environ.get("HOROVOD_TIMELINE")
+            or os.environ.get("HVD_TPU_TIMELINE")):
         print("# warning: --timeline-mark-cycles has no effect without "
-              "--timeline-filename", file=sys.stderr)
+              "--timeline-filename (or HOROVOD_TIMELINE)", file=sys.stderr)
 
     cmd = list(opts.command)
     if cmd and cmd[0] == "--":
@@ -232,8 +237,11 @@ def run_command(args: Optional[List[str]] = None) -> int:
             cpu=opts.cpu, slots=opts.slots))
         if opts.timeline_filename:
             env["HOROVOD_TIMELINE"] = f"{opts.timeline_filename}.{rank}"
-            if opts.timeline_mark_cycles:
-                env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+        if opts.timeline_mark_cycles:
+            # Unconditional: the timeline may come from HOROVOD_TIMELINE
+            # in the inherited env; config ignores the flag when no
+            # timeline is active.
+            env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
         if opts.autotune:
             env["HOROVOD_AUTOTUNE"] = "1"
         if opts.fusion_threshold_mb is not None:
