@@ -237,10 +237,16 @@ def run_command(args: Optional[List[str]] = None) -> int:
             cpu=opts.cpu, slots=opts.slots))
         if opts.timeline_filename:
             env["HOROVOD_TIMELINE"] = f"{opts.timeline_filename}.{rank}"
+        else:
+            # An inherited HOROVOD_TIMELINE/HVD_TPU_TIMELINE would have
+            # every worker truncate the SAME file; re-point each rank at
+            # its own suffix like the CLI path does.
+            for var in ("HOROVOD_TIMELINE", "HVD_TPU_TIMELINE"):
+                if env.get(var):
+                    env[var] = f"{env[var]}.{rank}"
         if opts.timeline_mark_cycles:
-            # Unconditional: the timeline may come from HOROVOD_TIMELINE
-            # in the inherited env; config ignores the flag when no
-            # timeline is active.
+            # The timeline may come from the CLI flag or inherited env;
+            # config ignores mark-cycles when no timeline is active.
             env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
         if opts.autotune:
             env["HOROVOD_AUTOTUNE"] = "1"

@@ -79,3 +79,12 @@ def test_allreduce_benchmark_cpu():
     out = _run([os.path.join(REPO, "examples", "allreduce_benchmark.py"),
                 "--cpu-devices", "4", "--sizes-mb", "1", "--iters", "2"])
     assert "bus>=" in out
+
+
+@pytest.mark.integration
+def test_tensorflow2_mnist_two_process():
+    out = _run(["-m", "horovod_tpu.run", "-np", "2", "--cpu",
+                sys.executable,
+                os.path.join(REPO, "examples", "tensorflow2_mnist.py"),
+                "--steps", "12"])
+    assert "avg final loss" in out
