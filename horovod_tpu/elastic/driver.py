@@ -23,7 +23,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..run.exec_util import TaggedProcess
-from ..run.launch import free_port, worker_env
+from ..run.launch import apply_timeline_env, free_port, worker_env
 from .discovery import HostDiscoveryScript
 from .notify import ASSIGNMENT_ENV, WORKER_ID_ENV, write_assignment
 
@@ -123,6 +123,7 @@ class ElasticDriver:
         env.update(worker_env(rank=rank, size=size, coordinator="127.0.0.1",
                               port=port, cpu=self.cpu, slots=1,
                               local_rank=rank, local_size=size))
+        apply_timeline_env(env, rank)
         if self._rdv is not None:
             from ..run.secret import SECRET_ENV
             env[ASSIGNMENT_ENV] = f"http://127.0.0.1:{self._rdv.port}"

@@ -235,15 +235,7 @@ def run_command(args: Optional[List[str]] = None) -> int:
         env.update(worker_env(
             rank=rank, size=np_, coordinator=opts.coordinator, port=port,
             cpu=opts.cpu, slots=opts.slots))
-        if opts.timeline_filename:
-            env["HOROVOD_TIMELINE"] = f"{opts.timeline_filename}.{rank}"
-        else:
-            # An inherited HOROVOD_TIMELINE/HVD_TPU_TIMELINE would have
-            # every worker truncate the SAME file; re-point each rank at
-            # its own suffix like the CLI path does.
-            for var in ("HOROVOD_TIMELINE", "HVD_TPU_TIMELINE"):
-                if env.get(var):
-                    env[var] = f"{env[var]}.{rank}"
+        apply_timeline_env(env, rank, opts.timeline_filename)
         if opts.timeline_mark_cycles:
             # The timeline may come from the CLI flag or inherited env;
             # config ignores mark-cycles when no timeline is active.
@@ -258,6 +250,26 @@ def run_command(args: Optional[List[str]] = None) -> int:
         procs.append(TaggedProcess(rank, cmd, env, lock=lock,
                                    tag=not opts.no_tag_output))
     return wait_all(procs)
+
+
+def apply_timeline_env(env: dict, rank: int,
+                       cli_filename: Optional[str] = None) -> None:
+    """Point this worker's timeline at a per-rank file.
+
+    A shared path would have every worker ``open(path, 'w')`` the SAME
+    file and interleave/truncate each other's trace.  The CLI flag wins
+    (and clears any inherited spelling, since config resolves HVD_TPU_
+    first); otherwise inherited HOROVOD_TIMELINE/HVD_TPU_TIMELINE values
+    get the rank suffix.  Used by the static spawn loop AND the elastic
+    driver.
+    """
+    if cli_filename:
+        env.pop("HVD_TPU_TIMELINE", None)
+        env["HOROVOD_TIMELINE"] = f"{cli_filename}.{rank}"
+        return
+    for var in ("HOROVOD_TIMELINE", "HVD_TPU_TIMELINE"):
+        if env.get(var):
+            env[var] = f"{env[var]}.{rank}"
 
 
 def worker_env(rank: int, size: int, coordinator: str, port: int,

@@ -1,0 +1,5 @@
+"""``horovod_tpu.tensorflow.keras``: the reference's canonical
+``import horovod.tensorflow.keras as hvd`` path, aliasing
+:mod:`horovod_tpu.keras` (same DistributedOptimizer + callbacks)."""
+
+from ..keras import *  # noqa: F401,F403

@@ -410,3 +410,18 @@ def test_lsf_rankfile_fqdn_subhost(monkeypatch, tmp_path):
     monkeypatch.setenv("LSB_SUB_HOST", "launch01")
     monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
     assert lsf.get_compute_hosts() == [("h1", 2)]
+
+
+def test_apply_timeline_env_per_rank():
+    from horovod_tpu.run.launch import apply_timeline_env
+    # CLI flag wins and clears the HVD_TPU_ spelling.
+    env = {"HVD_TPU_TIMELINE": "/tmp/old.json"}
+    apply_timeline_env(env, 3, "/tmp/new")
+    assert env == {"HOROVOD_TIMELINE": "/tmp/new.3"}
+    # Inherited env values get the rank suffix.
+    env = {"HOROVOD_TIMELINE": "/tmp/t.json"}
+    apply_timeline_env(env, 1)
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.json.1"
+    env = {}
+    apply_timeline_env(env, 0)
+    assert env == {}

@@ -193,3 +193,11 @@ def test_gradient_tape_densifies_indexed_slices(hvd_tf):
     strict = hvd_tf.DistributedGradientTape(tape2)  # default: refuse
     with pytest.raises(ValueError, match="sparse_as_dense"):
         strict.gradient(loss2, [emb])
+
+
+def test_tensorflow_keras_import_path(hvd):
+    # Reference canonical import line: horovod.tensorflow.keras.
+    import horovod_tpu.tensorflow.keras as khvd
+    assert callable(khvd.DistributedOptimizer)
+    assert callable(khvd.BroadcastGlobalVariablesCallback)
+    assert khvd.size() == hvd.size()
