@@ -123,7 +123,10 @@ class ElasticDriver:
         env.update(worker_env(rank=rank, size=size, coordinator="127.0.0.1",
                               port=port, cpu=self.cpu, slots=1,
                               local_rank=rank, local_size=size))
-        apply_timeline_env(env, rank)
+        # Suffix by the stable worker id: ranks are reassigned across
+        # re-rendezvous, so a rank-keyed file could collide with a
+        # surviving worker's live trace.
+        apply_timeline_env(env, wid.replace(":", "-"))
         if self._rdv is not None:
             from ..run.secret import SECRET_ENV
             env[ASSIGNMENT_ENV] = f"http://127.0.0.1:{self._rdv.port}"

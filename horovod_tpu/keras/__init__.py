@@ -99,3 +99,17 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
             return
         self.model.optimizer.learning_rate.assign(
             self.initial_lr * self.multiplier(epoch))
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "size", "rank", "local_size",
+    "local_rank", "cross_size", "cross_rank", "nccl_built", "mpi_built",
+    "gloo_built", "tpu_built", "cuda_built", "rocm_built",
+    "start_timeline", "stop_timeline", "allreduce", "barrier",
+    "broadcast", "broadcast_variables", "Average", "Sum", "Compression",
+    "DistributedOptimizer", "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback", "LearningRateWarmupCallback",
+    "LearningRateScheduleCallback", "callbacks",
+]
+
+from . import callbacks  # noqa: E402,F401  (hvd.callbacks.* namespace)

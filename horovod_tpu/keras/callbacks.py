@@ -1,0 +1,8 @@
+"""``horovod_tpu.keras.callbacks``: the reference's callbacks namespace
+(``horovod/_keras/callbacks.py`` surface; upstream examples use
+``hvd.callbacks.BroadcastGlobalVariablesCallback``)."""
+
+from . import (  # noqa: F401
+    BroadcastGlobalVariablesCallback, MetricAverageCallback,
+    LearningRateWarmupCallback, LearningRateScheduleCallback,
+)

@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fusion-threshold-mb", type=int, default=None,
                    help="override HOROVOD_FUSION_THRESHOLD (MiB)")
     p.add_argument("--verbose", "-v", action="count", default=0)
+    p.add_argument("--log-level", default=None,
+                   choices=("trace", "debug", "info", "warning", "error",
+                            "fatal"),
+                   help="worker HOROVOD_LOG_LEVEL (overrides -v mapping)")
     p.add_argument("--check-build", action="store_true",
                    help="print build capabilities and exit")
     p.add_argument("--no-tag-output", action="store_true",
@@ -191,6 +195,24 @@ def run_command(args: Optional[List[str]] = None) -> int:
         heartbeat = opts.heartbeat_timeout
         if heartbeat is None:
             heartbeat = load_config().heartbeat_timeout
+        # Per-worker env flags ride extra_env so elastic workers honor
+        # the same CLI surface as the static spawn loop (the per-rank
+        # timeline suffix is applied at each spawn).
+        extra = {}
+        if opts.log_level:
+            extra["HOROVOD_LOG_LEVEL"] = opts.log_level
+        elif opts.verbose:
+            extra["HOROVOD_LOG_LEVEL"] = ("debug" if opts.verbose > 1
+                                          else "info")
+        if opts.autotune:
+            extra["HOROVOD_AUTOTUNE"] = "1"
+        if opts.fusion_threshold_mb is not None:
+            extra["HOROVOD_FUSION_THRESHOLD"] = str(
+                opts.fusion_threshold_mb << 20)
+        if opts.timeline_filename:
+            extra["HOROVOD_TIMELINE"] = opts.timeline_filename
+        if opts.timeline_mark_cycles:
+            extra["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
         driver = ElasticDriver(
             command=cmd,
             discovery_script=opts.host_discovery_script,
@@ -201,6 +223,7 @@ def run_command(args: Optional[List[str]] = None) -> int:
             verbose=opts.verbose,
             heartbeat_timeout_s=heartbeat,
             rendezvous=opts.network_rendezvous,
+            extra_env=extra,
         )
         return driver.run()
 
@@ -245,14 +268,16 @@ def run_command(args: Optional[List[str]] = None) -> int:
         if opts.fusion_threshold_mb is not None:
             env["HOROVOD_FUSION_THRESHOLD"] = str(
                 opts.fusion_threshold_mb << 20)
-        if opts.verbose:
+        if opts.log_level:
+            env["HOROVOD_LOG_LEVEL"] = opts.log_level
+        elif opts.verbose:
             env["HOROVOD_LOG_LEVEL"] = "debug" if opts.verbose > 1 else "info"
         procs.append(TaggedProcess(rank, cmd, env, lock=lock,
                                    tag=not opts.no_tag_output))
     return wait_all(procs)
 
 
-def apply_timeline_env(env: dict, rank: int,
+def apply_timeline_env(env: dict, suffix,
                        cli_filename: Optional[str] = None) -> None:
     """Point this worker's timeline at a per-rank file.
 
@@ -260,16 +285,16 @@ def apply_timeline_env(env: dict, rank: int,
     file and interleave/truncate each other's trace.  The CLI flag wins
     (and clears any inherited spelling, since config resolves HVD_TPU_
     first); otherwise inherited HOROVOD_TIMELINE/HVD_TPU_TIMELINE values
-    get the rank suffix.  Used by the static spawn loop AND the elastic
-    driver.
+    get the suffix.  The static spawn loop suffixes by rank; the elastic
+    driver by the STABLE worker id (ranks are reassigned on rescale).
     """
     if cli_filename:
         env.pop("HVD_TPU_TIMELINE", None)
-        env["HOROVOD_TIMELINE"] = f"{cli_filename}.{rank}"
+        env["HOROVOD_TIMELINE"] = f"{cli_filename}.{suffix}"
         return
     for var in ("HOROVOD_TIMELINE", "HVD_TPU_TIMELINE"):
         if env.get(var):
-            env[var] = f"{env[var]}.{rank}"
+            env[var] = f"{env[var]}.{suffix}"
 
 
 def worker_env(rank: int, size: int, coordinator: str, port: int,

@@ -425,3 +425,16 @@ def test_apply_timeline_env_per_rank():
     env = {}
     apply_timeline_env(env, 0)
     assert env == {}
+
+
+@pytest.mark.integration
+def test_launcher_log_level_flag():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "1", "--cpu",
+         "--log-level", "info", sys.executable, "-c",
+         "import horovod_tpu as h; h.init()"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "horovod_tpu initialized" in out.stdout + out.stderr

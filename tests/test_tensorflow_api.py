@@ -200,4 +200,9 @@ def test_tensorflow_keras_import_path(hvd):
     import horovod_tpu.tensorflow.keras as khvd
     assert callable(khvd.DistributedOptimizer)
     assert callable(khvd.BroadcastGlobalVariablesCallback)
+    # Upstream examples use the callbacks namespace.
+    assert callable(khvd.callbacks.BroadcastGlobalVariablesCallback)
+    assert callable(khvd.callbacks.MetricAverageCallback)
     assert khvd.size() == hvd.size()
+    # __all__ keeps implementation modules out of the alias surface.
+    assert not hasattr(khvd, "np")
