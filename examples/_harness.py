@@ -6,18 +6,14 @@ compile-then-timed-loop, and throughput reporting.  Importable as a sibling
 module because each example puts its own directory on ``sys.path``.
 """
 
-import os
 import time
 
 
 def setup_devices(cpu_devices: int) -> None:
     """Force N virtual CPU devices.  Must run before first jax device use."""
     if cpu_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") +
-            f" --xla_force_host_platform_device_count={cpu_devices}")
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from horovod_tpu.utils.platform import force_host_device_count
+        force_host_device_count(cpu_devices, cpu=True, exact=True)
 
 
 def timed_training(step, params, opt_state, data, steps: int,

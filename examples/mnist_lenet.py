@@ -15,7 +15,6 @@ from os.path import abspath as _abs, dirname as _dir
 _sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
 
 import argparse
-import os
 import sys
 import time
 
@@ -33,11 +32,8 @@ def main():
     args = p.parse_args()
 
     if args.cpu_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") +
-            f" --xla_force_host_platform_device_count={args.cpu_devices}")
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from horovod_tpu.utils.platform import force_host_device_count
+        force_host_device_count(args.cpu_devices, cpu=True, exact=True)
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -64,13 +64,8 @@ def main() -> int:
     args = ap.parse_args()
 
     if args.cpu_devices:
-        import os
-        flags = os.environ.get("XLA_FLAGS", "")
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count="
-            f"{args.cpu_devices}").strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from horovod_tpu.utils.platform import force_host_device_count
+        force_host_device_count(args.cpu_devices, cpu=True, exact=True)
 
     import jax
     import jax.numpy as jnp

@@ -314,10 +314,10 @@ def worker_env(rank: int, size: int, coordinator: str, port: int,
         "HVD_TPU_COORDINATOR_PORT": str(port),
     }
     if cpu:
+        from ..utils.platform import set_host_device_flag
         env["HVD_TPU_FORCE_CPU"] = "1"
-        xla = os.environ.get("XLA_FLAGS", "")
-        env["XLA_FLAGS"] = (
-            f"{xla} --xla_force_host_platform_device_count={slots}").strip()
+        env["XLA_FLAGS"] = set_host_device_flag(
+            os.environ.get("XLA_FLAGS", ""), slots)
     return env
 
 

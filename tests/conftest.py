@@ -2,21 +2,22 @@
 ``mpirun -np N`` localhost test strategy (SURVEY.md section 4/7)."""
 
 import os
+import sys
+from os.path import abspath, dirname
 
-# Must be set before jax initializes its backends.
+# Must run before jax initializes its backends.  The environment
+# pre-configures jax_platforms="axon,cpu" (TPU plugin), which overrides the
+# JAX_PLATFORMS env var; force_host_device_count forces the CPU backend via
+# jax.config so tests get the 8-device virtual mesh.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, dirname(dirname(abspath(__file__))))
+from horovod_tpu.utils.platform import force_host_device_count  # noqa: E402
+
+force_host_device_count(8, cpu=True)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
-# The environment pre-configures jax_platforms="axon,cpu" (TPU plugin), which
-# overrides the env var; force the CPU backend explicitly so tests get the
-# 8-device virtual mesh.
-jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) >= 8, jax.devices()
 
 

@@ -31,3 +31,27 @@ def test_model_parallel_dryrun_runs():
 @pytest.mark.slow
 def test_full_dryrun_multichip():
     graft.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_driver_invocation():
+    """Reproduce the driver's exact call: a FRESH process with neither
+    XLA_FLAGS nor JAX_PLATFORMS set (no conftest help), so the entry itself
+    must force the 8-device virtual CPU mesh before backend init.
+
+    Round 1 failed exactly here: the entry probed jax.devices() first,
+    initializing the 1-device backend, and the CPU fallback saw 1 device.
+    """
+    import os
+    import subprocess
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "HVD_TPU_DRYRUN_PLATFORM")}
+    repo = dirname(dirname(abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "dryrun_multichip(8)" in proc.stdout

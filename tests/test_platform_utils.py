@@ -1,0 +1,72 @@
+"""Unit tests for the shared pre-init platform-forcing helper."""
+
+from horovod_tpu.utils.platform import (backend_initialized,
+                                        merge_host_device_flag)
+
+FLAG = "--xla_force_host_platform_device_count"
+
+
+def test_merge_appends_when_absent():
+    assert merge_host_device_flag("", 8) == f"{FLAG}=8"
+    assert merge_host_device_flag("--xla_foo=1", 8) == f"--xla_foo=1 {FLAG}=8"
+
+
+def test_merge_replaces_smaller_count():
+    # A pre-existing smaller count must be raised, not kept (round-1 style
+    # failure: inherited =4 would leave an 8-device dryrun short).
+    assert merge_host_device_flag(f"{FLAG}=4", 8) == f"{FLAG}=8"
+    assert merge_host_device_flag(f"--xla_foo=1 {FLAG}=4 --xla_bar=2", 8) \
+        == f"--xla_foo=1 --xla_bar=2 {FLAG}=8"
+
+
+def test_merge_keeps_larger_count():
+    assert merge_host_device_flag(f"{FLAG}=16", 8) == f"{FLAG}=16"
+
+
+def test_merge_collapses_duplicates_to_max():
+    # Inherited envs can carry duplicated flags (the pre-refactor launcher
+    # blind-appended).  XLA duplicate precedence is an implementation
+    # detail; collapse to a single occurrence with the max count.
+    assert merge_host_device_flag(f"{FLAG}=16 {FLAG}=4", 8) == f"{FLAG}=16"
+    assert merge_host_device_flag(f"{FLAG}=2 --xla_foo=1 {FLAG}=4", 8) \
+        == f"--xla_foo=1 {FLAG}=8"
+
+
+def test_set_is_exact():
+    from horovod_tpu.utils.platform import set_host_device_flag
+    # Worker envs need the slot count exactly, even when the parent env
+    # carries a larger one.
+    assert set_host_device_flag(f"{FLAG}=8", 2) == f"{FLAG}=2"
+    assert set_host_device_flag("--xla_foo=1", 2) == f"--xla_foo=1 {FLAG}=2"
+
+
+def test_backend_initialized_reports_true_under_conftest():
+    # conftest initialized the 8-device CPU backend for this process.
+    import jax
+    jax.devices()
+    assert backend_initialized()
+
+
+def test_package_import_does_not_initialize_backend():
+    """Guard the pre-init contract structurally: the platform helper is
+    reached through ``horovod_tpu.__init__``, so that import graph must
+    never initialize a jax backend -- otherwise every pre-init entry point
+    (conftest, examples, the driver dryrun) silently regresses to the
+    round-1 one-device failure."""
+    import os
+    import subprocess
+    import sys
+    from os.path import abspath, dirname
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import horovod_tpu\n"
+         "from horovod_tpu.utils.platform import backend_initialized\n"
+         "assert not backend_initialized(), 'import initialized a backend'\n"
+         "print('IMPORT_CLEAN')"],
+        cwd=dirname(dirname(abspath(__file__))), env=env,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "IMPORT_CLEAN" in proc.stdout
