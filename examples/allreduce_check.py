@@ -85,6 +85,34 @@ def main():
         assert np.allclose(a2a, expect), (a2a, expect)
         print(f"rank {rank}: alltoall OK")
 
+        # alltoallv: ragged exchange.  Device rank g sends (g + i) % 2 + 1
+        # rows (value 100*g + i) to rank i; every receiver checks the
+        # rank-order concatenation and the received counts.
+        def a2av_splits(g, i):
+            return (g + i) % 2 + 1
+
+        arrs, sps = [], []
+        for g in gids:
+            sp = np.array([a2av_splits(g, i) for i in range(world)],
+                          np.int32)
+            rows = np.concatenate(
+                [np.full((sp[i], 2), 100.0 * g + i, np.float32)
+                 for i in range(world)])
+            arrs.append(rows)
+            sps.append(sp)
+        datas, rsplits = hvd.alltoallv(arrs, sps, name="a2av_check")
+        for r, g in enumerate(gids):
+            expect_counts = np.array(
+                [a2av_splits(s_, g) for s_ in range(world)], np.int32)
+            assert np.array_equal(rsplits[r], expect_counts), (
+                rsplits[r], expect_counts)
+            expect_rows = np.concatenate(
+                [np.full((expect_counts[s_], 2), 100.0 * s_ + g, np.float32)
+                 for s_ in range(world)])
+            assert np.allclose(datas[r], expect_rows), (datas[r],
+                                                        expect_rows)
+        print(f"rank {rank}: alltoallv OK")
+
         # reducescatter: each device rank gets its 1/world slice of the
         # sum.
         rs_in = np.stack([np.arange(world * 2, dtype=np.float32)

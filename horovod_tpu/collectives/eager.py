@@ -399,6 +399,119 @@ def alltoall(x, *, name=None, process_set=None):
     return _run("alltoall", x, name, process_set, per_rank, "a2a")
 
 
+def alltoallv(arrs, splits, *, name=None, process_set=None):
+    """Uneven alltoall (reference ``hvd.alltoall(tensor, splits=...)``).
+
+    Reference semantics (NCCLAlltoall with ``splits`` -- the negotiation
+    exchanges counts, then a ragged exchange runs): split counts are
+    allgathered first, data is padded to the global max split and exchanged
+    with one static-shape alltoall, and each rank receives the rank-order
+    concatenation of the splits addressed to it, plus the per-sender counts.
+
+    Args:
+      arrs: single process -- per-rank data arrays (length = set size);
+        multi-process -- this process's local per-rank list.  Each is
+        ``[total_r, ...]`` rows, the rank-order concatenation of splits.
+      splits: matching per-rank int arrays ``[size]``; ``splits[r][i]``
+        rows of ``arrs[r]`` go to global rank ``i``.
+
+    Returns:
+      ``(datas, recv_splits)``: per local rank ``r``, ``datas[r]`` is the
+      HOST array concatenating what rank ``r`` received (in sender rank
+      order) and ``recv_splits[r][j]`` says how many rows came from global
+      rank ``j``.
+    """
+    ps = _ps.get_process_set(process_set)
+    if hasattr(arrs, "shape"):
+        arrs = [arrs]
+    arrs = [np.asarray(a) for a in arrs]
+    if hasattr(splits, "shape") and np.asarray(splits).ndim == 1:
+        splits = [splits]
+    splits = [np.asarray(s, np.int32) for s in splits]
+    k = local_rank_count(ps)
+    n = ps.size()
+    if k == 0:  # non-member process: no sub-mesh participation
+        if arrs or splits:
+            raise ValueError("this process owns no member device; pass "
+                             "empty arrs/splits")
+        return [], []
+    if len(arrs) != k or len(splits) != k:
+        raise ValueError(
+            f"alltoallv takes one array and one splits vector per local "
+            f"rank: expected {k}, got {len(arrs)} arrays / {len(splits)} "
+            f"splits")
+    for a, s in zip(arrs, splits):
+        if s.shape != (n,):
+            raise ValueError(f"splits must have shape ({n},), got {s.shape}")
+        if s.sum() > a.shape[0]:
+            raise ValueError(
+                f"splits sum {int(s.sum())} exceeds data rows {a.shape[0]}")
+    tail_shapes = {a.shape[1:] for a in arrs}
+    dtypes = {a.dtype for a in arrs}
+    if len(tail_shapes) > 1 or len(dtypes) > 1:
+        raise ValueError("alltoallv arrays may differ only in dim 0; got "
+                         f"shapes {[a.shape for a in arrs]}, "
+                         f"dtypes {sorted(map(str, dtypes))}")
+    # Phase 1: exchange the split matrix (negotiation analogue).  Row r of
+    # ``all_splits`` is global rank r's splits vector.
+    stacked = np.stack(splits)                      # [k, n]
+    all_splits = local_result(
+        allgather(stacked, name=f"{name or 'alltoallv'}.splits",
+                  process_set=ps))[0].reshape(n, n)
+    max_len = max(int(all_splits.max()), 1)
+    tail = arrs[0].shape[1:]
+    # Phase 2: pad each split to the max and exchange (one static-shape
+    # alltoall).  Send layout per rank: [n, max_len, ...].
+    padded = np.zeros((k, n, max_len) + tail, arrs[0].dtype)
+    for r, (a, s) in enumerate(zip(arrs, splits)):
+        off = 0
+        for i, c in enumerate(s):
+            padded[r, i, :c] = a[off:off + c]
+            off += int(c)
+
+    def per_rank(t):
+        return _ops.alltoall(t, axes=(HVD_AXIS,))
+    out = _run("alltoallv", padded, name, ps, per_rank, "a2av")
+    rows = local_result(out)                        # [k, n, max_len, ...]
+    local_global_ranks = _local_member_positions(ps)
+    datas, recv_splits = [], []
+    for r in range(k):
+        g = local_global_ranks[r]
+        counts = all_splits[:, g]                   # what each sender sent me
+        datas.append(np.concatenate(
+            [rows[r, j, :counts[j]] for j in range(n)], axis=0))
+        recv_splits.append(counts.copy())
+    return datas, recv_splits
+
+
+def alltoallv_row(data, splits, *, name=None, process_set=None):
+    """Framework-shim helper: uneven alltoall of ONE per-process value
+    (replicated across this process's local ranks, like
+    :func:`replicated_stack` for the even collectives).
+
+    Returns host arrays ``(received, received_splits)`` for this process's
+    first local rank -- the single-controller row the torch/TF/mxnet
+    wrappers hand back.
+    """
+    data = np.asarray(data)
+    sp = np.asarray(splits, np.int32)
+    k = local_rank_count(process_set)
+    datas, rsplits = alltoallv([data] * k, [sp] * k, name=name,
+                               process_set=process_set)
+    return datas[0], rsplits[0]
+
+
+def _local_member_positions(ps) -> List[int]:
+    """Positions within the set (0..size-1) of this process's local ranks,
+    in the same order their rows appear in rank-stacked eager arrays."""
+    mesh = ps.flat_mesh()
+    me = jax.process_index()
+    if not _is_multiprocess(mesh):
+        return list(range(int(mesh.devices.size)))
+    return [i for i, d in enumerate(mesh.devices.flat)
+            if d.process_index == me]
+
+
 def barrier(*, process_set=None) -> None:
     """Block until every member device reaches the barrier."""
     ps = _ps.get_process_set(process_set)

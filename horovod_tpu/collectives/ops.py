@@ -298,6 +298,73 @@ def alltoall(x,
                           concat_axis=concat_axis, tiled=True)
 
 
+def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
+              process_set=None, max_count: int):
+    """Uneven alltoall (padded alltoallv; NCCLAlltoall with ``splits``).
+
+    The reference exchanges ragged splits directly (its negotiation shares
+    the counts); XLA needs static shapes, so each split is padded to the
+    static bound ``max_count`` and receivers get the valid lengths
+    alongside.  ``send_counts`` may be a traced per-device value -- the
+    padding/masking is dynamic-slice based, so routing decisions computed
+    inside the step (e.g. MoE dispatch) stay on device.
+
+    Args:
+      x: ``[total, ...]`` local rows; the split for peer ``i`` occupies
+        rows ``[sum(send_counts[:i]), sum(send_counts[:i+1]))`` (rank-order
+        concatenation, the reference's layout).
+      send_counts: int array ``[size]``; ``send_counts[i]`` rows go to
+        global rank ``i``.
+      max_count: static upper bound on any single split.  A traced count
+        exceeding it is truncated: only the first ``max_count`` rows of
+        that split transfer and the receiver's count reports the clamped
+        value (size your bound for the worst case, like an MoE capacity
+        factor).
+
+    Returns:
+      ``(recv, recv_counts)``: ``recv[j]`` is ``[max_count, ...]`` holding
+      the split received from rank ``j`` (zero-padded past
+      ``recv_counts[j]``); ``recv_counts`` is ``[size]``, every entry
+      ``<= max_count``.
+    """
+    axes, members = _resolve(axes, process_set)
+    if members is not None:
+        raise NotImplementedError(
+            "in-step alltoallv over a process set is not supported; use the "
+            "eager API, which runs on the member-only sub-mesh")
+    if len(axes) != 1:
+        raise NotImplementedError("alltoallv requires a flat mesh axis")
+    a = axes[0]
+    size = lax.axis_size(a)
+    send_counts = jnp.asarray(send_counts, jnp.int32)
+    if send_counts.shape != (size,):
+        raise ValueError(
+            f"send_counts must have shape ({size},) (one count per mesh "
+            f"member), got {send_counts.shape}")
+    # Offsets follow the caller's layout (the ORIGINAL counts); a split
+    # larger than max_count is truncated to max_count rows, and the clamped
+    # count is what the receiver sees -- overflow loses the tail but stays
+    # internally consistent (recv_counts[j] <= max_count always).
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(send_counts)[:-1]])
+    clamped = jnp.minimum(send_counts, max_count)
+    # Tail padding keeps every dynamic slice in bounds (XLA clamps
+    # out-of-bounds starts, which would otherwise duplicate trailing rows).
+    pad = jnp.zeros((max_count,) + x.shape[1:], x.dtype)
+    xp = jnp.concatenate([x, pad], axis=0)
+    pieces = jax.vmap(
+        lambda off: lax.dynamic_slice_in_dim(xp, off, max_count, axis=0)
+    )(offsets)                                # [size, max_count, ...]
+    valid = (jnp.arange(max_count, dtype=jnp.int32)[None, :]
+             < clamped[:, None])              # [size, max_count]
+    valid = valid.reshape(valid.shape + (1,) * (x.ndim - 1))
+    pieces = jnp.where(valid, pieces, jnp.zeros((), x.dtype))
+    recv = lax.all_to_all(pieces, a, split_axis=0, concat_axis=0, tiled=True)
+    recv_counts = lax.all_to_all(clamped, a, split_axis=0, concat_axis=0,
+                                 tiled=True)
+    return recv, recv_counts
+
+
 def barrier(*, axes: Optional[AxisSpec] = None, process_set=None):
     """Synchronization barrier (BarrierOp analogue).
 

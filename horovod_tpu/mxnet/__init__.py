@@ -104,11 +104,20 @@ def broadcast_(tensor, root_rank: int = 0, name=None, process_set=None):
     return tensor
 
 
-def alltoall(tensor, name=None, process_set=None):
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """With ``splits``: uneven exchange, returns (received, recv_splits)
+    (reference ``horovod.mxnet.alltoall`` semantics)."""
     mx = _require_mxnet()
-    out = _eager.alltoall(_to_stack(tensor), name=name,
-                          process_set=process_set)
-    return _from_row(mx, out, tensor.context)
+    if splits is None:
+        out = _eager.alltoall(_to_stack(tensor), name=name,
+                              process_set=process_set)
+        return _from_row(mx, out, tensor.context)
+    sp = getattr(splits, "asnumpy", lambda: splits)()
+    data = tensor.asnumpy()
+    out, rsplits = _eager.alltoallv_row(data, sp, name=name,
+                                        process_set=process_set)
+    return (mx.nd.array(out, ctx=tensor.context, dtype=data.dtype),
+            mx.nd.array(rsplits, ctx=tensor.context, dtype="int32"))
 
 
 def reducescatter(tensor, op: ReduceOp = Average, name=None,

@@ -107,10 +107,20 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
     return _from_row(out, tensor)
 
 
-def alltoall(tensor, name: Optional[str] = None, process_set=None):
-    out = _eager.alltoall(_to_stack(tensor), name=name,
-                          process_set=process_set)
-    return _from_row(out, tensor)
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    """Reference parity (``horovod.tensorflow.alltoall``): with ``splits``
+    the exchange is uneven and the result is ``(received,
+    received_splits)``; without, ``tensor`` splits evenly."""
+    if splits is None:
+        out = _eager.alltoall(_to_stack(tensor), name=name,
+                              process_set=process_set)
+        return _from_row(out, tensor)
+    data, rsplits = _eager.alltoallv_row(np.asarray(tensor),
+                                         np.asarray(splits), name=name,
+                                         process_set=process_set)
+    return (tf.convert_to_tensor(data),
+            tf.convert_to_tensor(rsplits.astype(np.int32)))
 
 
 def reducescatter(tensor, op: ReduceOp = Average, name=None,

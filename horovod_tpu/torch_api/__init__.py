@@ -210,11 +210,23 @@ def broadcast_(tensor: torch.Tensor, root_rank: int, **kwargs):
     return tensor
 
 
-def alltoall(tensor: torch.Tensor, name: Optional[str] = None,
-             process_set=None) -> torch.Tensor:
-    out = _eager.alltoall(_to_stack(tensor), name=name,
-                          process_set=process_set)
-    return _from_row(out, tensor)
+def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
+             name: Optional[str] = None, process_set=None):
+    """Reference parity (``horovod.torch.alltoall``): with ``splits`` the
+    exchange is uneven -- ``splits[i]`` rows of ``tensor`` go to rank
+    ``i`` -- and the result is ``(received, received_splits)``; without,
+    ``tensor`` splits evenly and only the received tensor returns."""
+    if splits is None:
+        out = _eager.alltoall(_to_stack(tensor), name=name,
+                              process_set=process_set)
+        return _from_row(out, tensor)
+    sp = splits.detach().cpu().numpy() if isinstance(splits, torch.Tensor) \
+        else splits
+    data, rsplits = _eager.alltoallv_row(
+        tensor.detach().cpu().numpy(), sp, name=name,
+        process_set=process_set)
+    return (torch.from_numpy(data.copy()).to(tensor.dtype),
+            torch.from_numpy(rsplits.astype(np.int64)))
 
 
 def reducescatter(tensor: torch.Tensor, op: ReduceOp = Average,

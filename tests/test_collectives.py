@@ -118,6 +118,119 @@ def test_alltoall(hvd, n_devices):
         np.testing.assert_allclose(np.asarray(y[r]), expect, rtol=1e-6)
 
 
+def _ragged_a2a_case(n, tail=(2,)):
+    """Build per-rank ragged data + splits and the expected exchange.
+
+    splits[r][i] = (r + i) % 3 rows from rank r to rank i; row payloads
+    encode (sender, dest) so misrouted rows are visible.
+    """
+    splits = np.array([[(r + i) % 3 for i in range(n)] for r in range(n)],
+                      np.int32)
+    datas = []
+    for r in range(n):
+        rows = []
+        for i in range(n):
+            for j in range(splits[r, i]):
+                rows.append(np.full(tail, 100.0 * r + i + 0.01 * j,
+                                    np.float32))
+        datas.append(np.stack(rows) if rows
+                     else np.zeros((0,) + tail, np.float32))
+    expect = []
+    for r in range(n):
+        rows = []
+        for s in range(n):
+            for j in range(splits[s, r]):
+                rows.append(np.full(tail, 100.0 * s + r + 0.01 * j,
+                                    np.float32))
+        expect.append(np.stack(rows) if rows
+                      else np.zeros((0,) + tail, np.float32))
+    return datas, splits, expect
+
+
+def test_alltoallv_eager(hvd, n_devices):
+    datas, splits, expect = _ragged_a2a_case(n_devices)
+    got, recv_splits = hv.alltoallv(datas, list(splits), name="a2av")
+    assert len(got) == n_devices
+    for r in range(n_devices):
+        np.testing.assert_allclose(got[r], expect[r], rtol=1e-6)
+        np.testing.assert_array_equal(recv_splits[r], splits[:, r])
+
+
+def test_alltoallv_in_step_traced_counts(hvd, n_devices):
+    """ops.alltoallv with counts computed INSIDE the traced step (the MoE
+    dispatch pattern: routing decided on device, exchange stays on device).
+    """
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    n = n_devices
+    max_count = 3
+    datas, splits, expect = _ragged_a2a_case(n, tail=(2,))
+    # Static-shape per-rank buffers: pad each rank's data to the same total.
+    tot = max(d.shape[0] for d in datas)
+    data_padded = np.stack([np.pad(d, ((0, tot - d.shape[0]), (0, 0)))
+                            for d in datas])           # [n, tot, 2]
+
+    def f(x, s):
+        recv, rc = cops.alltoallv(x[0], s[0], axes=axes,
+                                  max_count=max_count)
+        return recv[None], rc[None]
+
+    fs = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(axes), P(axes)),
+        out_specs=(P(axes), P(axes))))
+    recv, rc = fs(jnp.asarray(data_padded), jnp.asarray(splits))
+    recv, rc = np.asarray(recv), np.asarray(rc)
+    assert recv.shape == (n, n, max_count, 2)
+    for r in range(n):
+        np.testing.assert_array_equal(rc[r], splits[:, r])
+        off = 0
+        for s in range(n):
+            c = splits[s, r]
+            np.testing.assert_allclose(recv[r, s, :c],
+                                       expect[r][off:off + c], rtol=1e-6)
+            # Padding past the valid rows is zero (documented contract).
+            assert np.all(recv[r, s, c:] == 0.0)
+            off += c
+
+
+def test_alltoallv_in_step_truncates_consistently(hvd, n_devices):
+    """A traced count above max_count truncates the split AND clamps the
+    receiver's count -- never recv_counts[j] > max_count."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    n = n_devices
+    max_count = 2
+    # Every rank sends 4 rows to rank 0 and 1 row to the others.
+    splits = np.array([[4] + [1] * (n - 1)] * n, np.int32)
+    tot = int(splits[0].sum())
+    data = np.stack([np.arange(tot, dtype=np.float32) + 10 * r
+                     for r in range(n)])[..., None]     # [n, tot, 1]
+
+    def f(x, s):
+        recv, rc = cops.alltoallv(x[0], s[0], axes=axes,
+                                  max_count=max_count)
+        return recv[None], rc[None]
+
+    fs = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(axes), P(axes)),
+        out_specs=(P(axes), P(axes))))
+    recv, rc = fs(jnp.asarray(data), jnp.asarray(splits))
+    recv, rc = np.asarray(recv), np.asarray(rc)
+    assert rc.max() <= max_count
+    # Rank 0 receives the FIRST max_count rows of each sender's 4-row
+    # split, with the clamped count reported.
+    np.testing.assert_array_equal(rc[0], np.full(n, max_count))
+    for s in range(n):
+        np.testing.assert_allclose(recv[0, s, :, 0],
+                                   np.arange(max_count) + 10 * s)
+
+
 def test_grouped_allreduce(hvd, n_devices):
     xs = [rank_stacked(n_devices, shape, jnp.float32, seed=i)
           for i, shape in enumerate([(4,), (2, 3), (5, 1)])]

@@ -35,6 +35,18 @@ def test_allgather_broadcast(hvd_tf, n_devices):
     np.testing.assert_allclose(b.numpy(), [7.0])
 
 
+def test_alltoall_splits(hvd_tf, n_devices):
+    """alltoall(tensor, splits) -> (received, received_splits) parity."""
+    n = n_devices
+    sp = tf.constant([(i % 3) + 1 for i in range(n)], tf.int32)
+    tot = int(tf.reduce_sum(sp))
+    t = tf.reshape(tf.range(tot * 2, dtype=tf.float32), (tot, 2))
+    out, rsp = hvd_tf.alltoall(t, splits=sp)
+    block0 = t.numpy()[: int(sp[0])]
+    np.testing.assert_allclose(out.numpy(), np.tile(block0, (n, 1)))
+    np.testing.assert_array_equal(rsp.numpy(), np.full(n, int(sp[0])))
+
+
 def test_broadcast_variables(hvd_tf):
     v = tf.Variable([1.0, 2.0, 3.0])
     hvd_tf.broadcast_variables([v], root_rank=0)

@@ -65,6 +65,31 @@ def test_broadcast_and_allgather(hvd_t, n_devices):
     assert g.shape == (2 * n_devices, 3)
 
 
+def test_alltoall_splits(hvd_t, n_devices):
+    """Reference parity: alltoall(tensor, splits) does an uneven exchange
+    and returns (received, received_splits).  Single-process mode: every
+    rank replicates the same (tensor, splits), so rank 0 receives its
+    block 0 from each of the n identical senders."""
+    n = n_devices
+    sp = torch.tensor([(i % 3) + 1 for i in range(n)], dtype=torch.int64)
+    tot = int(sp.sum())
+    t = torch.arange(tot * 2, dtype=torch.float32).reshape(tot, 2)
+    out, rsp = hvd_t.alltoall(t, splits=sp)
+    assert isinstance(out, torch.Tensor) and out.dtype == t.dtype
+    block0 = t.numpy()[: int(sp[0])]
+    np.testing.assert_allclose(out.numpy(), np.tile(block0, (n, 1)))
+    np.testing.assert_array_equal(rsp.numpy(), np.full(n, int(sp[0])))
+
+
+def test_alltoall_even_returns_bare_tensor(hvd_t, n_devices):
+    n = n_devices
+    t = torch.arange(n * 2, dtype=torch.float32)
+    out = hvd_t.alltoall(t)
+    assert isinstance(out, torch.Tensor)  # no splits -> no tuple
+    # Replicated senders: receiver 0 gets its chunk 0 from all n senders.
+    np.testing.assert_allclose(out.numpy(), np.tile(t.numpy()[:2], n))
+
+
 def test_grouped_allreduce(hvd_t, n_devices):
     ts = [torch.ones(3), torch.full((2, 2), 2.0)]
     outs = hvd_t.grouped_allreduce(ts, op=thvd.Sum)
