@@ -12,11 +12,10 @@ where ``a`` is the lower-index group's vector.  Dot products are taken in
 float32 regardless of wire dtype (matching the reference's double-precision
 scalar accumulation in spirit; f32 is the TPU-native scalar unit width).
 
-Note on bandwidth: the reference halves the vector at each level (VHDD,
-O(n) bytes total); this version exchanges full vectors (O(n log p)) which
-is simple and correct.  On ICI the log p factor is cheap for the scalar
-mixing to remain exact; a psum_scatter-based VHDD variant is the planned
-optimization once profiled.
+Bandwidth: like the reference, the vector halves at each level (VHDD) --
+O(n) bytes per rank for the reduce phase plus O(n) for the rebuild
+allgather, independent of world size; only the 3 mixing scalars per level
+pay a log p factor.
 
 Validated against ``horovod_tpu.adasum.reference.adasum_reference``.
 """
@@ -80,8 +79,38 @@ def adasum_allreduce_hierarchical(x, dcn_axis: str = "dcn",
     return out.reshape(shape)
 
 
+def adasum_local_tree(vectors):
+    """Adasum of a list of on-device vectors, no communication.
+
+    The same binary tree as ``adasum.reference.adasum_reference`` (level k
+    combines groups whose bit k differs, lower-index group first), unrolled
+    at trace time.  Used for process-set Adasum, where member vectors are
+    gathered first and every device mixes locally.
+    """
+    n = len(vectors)
+    if n & (n - 1) != 0:
+        raise ValueError(f"Adasum requires a power-of-two count, got {n}")
+    if n == 1:
+        return vectors[0]
+    half = n // 2
+    return _pair(adasum_local_tree(vectors[:half]),
+                 adasum_local_tree(vectors[half:]))
+
+
 def adasum_allreduce(x, axis: str = "hvd"):
-    """Adasum-allreduce ``x`` across the (power-of-two) flat mesh axis."""
+    """Adasum-allreduce ``x`` across the (power-of-two) flat mesh axis.
+
+    Vector-halving distance-doubling (the reference's ``adasum.h``
+    FusedAllreduce schedule): at level k each rank exchanges HALF of its
+    working segment with its distance-2^k partner, so the payload halves as
+    the distance doubles -- O(n) bytes per rank total, not O(n log p).  The
+    mixing coefficients need FULL-vector dot products, which after halving
+    live distributed across the 2^(k+1)-rank merged group: each rank
+    computes partials on its retained piece and the 3 scalars are summed
+    over the group (an all_gather of 3 floats per level -- the analogue of
+    the reference's per-level MPI scalar allreduce, negligible bytes).  A
+    reverse-order distance-halving allgather rebuilds the full vector.
+    """
     n = lax.axis_size(axis)
     if n & (n - 1) != 0:
         raise ValueError(f"Adasum requires a power-of-two world size, got {n}")
@@ -89,14 +118,47 @@ def adasum_allreduce(x, axis: str = "hvd"):
         return x
     idx = lax.axis_index(axis)
     levels = int(math.log2(n))
-    y = x
+    shape = x.shape
+    flat = x.ravel()
+    pad = (-flat.size) % n  # divisible by 2 at every halving level
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    y = flat  # my piece of my (size-2^k) group's combined vector
+    ranks = jnp.arange(n)
     for k in range(levels):
         bit = 1 << k
         perm = [(i, i ^ bit) for i in range(n)]
-        partner = lax.ppermute(y, axis, perm)
-        # Lower-index group (bit clear) owns the "a" slot.
+        half = y.shape[0] // 2
         is_lo = (idx & bit) == 0
-        a = jnp.where(is_lo, y, partner)
-        b = jnp.where(is_lo, partner, y)
-        y = _pair(a, b)
-    return y
+        first, second = y[:half], y[half:]
+        # Lower rank keeps the first half; partner (same position within
+        # its group) keeps the second -- retained pieces stay aligned on
+        # the same global index range by induction.
+        mine = jnp.where(is_lo, first, second)
+        give = jnp.where(is_lo, second, first)
+        recv = lax.ppermute(give, axis, perm)
+        a_piece = jnp.where(is_lo, mine, recv)  # lower group's vector
+        b_piece = jnp.where(is_lo, recv, mine)
+        a32 = a_piece.astype(jnp.float32)
+        b32 = b_piece.astype(jnp.float32)
+        partial = jnp.stack([jnp.dot(a32, b32), jnp.dot(a32, a32),
+                             jnp.dot(b32, b32)])
+        dots_all = lax.all_gather(partial, axis, axis=0)     # [n, 3]
+        in_group = ((ranks >> (k + 1)) == (idx >> (k + 1)))
+        dot, anormsq, bnormsq = jnp.sum(
+            jnp.where(in_group[:, None], dots_all, 0.0), axis=0)
+        acoeff = jnp.where(anormsq < _TOL, 1.0, 1.0 - dot / (2.0 * anormsq))
+        bcoeff = jnp.where(bnormsq < _TOL, 1.0, 1.0 - dot / (2.0 * bnormsq))
+        y = (acoeff.astype(y.dtype) * a_piece
+             + bcoeff.astype(y.dtype) * b_piece)
+    # Distance-halving allgather, inverting the split order.
+    for k in reversed(range(levels)):
+        bit = 1 << k
+        perm = [(i, i ^ bit) for i in range(n)]
+        is_lo = (idx & bit) == 0
+        recv = lax.ppermute(y, axis, perm)
+        y = jnp.where(is_lo, jnp.concatenate([y, recv]),
+                      jnp.concatenate([recv, y]))
+    if pad:
+        y = y[:-pad]
+    return y.reshape(shape)

@@ -443,9 +443,11 @@ def alltoallv(arrs, splits, *, name=None, process_set=None):
     for a, s in zip(arrs, splits):
         if s.shape != (n,):
             raise ValueError(f"splits must have shape ({n},), got {s.shape}")
-        if s.sum() > a.shape[0]:
+        if s.sum() != a.shape[0]:
             raise ValueError(
-                f"splits sum {int(s.sum())} exceeds data rows {a.shape[0]}")
+                f"splits must sum to the data rows (the rank-order "
+                f"concatenation of splits): sum {int(s.sum())} != "
+                f"{a.shape[0]} rows")
     tail_shapes = {a.shape[1:] for a in arrs}
     dtypes = {a.dtype for a in arrs}
     if len(tail_shapes) > 1 or len(dtypes) > 1:
@@ -496,6 +498,11 @@ def alltoallv_row(data, splits, *, name=None, process_set=None):
     data = np.asarray(data)
     sp = np.asarray(splits, np.int32)
     k = local_rank_count(process_set)
+    if k == 0:
+        raise RuntimeError(
+            "alltoall(splits=...) called on a process owning no member "
+            "device of the process set (in the reference's per-rank model "
+            "a non-member never calls the op)")
     datas, rsplits = alltoallv([data] * k, [sp] * k, name=name,
                                process_set=process_set)
     return datas[0], rsplits[0]

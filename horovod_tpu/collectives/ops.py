@@ -22,6 +22,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .reduce_op import ReduceOp, Average, Sum, Min, Max, Product, Adasum
@@ -63,6 +64,27 @@ def _resolve(axes: Optional[AxisSpec],
 
 def _member_mask(axes: Tuple[str, ...], members: Tuple[int, ...]):
     return jnp.isin(axis_index(axes), jnp.asarray(members))
+
+
+def _member_pos(axes: Tuple[str, ...], members: Tuple[int, ...]):
+    """This device's position within ``members`` (0 for non-members).
+
+    ``members`` is static, so the rank->position table is baked into the
+    program as a constant gather.
+    """
+    size = math.prod(lax.axis_size(a) for a in axes)
+    table = np.zeros((size,), np.int32)
+    table[list(members)] = np.arange(len(members), dtype=np.int32)
+    return jnp.asarray(table)[axis_index(axes)]
+
+
+def _gather_rows(x, axes: Tuple[str, ...]):
+    """Stack every mesh member's ``x`` along a new leading axis, ordered by
+    the row-major flattened index (matching :func:`axis_index`)."""
+    g = x[None]
+    for a in reversed(axes):
+        g = lax.all_gather(g, a, axis=0, tiled=True)
+    return g
 
 
 def axis_size(axes: Optional[AxisSpec] = None) -> int:
@@ -142,11 +164,20 @@ def allreduce(x,
         y = jnp.prod(g, axis=0, dtype=g.dtype)
     elif op is Adasum:
         from ..adasum.xla import (adasum_allreduce,
-                                  adasum_allreduce_hierarchical)
+                                  adasum_allreduce_hierarchical,
+                                  adasum_local_tree)
         if members is not None:
-            raise NotImplementedError(
-                "Adasum currently requires the global process set")
-        if len(axes) == 1:
+            # Subset Adasum: gather member vectors, then run the same
+            # binary-tree mixing locally on every device (compute is
+            # replicated, comm is one gather -- fine at subset scale; the
+            # global path below stays bandwidth-optimal).
+            if len(members) & (len(members) - 1) != 0:
+                raise ValueError(
+                    f"Adasum requires a power-of-two member count, got "
+                    f"{len(members)}")
+            sel = _gather_rows(x, axes)[np.asarray(members)]
+            y = adasum_local_tree([sel[i] for i in range(len(members))])
+        elif len(axes) == 1:
             y = adasum_allreduce(x, axis=axes[0])
         elif len(axes) == 2:
             # Hierarchical (dcn, ici) mesh: the reference's hybrid Adasum
@@ -205,13 +236,19 @@ def allgather(x,
     Like the reference, workers may differ only in dimension ``axis`` --
     but XLA requires static equal shapes, so unequal first dims must go
     through :func:`allgatherv` (padding-based) instead.
+
+    With a process set, every device (SPMD traces one program) computes the
+    gather of the MEMBER values -- shape ``[len(set) * d_axis, ...]`` when
+    tiled.  Non-members receive the member gather too (in the reference's
+    per-rank model they would never have called the op).
     """
     axes, members = _resolve(axes, process_set)
     if members is not None:
-        raise NotImplementedError(
-            "in-step allgather over a process set is not supported (shape "
-            "would differ per device); use the eager API, which runs on the "
-            "member-only sub-mesh")
+        sel = _gather_rows(x, axes)[np.asarray(members)]  # [m, ...]
+        if tiled:
+            return jnp.concatenate([sel[i] for i in range(len(members))],
+                                   axis=axis)
+        return jnp.moveaxis(sel, 0, axis)
     y = x
     for a in reversed(axes):
         y = lax.all_gather(y, a, axis=axis, tiled=tiled)
@@ -258,14 +295,33 @@ def reducescatter(x,
                   axes: Optional[AxisSpec] = None,
                   process_set=None,
                   scatter_axis: int = 0):
-    """Reduce then scatter shards along ``scatter_axis`` (NCCLReducescatter)."""
+    """Reduce then scatter shards along ``scatter_axis`` (NCCLReducescatter).
+
+    With a process set, members reduce among themselves (masked full-mesh
+    psum) and each member takes the shard at its position within the set;
+    ``x.shape[scatter_axis]`` must divide by the set size.  Non-members
+    receive shard 0 of the member reduction (unspecified in the reference's
+    per-rank model -- a non-member never calls the op).
+    """
     axes, members = _resolve(axes, process_set)
-    if members is not None:
-        raise NotImplementedError(
-            "in-step reducescatter over a process set is not supported "
-            "(shape would differ per device); use the eager API")
     if op not in (Sum, Average):
         raise NotImplementedError("reducescatter supports Sum/Average")
+    if members is not None:
+        m = len(members)
+        d = x.shape[scatter_axis]
+        if d % m:
+            raise ValueError(
+                f"reducescatter over a {m}-member process set needs "
+                f"dim {scatter_axis} divisible by {m}, got {d}")
+        mask = _member_mask(axes, members)
+        contrib = jnp.where(mask, x, jnp.zeros((), x.dtype))
+        y = lax.psum(contrib, axes)
+        shard = d // m
+        pos = _member_pos(axes, members)
+        y = lax.dynamic_slice_in_dim(y, pos * shard, shard, scatter_axis)
+        if op is Average:
+            y = _divide_in_dtype(y, m)
+        return y
     y = x
     for a in axes:
         y = lax.psum_scatter(y, a, scatter_dimension=scatter_axis, tiled=True)
@@ -286,16 +342,37 @@ def alltoall(x,
     The reference supports uneven ``splits``; XLA's static shapes require
     equal splits -- uneven exchange is provided by ``alltoallv`` (padded).
     This is the expert-parallel / Ulysses building block (SURVEY.md 5.7).
+
+    With a process set, members exchange their ``len(set)`` splits through
+    a masked full-mesh alltoall (non-member slots carry zeros; non-members
+    receive zeros).  ``x.shape[split_axis]`` must divide by the set size.
     """
     axes, members = _resolve(axes, process_set)
-    if members is not None:
-        raise NotImplementedError(
-            "in-step alltoall over a process set is not supported; use the "
-            "eager API, which runs on the member-only sub-mesh")
     if len(axes) != 1:
         raise NotImplementedError("alltoall requires a flat mesh axis")
-    return lax.all_to_all(x, axes[0], split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    a = axes[0]
+    if members is None:
+        return lax.all_to_all(x, a, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    m = len(members)
+    size = lax.axis_size(a)
+    d = x.shape[split_axis]
+    if d % m:
+        raise ValueError(
+            f"alltoall over a {m}-member process set needs dim "
+            f"{split_axis} divisible by {m}, got {d}")
+    chunk = d // m
+    # [m, chunk, rest...]: split i is this member's payload for member i.
+    xs = jnp.moveaxis(x, split_axis, 0).reshape(
+        (m, chunk) + tuple(np.delete(np.array(x.shape), split_axis)))
+    send = jnp.zeros((size,) + xs.shape[1:], x.dtype)
+    send = send.at[np.asarray(members)].set(xs)
+    recv = lax.all_to_all(send, a, split_axis=0, concat_axis=0, tiled=True)
+    sel = recv[np.asarray(members)]          # [m, chunk, rest...]
+    # Match the global tiled semantics: split_axis shrinks to ``chunk``,
+    # concat_axis grows by ``m``.
+    pieces = jnp.moveaxis(sel, 1, split_axis + 1)   # [m] + x-like shape
+    return jnp.concatenate([pieces[i] for i in range(m)], axis=concat_axis)
 
 
 def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,

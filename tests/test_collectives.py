@@ -274,6 +274,77 @@ def test_process_set_allreduce(hvd, n_devices):
     hv.remove_process_set("half")
 
 
+def test_in_step_process_set_collectives(hvd, n_devices):
+    """allgather/reducescatter/alltoall/Adasum over a process set INSIDE a
+    traced step (masked full-mesh implementations -- SURVEY.md section 3.1
+    ProcessSet says every collective works per-set)."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.adasum.reference import adasum_reference
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    members = (1, 3, 5, 7)
+    m = len(members)
+    ps = hv.add_process_set(members, name="instep")
+    try:
+        def f(x):
+            local = x[0]                        # [m, 2] rows
+            g = cops.allgather(local[:1], axes=axes, process_set=ps)
+            rs = cops.reducescatter(local, hv.Sum, axes=axes,
+                                    process_set=ps)
+            a2a = cops.alltoall(local, axes=axes, process_set=ps)
+            ad = cops.allreduce(local, hv.Adasum, axes=axes,
+                                process_set=ps)
+            return g[None], rs[None], a2a[None], ad[None]
+
+        fs = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axes),
+                                   out_specs=(P(axes),) * 4))
+        x = rank_stacked(n_devices, (m, 2), jnp.float32)
+        g, rs, a2a, ad = map(np.asarray, fs(x))
+        xs = np.asarray(x)
+        mem = list(members)
+        member_sum = xs[mem].sum(axis=0)        # [m, 2]
+        expect_ad = adasum_reference([xs[r] for r in mem])
+        for pos, r in enumerate(mem):
+            # allgather: concat of member first-rows.
+            np.testing.assert_allclose(
+                g[r], np.concatenate([xs[s][:1] for s in mem]), rtol=1e-6)
+            # reducescatter: member at set-position pos takes shard pos.
+            np.testing.assert_allclose(rs[r], member_sum[pos:pos + 1],
+                                       rtol=1e-5)
+            # alltoall: row i is member i's chunk pos.
+            np.testing.assert_allclose(
+                a2a[r], np.stack([xs[s][pos] for s in mem]), rtol=1e-6)
+            np.testing.assert_allclose(ad[r], expect_ad, rtol=1e-3,
+                                       atol=1e-5)
+        # Allreduce-style ops leave non-members' values untouched.
+        for r in range(n_devices):
+            if r not in members:
+                np.testing.assert_allclose(ad[r], xs[r], rtol=1e-6)
+
+        # Distinct split/concat axes follow the global tiled semantics:
+        # split_axis shrinks by m, concat_axis grows by m.
+        def f2(x):
+            return cops.alltoall(x[0], axes=axes, process_set=ps,
+                                 split_axis=1, concat_axis=0)[None]
+
+        fs2 = jax.jit(jax.shard_map(f2, mesh=mesh, in_specs=P(axes),
+                                    out_specs=P(axes)))
+        x2 = rank_stacked(n_devices, (3, m), jnp.float32, seed=5)
+        y2 = np.asarray(fs2(x2))
+        xs2 = np.asarray(x2)
+        assert y2.shape[1:] == (3 * m, 1)
+        for pos, r in enumerate(mem):
+            # Receiver at set position pos: sender i's column pos, stacked
+            # over senders along axis 0.
+            expect = np.concatenate(
+                [xs2[s][:, pos:pos + 1] for s in mem], axis=0)
+            np.testing.assert_allclose(y2[r], expect, rtol=1e-6)
+    finally:
+        hv.remove_process_set("instep")
+
+
 def test_process_set_registry(hvd, n_devices):
     ps = hv.add_process_set([0, 1], name="pair")
     assert "pair" in hv.process_set_names()
