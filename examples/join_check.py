@@ -1,0 +1,70 @@
+"""Multi-process JoinOp check: ranks stop after different batch counts.
+
+Reference behavior under test (SURVEY.md 3.2 JoinOp): rank 0 exhausts its
+data first and calls ``hvd.join()``; rank 1 keeps allreducing (averages are
+over the ACTIVE ranks only), runs a ragged allgather that receives zero
+rows from the drained rank, then joins -- nobody deadlocks, and ``join``
+returns the last rank to join.  A second epoch validates that join
+generations reset cleanly.
+
+    python -m horovod_tpu.run -np 2 --cpu python examples/join_check.py
+"""
+
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
+
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank, n = hvd.rank(), hvd.size()
+    nproc = jax.process_count()
+    assert nproc >= 2, "run under horovod_tpu.run -np 2+"
+    s = jax.local_device_count()
+
+    my_batches = 2 * (jax.process_index() + 1)     # proc 0: 2, proc 1: 4
+    for b in range(my_batches):
+        out = hvd.allreduce(
+            np.full((s, 3), 1.0 + jax.process_index(), np.float32),
+            hvd.Average, name="join_loop")
+        got = hvd.local_result(out)[0]
+        active = [p for p in range(nproc) if 2 * (p + 1) > b]
+        expect = float(np.mean([1.0 + p for p in active]))
+        assert np.allclose(got, expect, atol=1e-5), (b, got, expect)
+        print(f"rank {rank}: batch {b} avg={got[0]:.3f} (expect "
+              f"{expect:.3f}, {len(active)} active)")
+
+    if jax.process_index() == nproc - 1:
+        # Sole survivor: ragged allgather receives ZERO rows from every
+        # drained rank (each replays a 0-size contribution).
+        rows = hvd.allgatherv([np.full((2, 2), 7.0, np.float32)
+                               for _ in range(s)])
+        assert rows.shape == (2 * s, 2), rows.shape
+        assert np.allclose(rows, 7.0), rows
+        print(f"rank {rank}: allgatherv-during-join OK {rows.shape}")
+
+    last = hvd.join()
+    print(f"rank {rank}: join OK last={last}")
+    assert last == n - 1, (last, n)  # the rank with the most batches
+
+    # Epoch 2: generation advanced; survivors still drain correctly.
+    if jax.process_index() == nproc - 1:
+        out = hvd.allreduce(np.full((s, 2), 4.0, np.float32), hvd.Sum,
+                            name="epoch2")
+        got = hvd.local_result(out)[0]
+        assert np.allclose(got, 4.0), got  # only this rank contributes
+        print(f"rank {rank}: epoch2 sum OK")
+    last2 = hvd.join()
+    print(f"rank {rank}: join2 OK last={last2}")
+    assert last2 == n - 1, last2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

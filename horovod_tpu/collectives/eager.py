@@ -99,11 +99,19 @@ def _to_global(x, mesh: Mesh):
 
 
 def _run(kind: str, x, name: Optional[str], ps, per_rank_fn, op_label: str,
-         out_rank_stacked: bool = True):
-    """Shared eager dispatch: cache lookup -> shard_map program -> run."""
+         out_rank_stacked: bool = True, publish_meta: Optional[dict] = None):
+    """Shared eager dispatch: cache lookup -> shard_map program -> run.
+
+    ``publish_meta``: replay metadata for joined ranks (join mode only) --
+    published to the coordination KV store under this op's fence sequence
+    number before dispatch, so drained ranks can mirror the collective.
+    """
+    from . import joinop as _join
     st = global_state()
     ps = _ps.get_process_set(ps)
     mesh = ps.flat_mesh()
+    if publish_meta is not None:
+        _join.publish(mesh, publish_meta)
     arr = _to_global(x, mesh)
     key = signature(kind, name, (tuple(arr.shape), str(arr.dtype)), op_label,
                     ps.name)
@@ -156,8 +164,17 @@ def reset_fences() -> None:
     an elastic re-init, a restarted worker starts counting from zero, so a
     survivor carrying the old counts would wait at differently-named
     barriers forever."""
+    from . import joinop as _join
     with _fence_lock:
         _fence_seq.clear()
+    _join.reset()
+
+
+def _peek_next_seq(procs: tuple) -> int:
+    """The fence sequence number the NEXT collective on ``procs`` will use
+    (the key joined ranks watch for replay metadata)."""
+    with _fence_lock:
+        return _fence_seq.get(procs, 0) + 1
 
 
 def _coordination_fence(mesh: Mesh) -> None:
@@ -237,9 +254,51 @@ def poll(handle: int) -> bool:
 # Public eager collectives.
 # ---------------------------------------------------------------------------
 
+def _join_sync(ps, kind: str, x, name: Optional[str], extra: dict = None):
+    """Presence round + replay-metadata for join mode (JoinOp draining).
+
+    Returns ``(k_active, meta, mask)``: ``k_active``/``mask`` are None
+    when join handling does not apply (single process, replaying,
+    non-global set); ``meta`` is None unless some rank has joined
+    (k < set size), in which case it is the dict to publish for drained
+    ranks to replay.
+    """
+    from . import joinop as _join
+    ps = _ps.get_process_set(ps)
+    mask = _join.sync(ps)
+    if mask is None:
+        return None, None, None
+    k = int(mask.sum())
+    if k >= ps.size():
+        return k, None, mask
+    xa = np.asarray(x)
+    meta = {"kind": kind, "name": name,
+            "shape": (ps.size(),) + tuple(xa.shape[1:]),
+            "dtype": str(xa.dtype)}
+    if extra:
+        meta.update(extra)
+    return k, meta, mask
+
+
 def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
               process_set=None, prescale_factor: float = 1.0,
               postscale_factor: float = 1.0, compression=Compression.none):
+    ps = _ps.get_process_set(process_set)
+    k, jmeta, _mask = _join_sync(ps, "allreduce", x, name)
+    if jmeta is not None:
+        if op is Average:
+            # Mean over the ranks that actually contributed (reference
+            # JoinOp behavior): the traced op divides by the full size n,
+            # so rescale by n/k.  Ill-defined for truncating int division.
+            if np.issubdtype(np.asarray(x).dtype, np.integer):
+                raise NotImplementedError(
+                    "integer-dtype Average while ranks are joined is "
+                    "unsupported (truncating rescale is ill-defined)")
+            postscale_factor *= ps.size() / k
+        jmeta.update(op=str(op), pre=prescale_factor,
+                     post=postscale_factor,
+                     compression=compression.__name__)
+
     def per_rank(t):
         c, ctx = compression.compress(t)
         r = _ops.allreduce(c, op, axes=(HVD_AXIS,),
@@ -250,7 +309,8 @@ def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
     # cache key (the reference's Request carries the same distinctions).
     label = (f"{op}|pre={prescale_factor}|post={postscale_factor}|"
              f"{compression.__name__}")
-    return _run("allreduce", x, name, process_set, per_rank, label)
+    return _run("allreduce", x, name, ps, per_rank, label,
+                publish_meta=jmeta)
 
 
 def allreduce_async(x, op: ReduceOp = Average, *, name=None, process_set=None,
@@ -304,10 +364,18 @@ def allgather(x, *, name=None, process_set=None):
     First dimensions must match; ragged inputs go through
     :func:`allgatherv` (the reference's ``hvd.allgather`` supports both
     through one entry point because its negotiation already exchanges
-    sizes; here the ragged path is explicit)."""
+    sizes; here the ragged path is explicit).
+
+    During a join phase, drained ranks contribute ZERO rows of sizes via
+    :func:`allgatherv` (reference zero-size gather contribution); through
+    this static-shape entry point they contribute zeros."""
+    ps = _ps.get_process_set(process_set)
+    _, jmeta, _mask = _join_sync(ps, "allgather", x, name)
+
     def per_rank(t):
         return _ops.allgather(t, axes=(HVD_AXIS,), axis=0)
-    return _run("allgather", x, name, process_set, per_rank, "gather")
+    return _run("allgather", x, name, ps, per_rank, "gather",
+                publish_meta=jmeta)
 
 
 def allgather_value(a, *, name=None, process_set=None) -> np.ndarray:
@@ -382,21 +450,56 @@ def broadcast(x, root_rank: int = 0, *, name=None, process_set=None):
                              f"(ranks {ps.ranks})")
         root_pos = ps.ranks.index(root_rank)
 
+    _, jmeta, mask = _join_sync(ps, "broadcast", x, name,
+                                {"root": root_rank})
+    if jmeta is not None and not mask[root_rank]:
+        # A drained root would replay zeros; error like the reference (a
+        # joined rank cannot be the source of new data).
+        raise RuntimeError(
+            f"broadcast root_rank {root_rank} has joined and cannot "
+            "source a broadcast")
+
     def per_rank(t):
         return _ops.broadcast(t, root_pos, axes=(HVD_AXIS,))
-    return _run("broadcast", x, name, ps, per_rank, f"root{root_rank}")
+    return _run("broadcast", x, name, ps, per_rank, f"root{root_rank}",
+                publish_meta=jmeta)
 
 
-def reducescatter(x, op: ReduceOp = Average, *, name=None, process_set=None):
+def reducescatter(x, op: ReduceOp = Average, *, name=None, process_set=None,
+                  _join_k: Optional[int] = None):
+    """``_join_k`` (internal): active-rank count during a join phase --
+    Average then divides by the contributing ranks, not the full size."""
+    ps = _ps.get_process_set(process_set)
+    if _join_k is None:
+        k, jmeta, _mask = _join_sync(ps, "reducescatter", x, name)
+        if jmeta is not None:
+            if op is Average:
+                if np.issubdtype(np.asarray(x).dtype, np.integer):
+                    raise NotImplementedError(
+                        "integer-dtype Average while ranks are joined is "
+                        "unsupported")
+                _join_k = k
+            jmeta.update(op=str(op), jk=_join_k)
+    else:
+        jmeta = None  # replaying a drained rank's mirror call
+
     def per_rank(t):
+        if _join_k:
+            y = _ops.reducescatter(t, Sum, axes=(HVD_AXIS,))
+            return y / jnp.asarray(_join_k, y.dtype)
         return _ops.reducescatter(t, op, axes=(HVD_AXIS,))
-    return _run("reducescatter", x, name, process_set, per_rank, str(op))
+    return _run("reducescatter", x, name, ps, per_rank,
+                f"{op}|jk={_join_k}", publish_meta=jmeta)
 
 
 def alltoall(x, *, name=None, process_set=None):
+    ps = _ps.get_process_set(process_set)
+    _, jmeta, _mask = _join_sync(ps, "alltoall", x, name)
+
     def per_rank(t):
         return _ops.alltoall(t, axes=(HVD_AXIS,))
-    return _run("alltoall", x, name, process_set, per_rank, "a2a")
+    return _run("alltoall", x, name, ps, per_rank, "a2a",
+                publish_meta=jmeta)
 
 
 def alltoallv(arrs, splits, *, name=None, process_set=None):
@@ -471,9 +574,15 @@ def alltoallv(arrs, splits, *, name=None, process_set=None):
             padded[r, i, :c] = a[off:off + c]
             off += int(c)
 
+    # Join phase: drained ranks replay this as a plain alltoall of zeros
+    # on the padded shape (identical traced program) -- their zero split
+    # rows in ``all_splits`` already make receivers take 0 rows from them.
+    _, jmeta, _mask = _join_sync(ps, "alltoall", padded, name)
+
     def per_rank(t):
         return _ops.alltoall(t, axes=(HVD_AXIS,))
-    out = _run("alltoallv", padded, name, ps, per_rank, "a2av")
+    out = _run("alltoallv", padded, name, ps, per_rank, "a2av",
+               publish_meta=jmeta)
     rows = local_result(out)                        # [k, n, max_len, ...]
     local_global_ranks = _local_member_positions(ps)
     datas, recv_splits = [], []
@@ -523,18 +632,32 @@ def barrier(*, process_set=None) -> None:
     """Block until every member device reaches the barrier."""
     ps = _ps.get_process_set(process_set)
     ones = replicated_stack(np.ones((1,), np.int32), ps)
+    _, jmeta, _mask = _join_sync(ps, "barrier", ones, "barrier")
     out = _run("barrier", ones, "barrier", ps,
-               lambda t: _ops.barrier(axes=(HVD_AXIS,)) * t, "barrier")
+               lambda t: _ops.barrier(axes=(HVD_AXIS,)) * t, "barrier",
+               publish_meta=jmeta)
     with _stall.watched("barrier"):
         jax.block_until_ready(out)
 
 
 def join() -> int:
-    """SPMD parity stub for ``hvd.join()``.
+    """``hvd.join()`` (reference JoinOp, SURVEY.md 3.2).
 
-    Under SPMD every device executes every step, so there are no stragglers
-    to drain; join degenerates to a barrier.  Returns -1 ("no rank joined
-    last"), matching the reference's return convention.
+    Multi-process mode: this process stops contributing and DRAINS -- it
+    keeps participating in the survivors' collectives with identity
+    payloads (zeros / +-inf / ones) until every process has joined, then
+    returns the last rank to join.  Ranks with fewer batches can therefore
+    stop early while the rest keep allreducing, without deadlock.
+
+    Single-controller SPMD mode: every rank executes every step by
+    construction, so there are no stragglers; join degenerates to a
+    barrier and returns -1 ("no rank joined last"), the reference's
+    convention when ranks are indistinguishable.
     """
-    barrier()
-    return -1
+    from . import joinop as _join
+    ps = _ps.get_process_set(None)
+    mesh = ps.flat_mesh()
+    if not _is_multiprocess(mesh) or _join.client() is None:
+        barrier()
+        return -1
+    return _join.join_drain(mesh)

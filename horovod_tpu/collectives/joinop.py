@@ -1,0 +1,241 @@
+"""JoinOp: straggler draining for the multi-process eager path.
+
+Reference semantics (``horovod/common/ops/operations.cc`` JoinOp, SURVEY.md
+section 3.2): a rank that runs out of batches calls ``hvd.join()`` and
+stops contributing, while the remaining ranks keep issuing collectives;
+the joined rank keeps PARTICIPATING (with identity payloads) so nobody
+deadlocks, and ``join`` returns once every rank has joined, yielding the
+last rank to join.
+
+The reference implements this inside its controller negotiation: joined
+ranks answer every negotiation round with a Join request and the
+coordinator fabricates their contribution.  Here there is no negotiation
+-- multi-process eager collectives are SPMD programs spanning every
+process's devices -- so the draining protocol runs over the JAX
+coordination service instead:
+
+* every multi-process eager dispatch first runs a fixed tiny "presence"
+  collective (a psum of one-hot rows) telling everyone which ranks are
+  still active;
+* when anyone has joined, the active caller publishes the op's replay
+  metadata (kind, shape, dtype, op params) to the coordination KV store
+  under the op's fence sequence number;
+* each joined process sits in :func:`join_drain`, running the same
+  presence rounds, fetching the metadata, and re-issuing the identical
+  collective through the public eager API with an identity payload
+  (zeros for sums/gathers, +/-inf for min/max, ones for products);
+* ``Average`` reductions are rescaled by ``n_ranks / n_active`` so the
+  mean is taken over the ranks that actually contributed (reference
+  behavior); integer-dtype Average during a join phase is unsupported
+  (the truncating-int rescale is ill-defined; gradients are floats);
+* a ragged :func:`~horovod_tpu.collectives.eager.allgatherv` from a
+  joined rank naturally contributes ZERO rows (its size row replays as
+  0), exactly the reference's zero-size gather contribution.
+
+The presence round costs one scalar-sized collective per eager dispatch;
+the multi-process eager path is already serialized per dispatch (see
+``eager._run``), so this changes constants, not shape.  The in-step
+(traced, fused) path -- the performance path -- is untouched: under SPMD
+a traced step executes on every device by construction, so there are no
+stragglers to drain.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..core import process_sets as _ps
+from ..core.config import _env_int
+from ..parallel.mesh import HVD_AXIS
+
+_lock = threading.Lock()
+_gen = 0              # completed join cycles (namespaces the KV keys)
+_joined = False       # this process is currently inside join_drain
+_replaying = False    # this process is re-issuing a fetched op
+_presence_cache = {}  # mesh -> compiled presence program
+
+
+def reset() -> None:
+    """Forget join state (``hvd.shutdown()``): a re-initialized world
+    starts at generation 0 with nobody joined."""
+    global _gen, _joined, _replaying
+    with _lock:
+        _gen = 0
+        _joined = False
+        _replaying = False
+        _presence_cache.clear()
+
+
+def client():
+    return getattr(jax._src.distributed.global_state, "client", None)
+
+
+def _op_key(seq: int) -> str:
+    return f"hvd_join/{_gen}/op/{seq}"
+
+
+def _last_key() -> str:
+    return f"hvd_join/{_gen}/last"
+
+
+def _timeout_ms() -> int:
+    return _env_int("HOROVOD_JOIN_TIMEOUT", 60) * 1000
+
+
+def _presence_program(mesh):
+    if mesh not in _presence_cache:
+        def spmd(block):  # block: [1, n] this device's row
+            return jax.lax.psum(block[0], HVD_AXIS)[None]
+        _presence_cache[mesh] = jax.jit(jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec(HVD_AXIS),
+            out_specs=jax.sharding.PartitionSpec(HVD_AXIS)))
+    return _presence_cache[mesh]
+
+
+def presence_round(mesh, active: bool) -> np.ndarray:
+    """One presence collective: returns the [n] 0/1 mask of active ranks.
+
+    Every process with devices in ``mesh`` must run this the same number
+    of times (actives once per eager dispatch, joined once per drain-loop
+    iteration) -- it is itself a collective.
+    """
+    from . import eager
+
+    n = int(mesh.devices.size)
+    positions = eager._local_member_positions(_ps.get_process_set(None))
+    rows = np.zeros((len(positions), n), np.int32)
+    if active:
+        for i, g in enumerate(positions):
+            rows[i, g] = 1
+    arr = eager._to_global(rows, mesh)
+    out = _presence_program(mesh)(arr)
+    jax.block_until_ready(out)
+    eager._coordination_fence(mesh)
+    return eager.one_row(out)
+
+
+def sync(ps) -> Optional[np.ndarray]:
+    """Called at the top of every public eager collective.
+
+    Returns ``None`` when no join handling applies (single process, no
+    coordination service, non-global process set, or this call is itself
+    a drain replay); otherwise runs a presence round and returns the
+    [n] 0/1 mask of active ranks.
+    """
+    from . import eager
+
+    if _replaying or _joined:
+        return None
+    if not ps.is_global() or client() is None:
+        return None
+    mesh = ps.flat_mesh()
+    if not eager._is_multiprocess(mesh):
+        return None
+    return presence_round(mesh, active=True)
+
+
+def publish(mesh, meta: dict) -> None:
+    """Publish an op's replay metadata at its fence sequence number.
+
+    EVERY active process publishes (SPMD -- they all dispatch the same op
+    with identical metadata), so overwriting is expected and benign.
+    """
+    from . import eager
+
+    procs = tuple(sorted({d.process_index for d in mesh.devices.flat}))
+    seq = eager._peek_next_seq(procs)
+    client().key_value_set(_op_key(seq), json.dumps(meta),
+                           allow_overwrite=True)
+
+
+def identity_value(op_value: str, dtype):
+    """The reduction identity a joined rank contributes."""
+    if op_value == "min":
+        return float(np.inf) if np.issubdtype(dtype, np.floating) \
+            else np.iinfo(dtype).max
+    if op_value == "max":
+        return float(-np.inf) if np.issubdtype(dtype, np.floating) \
+            else np.iinfo(dtype).min
+    if op_value == "product":
+        return 1
+    return 0  # sum / average / adasum / gathers / scatters
+
+
+def _replay(meta: dict) -> None:
+    """Re-issue the published collective with an identity payload."""
+    global _replaying
+    from . import eager
+    from .compression import Compression
+    from .reduce_op import ReduceOp
+
+    comps = {c.__name__: c for c in
+             (Compression.none, Compression.fp16, Compression.bf16)}
+    kind = meta["kind"]
+    name = meta.get("name")
+    _replaying = True
+    try:
+        if kind == "barrier":
+            eager.barrier()
+            return
+        shape = tuple(meta["shape"])
+        dtype = np.dtype(meta["dtype"])
+        k_local = eager.local_rank_count(None)
+        row = shape[1:]
+        if kind == "allreduce":
+            fill = identity_value(meta["op"], dtype)
+            x = np.full((k_local,) + row, fill, dtype)
+            eager.allreduce(x, ReduceOp(meta["op"]), name=name,
+                            prescale_factor=meta["pre"],
+                            postscale_factor=meta["post"],
+                            compression=comps[meta["compression"]])
+        elif kind == "broadcast":
+            eager.broadcast(np.zeros((k_local,) + row, dtype),
+                            meta["root"], name=name)
+        elif kind == "allgather":
+            eager.allgather(np.zeros((k_local,) + row, dtype), name=name)
+        elif kind == "reducescatter":
+            eager.reducescatter(np.zeros((k_local,) + row, dtype),
+                                ReduceOp(meta["op"]), name=name,
+                                _join_k=meta.get("jk"))
+        elif kind == "alltoall":
+            eager.alltoall(np.zeros((k_local,) + row, dtype), name=name)
+        else:  # pragma: no cover - forward compat
+            raise RuntimeError(f"unknown join replay kind {kind!r}")
+    finally:
+        _replaying = False
+
+
+def join_drain(mesh) -> int:
+    """The joined-rank loop: mirror every active dispatch with an identity
+    replay until everyone has joined; returns the last rank to join."""
+    global _gen, _joined
+    from . import eager
+
+    cl = client()
+    positions = eager._local_member_positions(_ps.get_process_set(None))
+    # Last KV writer ~ last joiner (every write happens before its
+    # writer's first inactive presence round, so all processes read the
+    # same settled value after the mask drains to zero).
+    cl.key_value_set(_last_key(), str(positions[0]), allow_overwrite=True)
+    procs = tuple(sorted({d.process_index for d in mesh.devices.flat}))
+    _joined = True
+    try:
+        while True:
+            mask = presence_round(mesh, active=False)
+            if int(mask.sum()) == 0:
+                break
+            seq = eager._peek_next_seq(procs)
+            raw = cl.blocking_key_value_get(_op_key(seq), _timeout_ms())
+            _replay(json.loads(raw))
+    finally:
+        _joined = False
+    last = int(cl.blocking_key_value_get(_last_key(), _timeout_ms()))
+    with _lock:
+        _gen += 1
+    return last

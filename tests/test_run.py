@@ -137,6 +137,27 @@ def test_ipv6_host_specs():
 
 
 @pytest.mark.integration
+@pytest.mark.parametrize("np_", [2, 3])
+def test_join_drains_stragglers(np_):
+    """Reference JoinOp behavior: ranks stop after different batch counts;
+    survivors' averages cover active ranks only; nobody deadlocks; join
+    returns the last rank to join (twice -- generations reset).  np=3
+    exercises concurrent metadata publishing by MULTIPLE active ranks
+    while one rank drains."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["HOROVOD_JOIN_TIMEOUT"] = "60"
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_), "--cpu",
+         sys.executable, os.path.join(REPO, "examples", "join_check.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    last = np_ - 1
+    assert f"rank 0: join OK last={last}" in out.stdout
+    assert f"rank {last}: allgatherv-during-join OK" in out.stdout
+    assert f"rank {last}: join2 OK last={last}" in out.stdout
+
+
 def test_launcher_dash_h_derives_np():
     """-H localhost:2 with no -np runs 2 workers end-to-end."""
     env = dict(os.environ)
