@@ -280,6 +280,21 @@ def _join_sync(ps, kind: str, x, name: Optional[str], extra: dict = None):
     return k, meta, mask
 
 
+def _join_abort(ps, message: str):
+    """Raise after a presence round without leaving drained ranks hanging.
+
+    A post-presence error on the active side must still publish SOMETHING
+    at the op's sequence slot -- drained ranks are already blocked on the
+    metadata key and would otherwise stall until HOROVOD_JOIN_TIMEOUT and
+    then desync.  Publish an abort record (they re-raise it) and raise
+    locally; every active rank does the same (SPMD), overwrites benign.
+    """
+    from . import joinop as _join
+    _join.publish(_ps.get_process_set(ps).flat_mesh(),
+                  {"kind": "abort", "message": message})
+    raise RuntimeError(message)
+
+
 def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
               process_set=None, prescale_factor: float = 1.0,
               postscale_factor: float = 1.0, compression=Compression.none):
@@ -291,9 +306,9 @@ def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
             # JoinOp behavior): the traced op divides by the full size n,
             # so rescale by n/k.  Ill-defined for truncating int division.
             if np.issubdtype(np.asarray(x).dtype, np.integer):
-                raise NotImplementedError(
-                    "integer-dtype Average while ranks are joined is "
-                    "unsupported (truncating rescale is ill-defined)")
+                _join_abort(ps, "integer-dtype Average while ranks are "
+                                "joined is unsupported (truncating rescale "
+                                "is ill-defined)")
             postscale_factor *= ps.size() / k
         jmeta.update(op=str(op), pre=prescale_factor,
                      post=postscale_factor,
@@ -455,9 +470,8 @@ def broadcast(x, root_rank: int = 0, *, name=None, process_set=None):
     if jmeta is not None and not mask[root_rank]:
         # A drained root would replay zeros; error like the reference (a
         # joined rank cannot be the source of new data).
-        raise RuntimeError(
-            f"broadcast root_rank {root_rank} has joined and cannot "
-            "source a broadcast")
+        _join_abort(ps, f"broadcast root_rank {root_rank} has joined and "
+                        "cannot source a broadcast")
 
     def per_rank(t):
         return _ops.broadcast(t, root_pos, axes=(HVD_AXIS,))
@@ -475,9 +489,8 @@ def reducescatter(x, op: ReduceOp = Average, *, name=None, process_set=None,
         if jmeta is not None:
             if op is Average:
                 if np.issubdtype(np.asarray(x).dtype, np.integer):
-                    raise NotImplementedError(
-                        "integer-dtype Average while ranks are joined is "
-                        "unsupported")
+                    _join_abort(ps, "integer-dtype Average while ranks "
+                                    "are joined is unsupported")
                 _join_k = k
             jmeta.update(op=str(op), jk=_join_k)
     else:

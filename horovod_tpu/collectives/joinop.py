@@ -180,6 +180,13 @@ def _replay(meta: dict) -> None:
     name = meta.get("name")
     _replaying = True
     try:
+        if kind == "abort":
+            # An active rank hit an error AFTER its presence round (e.g.
+            # broadcast from a joined root): it published this instead of
+            # op metadata so drained ranks fail cleanly rather than
+            # blocking on a collective that will never be dispatched.
+            raise RuntimeError(
+                f"collective aborted during join phase: {meta['message']}")
         if kind == "barrier":
             eager.barrier()
             return
@@ -221,8 +228,9 @@ def join_drain(mesh) -> int:
     positions = eager._local_member_positions(_ps.get_process_set(None))
     # Last KV writer ~ last joiner (every write happens before its
     # writer's first inactive presence round, so all processes read the
-    # same settled value after the mask drains to zero).
-    cl.key_value_set(_last_key(), str(positions[0]), allow_overwrite=True)
+    # same settled value after the mask drains to zero).  A process's
+    # ranks join together; report its highest (reference "last rank").
+    cl.key_value_set(_last_key(), str(positions[-1]), allow_overwrite=True)
     procs = tuple(sorted({d.process_index for d in mesh.devices.flat}))
     _joined = True
     try:

@@ -17,9 +17,10 @@ their respective API shims so reference users can keep their model
 objects.
 
 Input flexibility: ``fit`` accepts a dict of numpy arrays, a pandas
-DataFrame + ``feature_cols``/``label_cols``, or a pyspark DataFrame
-(collected on the driver; Petastorm-scale out-of-core feeds are out of
-scope).
+DataFrame + ``feature_cols``/``label_cols``, a pyspark DataFrame
+(partitions STREAM to Store chunks through ``toLocalIterator`` -- the
+driver never materializes the dataset, the Petastorm-scale path), or any
+iterator/generator of such items (each item becomes one streamed chunk).
 """
 
 from __future__ import annotations
@@ -69,33 +70,194 @@ def _as_arrays(df, feature_cols, label_cols) -> Dict[str, np.ndarray]:
     raise TypeError(f"unsupported data input: {type(df).__name__}")
 
 
-def _write_shards(store: Store, data: Dict[str, np.ndarray], num_proc: int,
-                  val_fraction: float) -> int:
-    """Rank-shard the arrays into the store's intermediate layout.
+_CHUNK_ROWS = 65536   # flush threshold: the driver buffers at most this
+                      # many rows per rank (+ one validation buffer)
+_HASH_MULT = np.uint64(2654435761)  # Knuth multiplicative hash
+_HASH_MASK = np.uint64(0xFFFFFFFF)
 
-    Returns the number of validation rows held out (from the tail).
+
+def _iter_chunks(df, feature_cols, label_cols, chunk_rows: int = _CHUNK_ROWS):
+    """Yield normalized ``{'features','labels'}`` chunks WITHOUT
+    materializing the dataset on the driver.
+
+    The reference feeds workers from Petastorm shards written partition by
+    partition (SURVEY.md 3.6); here the equivalents are:
+
+    * pyspark DataFrame: rows stream through ``toLocalIterator()`` (the
+      driver holds one partition at a time), buffered to ``chunk_rows``;
+    * a generator/iterator of any supported item (dict of arrays,
+      ``(x, y)`` tuple, pandas frame): each item is one chunk;
+    * anything else (in-memory arrays / frames): one chunk -- the local
+      fallback.
     """
-    n = len(data["features"])
-    n_val = int(n * val_fraction)
-    n_train = n - n_val
-    if n_train < num_proc:
-        raise ValueError(f"{n_train} training rows < num_proc={num_proc}")
-    # Equal shard sizes are a CORRECTNESS requirement, not just balance:
-    # each worker's step count derives from its shard length, and a worker
-    # running one extra step would enter a collective its peers never join.
-    n_train = (n_train // num_proc) * num_proc
-    for rank in range(num_proc):
-        sl = slice(rank, n_train, num_proc)  # strided: balanced + shuffled-ish
+    import itertools
+
+    if hasattr(df, "toLocalIterator") and hasattr(df, "sparkSession"):
+        rows = df.toLocalIterator()
+        while True:
+            buf = list(itertools.islice(rows, chunk_rows))
+            if not buf:
+                return
+            import pandas as pd
+            pdf = pd.DataFrame([r.asDict() for r in buf])
+            yield _as_arrays(pdf, feature_cols, label_cols)
+    elif hasattr(df, "__next__") or (
+            hasattr(df, "__iter__")
+            and not isinstance(df, (dict, tuple, list))
+            and not hasattr(df, "shape") and not hasattr(df, "columns")):
+        for item in df:
+            yield _as_arrays(item, feature_cols, label_cols)
+    else:
+        yield _as_arrays(df, feature_cols, label_cols)
+
+
+class _ShardWriter:
+    """Streams row chunks into equal-length per-rank Store shards.
+
+    Rows are assigned round-robin by train ordinal (balanced +
+    shuffled-ish, like the old strided slice); every ``flush_rows`` rows a
+    rank's buffer is written out as ``<train_path>.chunkNNNNN``, so driver
+    memory is bounded by ``num_proc * flush_rows`` rows regardless of
+    dataset size.  Validation rows are selected deterministically by a
+    multiplicative hash of the global row index compared against the
+    fraction -- any fraction is honored to ~1/2^32 resolution without
+    driver-side shuffling or RNG state.
+
+    Equal shard sizes are a CORRECTNESS requirement, not just balance:
+    each worker's step count derives from its shard length, and a worker
+    running one extra step would enter a collective its peers never join;
+    :meth:`finish` trims the ragged tail (rewriting a flushed last chunk
+    when necessary).
+    """
+
+    def __init__(self, store: Store, num_proc: int, val_fraction: float,
+                 flush_rows: int = _CHUNK_ROWS):
+        self.store = store
+        self.num_proc = num_proc
+        self.val_threshold = np.uint64(int(val_fraction * float(2 ** 32)))
+        self.flush_rows = flush_rows
+        self.seen = 0        # total rows
+        self.train_seen = 0  # train ordinals handed out
+        self.bufs = [{"features": [], "labels": []} for _ in range(num_proc)]
+        self.buf_rows = [0] * num_proc
+        self.chunk_seq = [0] * num_proc
+        self.val_buf = {"features": [], "labels": []}
+        self.val_rows = 0
+        self.val_seq = 0
+        self.written = [0] * num_proc  # rows flushed per rank
+
+    def add(self, chunk: Dict[str, np.ndarray]) -> None:
+        feats = np.asarray(chunk["features"])
+        labels = np.asarray(chunk["labels"])
+        n = len(feats)
+        if len(labels) != n:
+            raise ValueError(f"features ({n}) and labels ({len(labels)}) "
+                             "row counts differ")
+        idx = np.arange(self.seen, self.seen + n, dtype=np.uint64)
+        val_mask = ((idx * _HASH_MULT) & _HASH_MASK) < self.val_threshold
+        self.seen += n
+        if val_mask.any():
+            self.val_buf["features"].append(feats[val_mask])
+            self.val_buf["labels"].append(labels[val_mask])
+            self.val_rows += int(val_mask.sum())
+            if self.val_rows >= self.flush_rows:
+                self._flush_val()
+        tf_, tl = feats[~val_mask], labels[~val_mask]
+        nt = len(tf_)
+        ranks = (self.train_seen + np.arange(nt)) % self.num_proc
+        self.train_seen += nt
+        for r in range(self.num_proc):
+            sel = ranks == r
+            if not sel.any():
+                continue
+            self.bufs[r]["features"].append(tf_[sel])
+            self.bufs[r]["labels"].append(tl[sel])
+            self.buf_rows[r] += int(sel.sum())
+            if self.buf_rows[r] >= self.flush_rows:
+                self._flush_rank(r)
+
+    def _write_npz(self, path: str, feats, labels) -> None:
         buf = io.BytesIO()
-        np.savez(buf, features=data["features"][sl],
-                 labels=data["labels"][sl])
-        store.write(store.get_train_data_path(rank), buf.getvalue())
-    if n_val:
-        buf = io.BytesIO()
-        np.savez(buf, features=data["features"][n_train:],
-                 labels=data["labels"][n_train:])
-        store.write(store.get_val_data_path(), buf.getvalue())
-    return n_val
+        np.savez(buf, features=feats, labels=labels)
+        self.store.write(path, buf.getvalue())
+
+    def _flush_rank(self, r: int) -> None:
+        if not self.buf_rows[r]:
+            return
+        path = (f"{self.store.get_train_data_path(r)}"
+                f".chunk{self.chunk_seq[r]:05d}")
+        self._write_npz(path, np.concatenate(self.bufs[r]["features"]),
+                        np.concatenate(self.bufs[r]["labels"]))
+        self.written[r] += self.buf_rows[r]
+        self.chunk_seq[r] += 1
+        self.bufs[r] = {"features": [], "labels": []}
+        self.buf_rows[r] = 0
+
+    def _flush_val(self) -> None:
+        if not self.val_rows:
+            return
+        path = f"{self.store.get_val_data_path()}.chunk{self.val_seq:05d}"
+        self._write_npz(path, np.concatenate(self.val_buf["features"]),
+                        np.concatenate(self.val_buf["labels"]))
+        self.val_seq += 1
+        self.val_buf = {"features": [], "labels": []}
+        self.val_rows = 0
+
+    def finish(self) -> int:
+        """Equalize shard lengths, flush remainders; returns val rows."""
+        if self.train_seen < self.num_proc:
+            raise ValueError(f"{self.train_seen} training rows < "
+                             f"num_proc={self.num_proc}")
+        target = self.train_seen // self.num_proc
+        for r in range(self.num_proc):
+            extra = self.written[r] + self.buf_rows[r] - target
+            assert 0 <= extra <= 1, (r, extra)  # round-robin invariant
+            if extra:
+                if self.buf_rows[r]:
+                    self.bufs[r]["features"][-1] = \
+                        self.bufs[r]["features"][-1][:-1]
+                    self.bufs[r]["labels"][-1] = \
+                        self.bufs[r]["labels"][-1][:-1]
+                    self.buf_rows[r] -= 1
+                else:
+                    # The extra row is already on disk: trim the last chunk.
+                    path = (f"{self.store.get_train_data_path(r)}"
+                            f".chunk{self.chunk_seq[r] - 1:05d}")
+                    with np.load(io.BytesIO(self.store.read(path)),
+                                 allow_pickle=False) as z:
+                        self._write_npz(path, z["features"][:-1],
+                                        z["labels"][:-1])
+                    self.written[r] -= 1
+            self._flush_rank(r)
+        total_val = self.seen - self.train_seen
+        self._flush_val()
+        return total_val
+
+
+def _clean_intermediate(store: Store, num_proc: int) -> None:
+    """Remove stale chunk files from a previous fit on the same store."""
+    for r in range(num_proc):
+        for p in store.list_prefix(f"{store.get_train_data_path(r)}.chunk"):
+            store.delete(p)
+        if store.exists(store.get_train_data_path(r)):
+            store.delete(store.get_train_data_path(r))
+    for p in store.list_prefix(f"{store.get_val_data_path()}.chunk"):
+        store.delete(p)
+    if store.exists(store.get_val_data_path()):
+        store.delete(store.get_val_data_path())
+
+
+def _write_shards(store: Store, chunks, num_proc: int,
+                  val_fraction: float) -> int:
+    """Stream chunks into the store's rank-sharded intermediate layout.
+
+    Returns the number of validation rows held out.
+    """
+    _clean_intermediate(store, num_proc)
+    w = _ShardWriter(store, num_proc, val_fraction)
+    for chunk in chunks:
+        w.add(chunk)
+    return w.finish()
 
 
 def _orderly_teardown(hvd) -> None:
@@ -116,9 +278,56 @@ def _orderly_teardown(hvd) -> None:
     hvd.shutdown()
 
 
-def _load_shard(path: str) -> Dict[str, np.ndarray]:
-    with np.load(path, allow_pickle=False) as z:
-        return {"features": z["features"], "labels": z["labels"]}
+def _shard_chunk_paths(store: Store, base: str) -> List[str]:
+    paths = store.list_prefix(f"{base}.chunk")
+    if not paths:
+        if not store.exists(base):
+            raise FileNotFoundError(f"no shard data under {base}")
+        paths = [base]
+    return paths
+
+
+def _load_shard(store: Store, base: str) -> Dict[str, np.ndarray]:
+    """Load one rank's WHOLE shard into memory (chunked layout or a bare
+    ``<base>`` file).  Used by the torch/keras workers, whose training
+    loops index the shard randomly; the JAX worker streams batches through
+    :func:`_iter_shard_batches` instead and stays out-of-core end to end."""
+    feats, labels = [], []
+    for p in _shard_chunk_paths(store, base):
+        with np.load(io.BytesIO(store.read(p)), allow_pickle=False) as z:
+            feats.append(z["features"])
+            labels.append(z["labels"])
+    return {"features": np.concatenate(feats),
+            "labels": np.concatenate(labels)}
+
+
+def _shard_row_count(store: Store, base: str) -> int:
+    total = 0
+    for p in _shard_chunk_paths(store, base):
+        with np.load(io.BytesIO(store.read(p)), allow_pickle=False) as z:
+            total += int(z["labels"].shape[0])
+    return total
+
+
+def _iter_shard_batches(store: Store, base: str, bs: int):
+    """Stream ``(features, labels)`` batches of exactly ``bs`` rows from a
+    chunked shard, holding at most one chunk + ``bs`` rows in memory.
+
+    The tail (< bs rows) is dropped; equal shard lengths make the drop
+    identical across ranks, keeping collective step counts aligned.
+    """
+    fb, lb, have = [], [], 0
+    for p in _shard_chunk_paths(store, base):
+        with np.load(io.BytesIO(store.read(p)), allow_pickle=False) as z:
+            fb.append(z["features"])
+            lb.append(z["labels"])
+            have += len(lb[-1])
+        if have >= bs:
+            f, l = np.concatenate(fb), np.concatenate(lb)
+            n_full = (have // bs) * bs
+            for i in range(0, n_full, bs):
+                yield f[i:i + bs], l[i:i + bs]
+            fb, lb, have = [f[n_full:]], [l[n_full:]], have - n_full
 
 
 # ---------------------------------------------------------------------------
@@ -154,8 +363,8 @@ class _EstimatorBase:
         store = p.store or LocalStore(os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "hvd_tpu_estimator"))
         run_id = p.run_id or f"run_{uuid.uuid4().hex[:8]}"
-        data = _as_arrays(df, p.feature_cols, p.label_cols)
-        _write_shards(store, data, p.num_proc, p.validation)
+        chunks = _iter_chunks(df, p.feature_cols, p.label_cols)
+        _write_shards(store, chunks, p.num_proc, p.validation)
         spec = dict(self._make_worker_spec(),
                     store_prefix=store.prefix_path,
                     run_id=run_id, num_proc=p.num_proc,
@@ -188,7 +397,10 @@ def _jax_worker(spec) -> List[float]:
     Rides the standard machinery end-to-end: ``DistributedOptimizer``
     (fused psum), ``make_flax_train_step`` (BN stat sync), and
     ``shard_batch_from_local`` (each rank feeds its own shard, the
-    reference's per-rank reader model).
+    reference's per-rank reader model).  Batches STREAM from the chunked
+    shard (one chunk in memory at a time) -- with the driver-side
+    streamed materialization this keeps the whole path out-of-core, the
+    Petastorm-equivalent property.
     """
     import jax
     import jax.numpy as jnp
@@ -198,13 +410,15 @@ def _jax_worker(spec) -> List[float]:
 
     hvd.init()
     store = LocalStore(spec["store_prefix"])
-    shard = _load_shard(store.get_train_data_path(hvd.rank()))
+    base = store.get_train_data_path(hvd.rank())
+    n = _shard_row_count(store, base)
     model = pickle.loads(spec["model"])
     opt = hvd.DistributedOptimizer(
         optax.adam(spec["lr"]) if spec["opt"] == "adam"
         else optax.sgd(spec["lr"], momentum=0.9))
 
-    x0 = jnp.asarray(shard["features"][:1], jnp.float32)
+    x1, _y1 = next(_iter_shard_batches(store, base, 1))
+    x0 = jnp.asarray(x1, jnp.float32)
     # PRNGKey(0) init is deterministic, so every rank starts from identical
     # params (the broadcast_parameters step is a no-op by construction).
     variables = model.init(jax.random.PRNGKey(0), x0, train=False)
@@ -226,15 +440,13 @@ def _jax_worker(spec) -> List[float]:
     from ..training import make_flax_train_step
     step = make_flax_train_step(model.apply, opt, loss_fn=loss_fn)
 
-    n = len(shard["features"])
     bs = max(1, min(spec["batch_size"], n))
     history = []
     for _ in range(spec["epochs"]):
         ep = []
-        for i in range(0, n - bs + 1, bs):
+        for xb, yb in _iter_shard_batches(store, base, bs):
             batch = hvd.shard_batch_from_local(
-                (np.asarray(shard["features"][i:i + bs], np.float32),
-                 np.asarray(shard["labels"][i:i + bs], label_dtype)))
+                (np.asarray(xb, np.float32), np.asarray(yb, label_dtype)))
             params, stats, opt_state, loss = step(params, stats, opt_state,
                                                   batch)
             ep.append(float(loss))
@@ -350,7 +562,7 @@ def _run_torch_training(spec, make_optimizer, compute_loss,
 
     hvd.init()
     store = LocalStore(spec["store_prefix"])
-    shard = _load_shard(store.get_train_data_path(hvd.rank()))
+    shard = _load_shard(store, store.get_train_data_path(hvd.rank()))
     model = pickle.loads(spec["model"])
     model.train()
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
@@ -526,7 +738,7 @@ def _keras_worker(spec) -> List[float]:
 
     hvd.init()
     store = LocalStore(spec["store_prefix"])
-    shard = _load_shard(store.get_train_data_path(hvd.rank()))
+    shard = _load_shard(store, store.get_train_data_path(hvd.rank()))
     model = tf.keras.models.model_from_json(spec["model_json"])
     weights = pickle.loads(spec["weights"])
     if weights is not None:

@@ -51,6 +51,12 @@ class Store:
     def delete(self, path: str) -> None:
         raise NotImplementedError
 
+    def list_prefix(self, prefix: str):
+        """Paths in the store starting with ``prefix``, sorted.  Used by the
+        chunked intermediate-data layout (shards stream in as
+        ``<base>.chunk00000``, ``.chunk00001``, ...)."""
+        raise NotImplementedError
+
     @classmethod
     def create(cls, prefix_path: str) -> "Store":
         """Pick a store flavour from the path scheme (reference
@@ -86,6 +92,10 @@ class LocalStore(Store):
             shutil.rmtree(path)
         elif os.path.exists(path):
             os.remove(path)
+
+    def list_prefix(self, prefix: str):
+        import glob
+        return sorted(glob.glob(glob.escape(prefix) + "*"))
 
 
 class HDFSStore(Store):

@@ -169,15 +169,81 @@ def test_estimator_data_normalization():
 
 def test_write_shards_equal_sizes(tmp_path):
     from horovod_tpu.spark import LocalStore
-    from horovod_tpu.spark.estimator import _load_shard, _write_shards
+    from horovod_tpu.spark.estimator import (_iter_chunks, _load_shard,
+                                             _write_shards)
     x, y = _blobs(n=11)
     store = LocalStore(str(tmp_path))
-    _write_shards(store, {"features": x, "labels": y}, 2, 0.0)
-    s0 = _load_shard(store.get_train_data_path(0))
-    s1 = _load_shard(store.get_train_data_path(1))
+    _write_shards(store, _iter_chunks({"features": x, "labels": y},
+                                      None, None), 2, 0.0)
+    s0 = _load_shard(store, store.get_train_data_path(0))
+    s1 = _load_shard(store, store.get_train_data_path(1))
     # Equal shard sizes even when rows don't divide evenly (collective
     # step-count alignment).
     assert len(s0["features"]) == len(s1["features"]) == 5
+
+
+def test_write_shards_streams_without_materializing(tmp_path):
+    """SURVEY.md 3.6 (Petastorm-scale feeds): a multi-chunk source streams
+    to Store shards with bounded driver memory -- no chunk ever holds the
+    dataset, shards stay equal-length, and every row lands exactly once."""
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.estimator import (_ShardWriter, _iter_chunks,
+                                             _load_shard)
+
+    n_chunks, rows_per_chunk, num_proc = 13, 7, 3
+    total = n_chunks * rows_per_chunk  # 91
+
+    def source():
+        for c in range(n_chunks):
+            base = c * rows_per_chunk
+            feats = np.arange(base, base + rows_per_chunk,
+                              dtype=np.float32)[:, None] * [1.0, 10.0]
+            labels = np.arange(base, base + rows_per_chunk, dtype=np.int32)
+            yield {"features": feats, "labels": labels}
+
+    store = LocalStore(str(tmp_path))
+    w = _ShardWriter(store, num_proc, val_fraction=0.0, flush_rows=10)
+    peak = 0
+    for chunk in _iter_chunks(source(), None, None):
+        w.add(chunk)
+        peak = max(peak, sum(w.buf_rows) + w.val_rows)
+    assert w.finish() == 0
+    # Bounded buffering: never anywhere near the full dataset.
+    assert peak < num_proc * 10 + rows_per_chunk, peak
+    # Multiple chunk files per rank actually got written.
+    assert all(len(store.list_prefix(
+        f"{store.get_train_data_path(r)}.chunk")) > 1
+        for r in range(num_proc))
+    shards = [_load_shard(store, store.get_train_data_path(r))
+              for r in range(num_proc)]
+    target = total // num_proc  # 30 (1 ragged row trimmed)
+    assert all(len(s["features"]) == target for s in shards)
+    got = np.sort(np.concatenate([s["labels"] for s in shards]))
+    # Every kept row appears exactly once, in round-robin assignment.
+    assert len(got) == target * num_proc
+    assert len(np.unique(got)) == len(got)
+
+
+def test_write_shards_validation_stripe(tmp_path):
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.estimator import (_iter_chunks, _load_shard,
+                                             _write_shards)
+    x = np.arange(2000, dtype=np.float32)[:, None]
+    y = np.arange(2000, dtype=np.int32)
+    store = LocalStore(str(tmp_path))
+    n_val = _write_shards(store, _iter_chunks((x, y), None, None), 2, 0.1)
+    # Hash-based selection: ~10% of 2000 rows (deterministic, not exact).
+    assert 140 <= n_val <= 260, n_val
+    val = _load_shard(store, store.get_val_data_path())
+    assert len(val["features"]) == n_val
+    train = [_load_shard(store, store.get_train_data_path(r))
+             for r in range(2)]
+    n_train = (2000 - n_val) // 2
+    assert len(train[0]["features"]) == len(train[1]["features"]) == n_train
+    # No row is in both train and val.
+    overlap = set(val["labels"].tolist()) & set(
+        np.concatenate([t["labels"] for t in train]).tolist())
+    assert not overlap
 
 
 @pytest.mark.integration

@@ -158,6 +158,7 @@ def test_join_drains_stragglers(np_):
     assert f"rank {last}: join2 OK last={last}" in out.stdout
 
 
+@pytest.mark.integration
 def test_launcher_dash_h_derives_np():
     """-H localhost:2 with no -np runs 2 workers end-to-end."""
     env = dict(os.environ)
