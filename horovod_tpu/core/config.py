@@ -118,6 +118,13 @@ class Config:
     # --cpu test mode; the Gloo-CPU-backend analogue).
     force_cpu: bool = False
 
+    # Persistent XLA compilation cache directory (HOROVOD_COMPILE_CACHE /
+    # HVD_TPU_COMPILE_CACHE).  Big-model compiles through the tunnelled
+    # runtime take tens of minutes (BERT-Large: ~35 min); the cache pays
+    # them once per program fingerprint.  No reference equivalent (CUDA
+    # kernels ship precompiled); on TPU it is table stakes.
+    compile_cache: Optional[str] = None
+
 
 def load_config() -> Config:
     """Parse the environment into a :class:`Config`."""
@@ -150,6 +157,7 @@ def load_config() -> Config:
         env_cross_size=_env_int("CROSS_SIZE", -1),
         coordinator_addr=addr,
         coordinator_port=port,
+        compile_cache=_env("COMPILE_CACHE"),
         check_desync=_env_bool("CHECK_DESYNC"),
         desync_max_retries=_env_int("DESYNC_MAX_RETRIES", 3),
         heartbeat_timeout=_env_float("HEARTBEAT_TIMEOUT", 0.0),

@@ -20,6 +20,33 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+def space_to_depth(x, block: int = 2):
+    """NHWC space-to-depth: ``[N,H,W,C] -> [N,H/b,W/b,b*b*C]`` with the
+    (dy, dx, c) intra-block order the stem-kernel transform assumes."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, h // block, w // block, block * block * c)
+
+
+def s2d_conv_init_kernel(k7):
+    """Transform a standard ``[7,7,C,F]`` stem kernel into the equivalent
+    ``[4,4,4C,F]`` space-to-depth kernel.
+
+    The 7x7 stride-2 SAME conv on ``[N,224,224,C]`` equals a 4x4 stride-1
+    SAME conv on the 2x2 space-to-depth input: pad the kernel to 8x8 on the
+    bottom/right (those taps hit rows the 7-tap window never covers) and
+    fold each 2x2 tap block into the channel dim.  This is the MLPerf-style
+    TPU stem optimization -- a 3-channel 7x7 conv underutilizes the MXU's
+    128 input lanes, while the folded 12-channel 4x4 tiles it 4x better.
+    Exactness is verified by ``test_space_to_depth_stem_parity``.
+    """
+    k8 = jnp.pad(k7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    c, f = k7.shape[2], k7.shape[3]
+    k = k8.reshape(4, 2, 4, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return k.reshape(4, 4, 4 * c, f)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int]
@@ -73,6 +100,12 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # MLPerf-style stem: fold the input's 2x2 spatial blocks into channels
+    # and replace the 7x7/2 conv with the equivalent 4x4/1 (the 3-channel
+    # 7x7 wastes the MXU's input lanes).  The ``conv_init`` kernel then has
+    # the s2d layout [4,4,4C,F]; ``s2d_conv_init_kernel`` converts standard
+    # checkpoints.  Mathematically identical output -- see its docstring.
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -81,7 +114,11 @@ class ResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.space_to_depth:
+            x = space_to_depth(x, 2)
+            x = conv(self.num_filters, (4, 4), (1, 1), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -48,7 +48,11 @@ def _to_stack(t) -> np.ndarray:
 
 
 def _from_row(out, like) -> tf.Tensor:
-    return tf.convert_to_tensor(_eager.one_row(out), dtype=like.dtype if
+    if isinstance(out, np.ndarray):       # host-fetched (grouped to_host)
+        row = out[0]
+    else:
+        row = _eager.one_row(out)
+    return tf.convert_to_tensor(row, dtype=like.dtype if
                                 hasattr(like, "dtype") else None)
 
 
@@ -78,7 +82,8 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
         def _reduce(*ts):
             outs = _eager.grouped_allreduce([_to_stack(t) for t in ts], op,
                                             name=name,
-                                            process_set=process_set)
+                                            process_set=process_set,
+                                            to_host=True)
             return [_from_row(o, t) for o, t in zip(outs, ts)]
 
         reduced = tf.py_function(_reduce, tensors,
@@ -87,7 +92,8 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
             r.set_shape(t.shape)
         return reduced
     outs = _eager.grouped_allreduce([_to_stack(t) for t in tensors], op,
-                                    name=name, process_set=process_set)
+                                    name=name, process_set=process_set,
+                                    to_host=True)
     return [_from_row(o, t) for o, t in zip(outs, tensors)]
 
 
@@ -140,11 +146,19 @@ def join() -> int:
 
 def broadcast_variables(variables, root_rank: int = 0,
                         process_set=None) -> None:
-    """Assign every variable its root-rank value (``hvd.broadcast_variables``)."""
-    for v in variables:
-        v.assign(broadcast(v, root_rank,
-                           name=f"broadcast.{getattr(v, 'name', 'var')}",
-                           process_set=process_set))
+    """Assign every variable its root-rank value (``hvd.broadcast_variables``).
+
+    Variables are FUSED per dtype into one flat buffer and broadcast with
+    a single collective per dtype: a per-variable loop would compile one
+    XLA program per distinct shape (minutes of tunnel compile time for a
+    real model) and pay one staging round-trip each.
+    """
+    variables = list(variables)
+    rows = _eager.broadcast_fused([np.asarray(v) for v in variables],
+                                  root_rank, name="broadcast.vars",
+                                  process_set=process_set)
+    for v, row in zip(variables, rows):
+        v.assign(tf.convert_to_tensor(row, dtype=v.dtype))
 
 
 def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):

@@ -113,10 +113,23 @@ def batcher() -> Optional[GradBatcher]:
         stall = 0.0 if cfg.stall_check_disable else cfg.stall_check_time
         # Multi-controller SPMD: every process must cut identical fused
         # batches (they jointly launch each XLA program), so the scheduler
-        # runs in deterministic mode -- dispatch only at synchronize()
-        # flush points, name-sorted grouping.
+        # runs in deterministic mode UNCONDITIONALLY there -- it is a
+        # correctness requirement, not a knob.  Also deterministic on
+        # accelerator backends even single-process: timing-based cutting
+        # produces DIFFERENT fused shapes each cycle, and every new shape
+        # is a fresh XLA compile -- seconds per step on the tunnelled TPU
+        # vs. ms on CPU.  HOROVOD_DETERMINISTIC=0/1 overrides only the
+        # single-process backend heuristic.
+        import os
+
         import jax
-        deterministic = jax.process_count() > 1
+        from ..core.config import _env_bool
+        if ("HOROVOD_DETERMINISTIC" in os.environ
+                or "HVD_TPU_DETERMINISTIC" in os.environ):
+            single_proc_det = _env_bool("DETERMINISTIC", False)
+        else:
+            single_proc_det = jax.default_backend() != "cpu"
+        deterministic = jax.process_count() > 1 or single_proc_det
         _batcher = GradBatcher(cycle_ms, cfg.fusion_threshold, stall,
                                deterministic=deterministic)
         atexit.register(shutdown_batcher)

@@ -67,6 +67,31 @@ def test_flax_step_on_hierarchical_mesh(n_devices):
     hv.shutdown()
 
 
+def test_space_to_depth_stem_parity(hvd):
+    """The s2d stem is EXACTLY the standard 7x7/2 stem: transform a
+    standard conv_init kernel with s2d_conv_init_kernel and the two models
+    must agree to float tolerance on random input."""
+    from horovod_tpu.models.resnet import s2d_conv_init_kernel
+
+    kw = dict(stage_sizes=[1, 1], block_cls=BottleneckBlock, num_classes=5,
+              num_filters=8, dtype=jnp.float32)
+    std = ResNet(**kw)
+    s2d = ResNet(space_to_depth=True, **kw)
+    rng = np.random.RandomState(0)
+    # 32x32 input: any even spatial size works.
+    x = jnp.asarray(rng.randn(2, 32, 32, 3), jnp.float32)
+    v_std = std.init(jax.random.PRNGKey(1), x, train=False)
+    params = jax.tree.map(lambda a: a, v_std["params"])
+    params["conv_init"] = {
+        "kernel": s2d_conv_init_kernel(v_std["params"]["conv_init"]["kernel"])}
+    out_std = std.apply(v_std, x, train=False)
+    out_s2d = s2d.apply({"params": params,
+                         "batch_stats": v_std["batch_stats"]}, x,
+                        train=False)
+    np.testing.assert_allclose(np.asarray(out_s2d), np.asarray(out_std),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_inception_v3_forward(hvd):
     from horovod_tpu.models import InceptionV3
     model = InceptionV3(num_classes=10, dtype=jnp.float32)
