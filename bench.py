@@ -107,7 +107,7 @@ def main():
               file=sys.stderr)
     mfu = ips * FLOPS_PER_IMAGE / V5E_BF16_PEAK
     print(f"# batch {BATCH}/chip, {WINDOWS}x{STEPS}-step windows: "
-          f"{[round(r, 1) for r in rates]} img/s/chip "
+          f"{rates.round(1).tolist()} img/s/chip "
           f"(std {rates.std():.1f}); grad payload "
           f"{grad_bytes/2**20:.1f} MiB/step; "
           f"~{ips*FLOPS_PER_IMAGE/1e12:.1f} TFLOP/s "

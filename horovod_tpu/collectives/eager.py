@@ -353,7 +353,8 @@ def grouped_allreduce(xs: Sequence, op: ReduceOp = Average, *, name=None,
     the framework-shim path, where slicing the fused device array per
     tensor would cost one device->host round-trip each.
     """
-    if not len(xs):
+    xs = list(xs)
+    if not xs:
         return []
     reds, spec = _grouped_allreduce_buckets(
         xs, op, name=name, process_set=process_set, compression=compression)

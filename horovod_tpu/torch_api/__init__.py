@@ -300,7 +300,8 @@ class _HandleTable:
     """HandleManager analogue for the torch surface."""
 
     def __init__(self):
-        self._entries: Dict[int, Tuple[Any, torch.Tensor, bool]] = {}
+        # (out, like, inplace, assemble) -- see alloc().
+        self._entries: Dict[int, Tuple[Any, Any, bool, Any]] = {}
 
     def alloc(self, out, like: torch.Tensor, inplace: bool,
               assemble=None) -> int:

@@ -345,6 +345,25 @@ def test_in_step_process_set_collectives(hvd, n_devices):
         hv.remove_process_set("instep")
 
 
+def test_broadcast_fused_process_set(hvd, n_devices):
+    """broadcast_fused must size its rank stack for the PROCESS SET, not
+    the global set (regression: the pre-unification torch/tf copies
+    stacked for the global set and crashed on subset sets)."""
+    from horovod_tpu.collectives.eager import broadcast_fused
+
+    ps = hv.add_process_set([0, 2], name="bfps")
+    try:
+        arrs = [np.full((3,), 7.0, np.float32),
+                np.arange(4, dtype=np.int32),
+                np.ones((2, 2), np.float32)]
+        rows = broadcast_fused(arrs, root_rank=2, process_set=ps)
+        for a, r in zip(arrs, rows):
+            assert r.shape == a.shape and r.dtype == a.dtype
+            np.testing.assert_array_equal(a, r)
+    finally:
+        hv.remove_process_set("bfps")
+
+
 def test_process_set_registry(hvd, n_devices):
     ps = hv.add_process_set([0, 1], name="pair")
     assert "pair" in hv.process_set_names()
