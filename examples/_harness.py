@@ -44,8 +44,11 @@ def timed_training(step, params, opt_state, data, steps: int,
     dt = time.perf_counter() - t0
     if rank == 0:
         import horovod_tpu as hvd
+        # Step indices count TRUE optimizer updates (compile + 5 warm
+        # steps precede the timed window), so loss-at-step-N stays
+        # comparable across configs.
         for i in range(0, steps, 10):
-            print(f"step {i:4d} loss {float(losses[i]):.4f}")
+            print(f"step {i + 6:4d} loss {float(losses[i]):.4f}")
         rate = steps * items_per_step / dt
         print(f"{rate:.1f} {unit}/s ({rate / hvd.size():.1f}/chip), "
               f"final loss {float(losses[-1]):.4f}")

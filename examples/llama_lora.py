@@ -27,6 +27,8 @@ def main():
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--rank", type=int, default=8, help="LoRA rank")
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--1b", dest="mid", action="store_true",
+                   help="~0.9B single-chip config")
     p.add_argument("--8b", dest="full", action="store_true",
                    help="real Llama-3 8B (needs TPU HBM)")
     p.add_argument("--cpu-devices", type=int, default=0)
@@ -38,10 +40,12 @@ def main():
     import numpy as np
     import optax
     import horovod_tpu as hvd
-    from horovod_tpu.models import LLAMA3_8B, LLAMA_TINY, LlamaLM, lora_mask
+    from horovod_tpu.models import (LLAMA3_8B, LLAMA_1B, LLAMA_TINY,
+                                    LlamaLM, lora_mask)
 
     hvd.init()
-    cfg = LLAMA3_8B if args.full else LLAMA_TINY
+    cfg = LLAMA3_8B if args.full else (
+        LLAMA_1B if args.mid else LLAMA_TINY)
     dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" \
         else jnp.float32
     model = LlamaLM(cfg, dtype=dtype, lora_rank=args.rank)

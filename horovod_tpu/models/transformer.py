@@ -182,6 +182,11 @@ class LlamaConfig:
 # Llama-3 8B architecture (public config: 32 layers, 32 heads / 8 KV heads,
 # d_model 4096, FFN 14336, vocab 128256, rope theta 5e5).
 LLAMA3_8B = LlamaConfig()
+# ~0.9B single-chip variant (the 8B needs >16 GB for f32 master weights
+# alone); same shape family, used for the single-chip LoRA benchmark.
+LLAMA_1B = LlamaConfig(vocab_size=32000, num_layers=16, num_heads=16,
+                       num_kv_heads=8, head_dim=128, d_model=2048,
+                       ffn_hidden=5632, max_seq_len=4096)
 LLAMA_TINY = LlamaConfig(vocab_size=256, num_layers=2, num_heads=4,
                          num_kv_heads=2, head_dim=16, d_model=64,
                          ffn_hidden=128, max_seq_len=128)
