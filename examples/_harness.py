@@ -30,9 +30,10 @@ def timed_training(step, params, opt_state, data, steps: int,
     # docstring); a value fetch is the portable fence.  On CPU/standard
     # backends block_until_ready is a correct fence (the eager collective
     # plane relies on it).
-    for _ in range(5):  # warm window: drains the post-compile dispatch
-        # backlog, which otherwise leaks multi-second latencies into the
-        # first timed steps (measured: 16.7s -> 0.1s/step on BERT-Large).
+    WARM = 5  # warm window: drains the post-compile dispatch backlog,
+    # which otherwise leaks multi-second latencies into the first timed
+    # steps (measured: 16.7s -> 0.1s/step on BERT-Large).
+    for _ in range(WARM):
         params, opt_state, loss = step(params, opt_state, data)
     float(loss)
     t0 = time.perf_counter()
@@ -44,11 +45,11 @@ def timed_training(step, params, opt_state, data, steps: int,
     dt = time.perf_counter() - t0
     if rank == 0:
         import horovod_tpu as hvd
-        # Step indices count TRUE optimizer updates (compile + 5 warm
+        # Step indices count TRUE optimizer updates (compile + warm
         # steps precede the timed window), so loss-at-step-N stays
         # comparable across configs.
         for i in range(0, steps, 10):
-            print(f"step {i + 6:4d} loss {float(losses[i]):.4f}")
+            print(f"step {i + 1 + WARM:4d} loss {float(losses[i]):.4f}")
         rate = steps * items_per_step / dt
         print(f"{rate:.1f} {unit}/s ({rate / hvd.size():.1f}/chip), "
               f"final loss {float(losses[-1]):.4f}")

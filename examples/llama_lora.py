@@ -27,9 +27,10 @@ def main():
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--rank", type=int, default=8, help="LoRA rank")
     p.add_argument("--lr", type=float, default=1e-3)
-    p.add_argument("--1b", dest="mid", action="store_true",
-                   help="~0.9B single-chip config")
-    p.add_argument("--8b", dest="full", action="store_true",
+    size = p.add_mutually_exclusive_group()
+    size.add_argument("--1b", dest="mid", action="store_true",
+                      help="~0.9B single-chip config")
+    size.add_argument("--8b", dest="full", action="store_true",
                    help="real Llama-3 8B (needs TPU HBM)")
     p.add_argument("--cpu-devices", type=int, default=0)
     args = p.parse_args()

@@ -196,6 +196,62 @@ def test_alltoallv_in_step_traced_counts(hvd, n_devices):
             off += c
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
+def test_alltoallv_eager_dtype_sweep(hvd, n_devices, dtype):
+    n = n_devices
+    splits = np.array([[(r + i) % 2 + 1 for i in range(n)]
+                       for r in range(n)], np.int32)
+    datas = []
+    for r in range(n):
+        tot = int(splits[r].sum())
+        datas.append(np.asarray(
+            jnp.asarray(np.arange(tot) + 10 * r, dtype)))
+    got, rs = hv.alltoallv(datas, list(splits),
+                           name=f"a2av_{jnp.dtype(dtype).name}")
+    for r in range(n):
+        assert got[r].dtype == np.asarray(jnp.asarray([], dtype)).dtype
+        np.testing.assert_array_equal(rs[r], splits[:, r])
+        # Row values: sender s's block for dest r starts at
+        # sum(splits[s,:r]) within sender s's data.
+        off_out = 0
+        for s in range(n):
+            c = splits[s, r]
+            start = int(splits[s, :r].sum())
+            expect = np.asarray(jnp.asarray(
+                np.arange(start, start + c) + 10 * s, dtype))
+            np.testing.assert_array_equal(got[r][off_out:off_out + c],
+                                          expect)
+            off_out += c
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_in_step_process_set_reducescatter_average(hvd, n_devices, dtype):
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    members = (0, 1, 4, 5)
+    m = len(members)
+    ps = hv.add_process_set(members, name="rs_avg")
+    try:
+        def f(x):
+            return cops.reducescatter(x[0], hv.Average, axes=axes,
+                                      process_set=ps)[None]
+
+        fs = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axes),
+                                   out_specs=P(axes)))
+        x = rank_stacked(n_devices, (m, 3), dtype, seed=9)
+        y = np.asarray(fs(x), np.float64)
+        mean = np.asarray(x, np.float64)[list(members)].mean(axis=0)
+        for pos, r in enumerate(members):
+            np.testing.assert_allclose(y[r], mean[pos:pos + 1],
+                                       rtol=3e-2 if dtype == jnp.bfloat16
+                                       else 1e-5)
+    finally:
+        hv.remove_process_set("rs_avg")
+
+
 def test_alltoallv_in_step_truncates_consistently(hvd, n_devices):
     """A traced count above max_count truncates the split AND clamps the
     receiver's count -- never recv_counts[j] > max_count."""

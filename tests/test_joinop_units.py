@@ -1,0 +1,52 @@
+"""Unit tests for the JoinOp draining machinery (single-process parts).
+
+The end-to-end protocol is exercised by the np=2/np=3 launcher tests in
+``test_run.py``; these cover the pure components.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.collectives import joinop
+
+
+@pytest.mark.parametrize("op,dtype,expect", [
+    ("sum", np.float32, 0),
+    ("average", np.float32, 0),
+    ("adasum", np.float32, 0),
+    ("product", np.float32, 1),
+    ("min", np.float32, np.inf),
+    ("max", np.float32, -np.inf),
+    ("min", np.int32, np.iinfo(np.int32).max),
+    ("max", np.int32, np.iinfo(np.int32).min),
+])
+def test_identity_values(op, dtype, expect):
+    assert joinop.identity_value(op, np.dtype(dtype)) == expect
+
+
+def test_sync_is_noop_single_process(hvd):
+    """Single-process mode: no join machinery, zero overhead path."""
+    from horovod_tpu.core import process_sets as ps
+    assert joinop.sync(ps.get_process_set(None)) is None
+
+
+def test_join_degenerates_to_barrier_single_process(hvd):
+    assert hvd.join() == -1  # reference convention: no rank joined last
+
+
+def test_reset_clears_generation(hvd):
+    joinop._gen = 3
+    joinop._joined = True
+    joinop.reset()
+    assert joinop._gen == 0 and not joinop._joined
+
+
+def test_replay_rejects_unknown_kind(hvd):
+    with pytest.raises(RuntimeError, match="unknown join replay kind"):
+        joinop._replay({"kind": "frobnicate", "shape": (1,),
+                        "dtype": "float32"})
+
+
+def test_replay_abort_raises_with_message(hvd):
+    with pytest.raises(RuntimeError, match="root has left"):
+        joinop._replay({"kind": "abort", "message": "root has left"})
