@@ -28,6 +28,8 @@ def main():
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--large", action="store_true",
                    help="real BERT-Large (needs TPU HBM)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks (long-seq memory trade)")
     p.add_argument("--cpu-devices", type=int, default=0)
     args = p.parse_args()
 
@@ -43,7 +45,7 @@ def main():
     cfg = BERT_LARGE if args.large else BERT_TINY
     dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" \
         else jnp.float32
-    model = Bert(cfg, dtype=dtype)
+    model = Bert(cfg, dtype=dtype, remat=args.remat)
     batch = args.batch_size or 4 * hvd.size()
     seq = min(args.seq_len, cfg.max_seq_len)
 

@@ -32,6 +32,8 @@ def main():
                       help="~0.9B single-chip config")
     size.add_argument("--8b", dest="full", action="store_true",
                    help="real Llama-3 8B (needs TPU HBM)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks (long-seq memory trade)")
     p.add_argument("--cpu-devices", type=int, default=0)
     args = p.parse_args()
 
@@ -49,7 +51,8 @@ def main():
         LLAMA_1B if args.mid else LLAMA_TINY)
     dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" \
         else jnp.float32
-    model = LlamaLM(cfg, dtype=dtype, lora_rank=args.rank)
+    model = LlamaLM(cfg, dtype=dtype, lora_rank=args.rank,
+                    remat=args.remat)
     batch = args.batch_size or 2 * hvd.size()
     seq = min(args.seq_len, cfg.max_seq_len)
 

@@ -193,11 +193,18 @@ LLAMA_TINY = LlamaConfig(vocab_size=256, num_layers=2, num_heads=4,
 
 
 class LlamaLM(nn.Module):
-    """Decoder-only LM (Llama-3 family architecture)."""
+    """Decoder-only LM (Llama-3 family architecture).
+
+    ``remat=True`` rematerializes each decoder block in the backward pass
+    (``jax.checkpoint`` via ``nn.remat``): activation HBM drops from
+    O(layers x tokens x d) to O(tokens x d) at ~1.3x FLOPs -- the
+    standard TPU trade for long sequences / big batches.
+    """
 
     config: LlamaConfig
     dtype: Dtype = jnp.bfloat16
     lora_rank: int = 0
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -208,12 +215,13 @@ class LlamaLM(nn.Module):
         emb = self.param("tok_embed", nn.initializers.normal(stddev=0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
         x = emb[tokens].astype(self.dtype)
+        block_cls = nn.remat(DecoderBlock) if self.remat else DecoderBlock
         for i in range(cfg.num_layers):
-            x = DecoderBlock(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
-                             cfg.ffn_hidden, dtype=self.dtype,
-                             rope_theta=cfg.rope_theta,
-                             lora_rank=self.lora_rank,
-                             name=f"layer_{i}")(x, positions)
+            x = block_cls(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                          cfg.ffn_hidden, dtype=self.dtype,
+                          rope_theta=cfg.rope_theta,
+                          lora_rank=self.lora_rank,
+                          name=f"layer_{i}")(x, positions)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
         # Tied-embedding readout in f32 for stable softmax.
         return x.astype(jnp.float32) @ emb.T
@@ -271,10 +279,15 @@ class EncoderBlock(nn.Module):
 
 
 class Bert(nn.Module):
-    """BERT encoder with MLM + NSP heads (pretraining objective)."""
+    """BERT encoder with MLM + NSP heads (pretraining objective).
+
+    ``remat=True``: see :class:`LlamaLM` -- per-block rematerialization
+    for long-sequence / large-batch training.
+    """
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, token_types=None):
@@ -291,9 +304,10 @@ class Bert(nn.Module):
         x = (emb[tokens] + pos[None, :t] + typ[token_types]).astype(self.dtype)
         x = nn.LayerNorm(dtype=self.dtype, epsilon=1e-12,
                          param_dtype=jnp.float32, name="embed_norm")(x)
+        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         for i in range(cfg.num_layers):
-            x = EncoderBlock(cfg.num_heads, cfg.ffn_hidden,
-                             dtype=self.dtype, name=f"layer_{i}")(x)
+            x = block_cls(cfg.num_heads, cfg.ffn_hidden,
+                          dtype=self.dtype, name=f"layer_{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, epsilon=1e-12,
                          param_dtype=jnp.float32, name="final_norm")(x)
         # MLM head: transform + tied-embedding readout (f32 softmax input).
