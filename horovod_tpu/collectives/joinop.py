@@ -207,7 +207,10 @@ def _replay(meta: dict) -> None:
         elif kind == "allgather":
             eager.allgather(np.zeros((k_local,) + row, dtype), name=name)
         elif kind == "reducescatter":
-            eager.reducescatter(np.zeros((k_local,) + row, dtype),
+            # Identity payload, like the allreduce branch: zeros corrupt
+            # min/max/product reductions.
+            fill = identity_value(meta["op"], dtype)
+            eager.reducescatter(np.full((k_local,) + row, fill, dtype),
                                 ReduceOp(meta["op"]), name=name,
                                 _join_k=meta.get("jk"))
         elif kind == "alltoall":

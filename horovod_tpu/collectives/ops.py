@@ -298,14 +298,20 @@ def reducescatter(x,
     """Reduce then scatter shards along ``scatter_axis`` (NCCLReducescatter).
 
     With a process set, members reduce among themselves (masked full-mesh
-    psum) and each member takes the shard at its position within the set;
+    psum, or the masked allreduce for min/max/product) and each member
+    takes the shard at its position within the set;
     ``x.shape[scatter_axis]`` must divide by the set size.  Non-members
-    receive shard 0 of the member reduction (unspecified in the reference's
-    per-rank model -- a non-member never calls the op).
+    receive an UNSPECIFIED value (shard 0 of the member reduction on the
+    sum path, their own shard 0 on the min/max/product path -- in the
+    reference's per-rank model a non-member never calls the op).
     """
     axes, members = _resolve(axes, process_set)
-    if op not in (Sum, Average):
-        raise NotImplementedError("reducescatter supports Sum/Average")
+    if op is Adasum:
+        raise NotImplementedError(
+            "reducescatter does not support Adasum (the reference's Adasum "
+            "is an allreduce-shaped op); use allreduce(op=Adasum)")
+    if op not in (Sum, Average, Min, Max, Product):
+        raise ValueError(f"unknown reduce op {op}")
     if members is not None:
         m = len(members)
         d = x.shape[scatter_axis]
@@ -313,15 +319,33 @@ def reducescatter(x,
             raise ValueError(
                 f"reducescatter over a {m}-member process set needs "
                 f"dim {scatter_axis} divisible by {m}, got {d}")
-        mask = _member_mask(axes, members)
-        contrib = jnp.where(mask, x, jnp.zeros((), x.dtype))
-        y = lax.psum(contrib, axes)
+        if op in (Min, Max, Product):
+            y = allreduce(x, op, axes=axes, process_set=process_set)
+        else:
+            mask = _member_mask(axes, members)
+            contrib = jnp.where(mask, x, jnp.zeros((), x.dtype))
+            y = lax.psum(contrib, axes)
+            if op is Average:
+                y = _divide_in_dtype(y, m)
         shard = d // m
         pos = _member_pos(axes, members)
-        y = lax.dynamic_slice_in_dim(y, pos * shard, shard, scatter_axis)
-        if op is Average:
-            y = _divide_in_dtype(y, m)
-        return y
+        return lax.dynamic_slice_in_dim(y, pos * shard, shard, scatter_axis)
+    if op in (Min, Max, Product):
+        # No min/max/prod scatter primitive: reduce the full vector
+        # (pmin/pmax, or the gathered product the allreduce path uses)
+        # and take this rank's shard.  Bytes are O(n) like an allreduce
+        # rather than the ring-scatter's O(n/p) -- matching the
+        # reference, whose NCCL reducescatter supports these ops and is
+        # the parity point.
+        n = math.prod(lax.axis_size(a) for a in axes)
+        d = x.shape[scatter_axis]
+        if d % n:
+            raise ValueError(
+                f"reducescatter needs dim {scatter_axis} divisible by the "
+                f"mesh size {n}, got {d}")
+        y = allreduce(x, op, axes=axes)
+        return lax.dynamic_slice_in_dim(
+            y, axis_index(axes) * (d // n), d // n, scatter_axis)
     y = x
     for a in axes:
         y = lax.psum_scatter(y, a, scatter_dimension=scatter_axis, tiled=True)

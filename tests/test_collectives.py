@@ -107,6 +107,46 @@ def test_reducescatter(hvd, n_devices):
                                    full[r * 2:(r + 1) * 2], rtol=1e-5)
 
 
+@pytest.mark.parametrize("op_name,op", [("min", hv.Min), ("max", hv.Max),
+                                        ("prod", hv.Product)])
+def test_reducescatter_minmaxprod(hvd, n_devices, op_name, op):
+    """Reference NCCL reducescatter supports min/max/prod too."""
+    rng = np.random.RandomState(11)
+    rows = rng.randint(1, 4, size=(n_devices, n_devices * 2, 3))
+    x = jnp.asarray(rows, jnp.float32)
+    y = hvd.reducescatter(x, op, name=f"rs_{op_name}")
+    assert y.shape == (n_devices, 2, 3)
+    full = _np_ref(op_name, rows.astype(np.float64))
+    for r in range(n_devices):
+        np.testing.assert_allclose(np.asarray(y[r], np.float64),
+                                   full[r * 2:(r + 1) * 2], rtol=1e-6)
+
+
+def test_in_step_process_set_reducescatter_min(hvd, n_devices):
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    members = (1, 2, 6, 7)
+    m = len(members)
+    ps = hv.add_process_set(members, name="rs_min")
+    try:
+        def f(x):
+            return cops.reducescatter(x[0], hv.Min, axes=axes,
+                                      process_set=ps)[None]
+
+        fs = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axes),
+                                   out_specs=P(axes)))
+        x = rank_stacked(n_devices, (m, 2), jnp.float32, seed=13)
+        y = np.asarray(fs(x))
+        mn = np.asarray(x)[list(members)].min(axis=0)
+        for pos, r in enumerate(members):
+            np.testing.assert_allclose(y[r], mn[pos:pos + 1], rtol=1e-6)
+    finally:
+        hv.remove_process_set("rs_min")
+
+
 def test_alltoall(hvd, n_devices):
     x = rank_stacked(n_devices, (n_devices * 2, 2), jnp.float32)
     y = hvd.alltoall(x)
