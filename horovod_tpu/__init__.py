@@ -39,9 +39,10 @@ from .collectives.compression import Compression  # noqa: F401
 from .collectives import ops as collective_ops  # noqa: F401  (in-step)
 from . import ops  # noqa: F401  (pallas kernels: hvd.ops.flash_attention)
 from .collectives.eager import (  # noqa: F401
-    allreduce, allreduce_async, grouped_allreduce, allgather, allgatherv,
-    broadcast, reducescatter, alltoall, alltoallv, barrier, join,
-    synchronize, poll, local_result, replicated_stack, local_rank_count,
+    allreduce, allreduce_async, grouped_allreduce, grouped_allgather,
+    grouped_reducescatter, allgather, allgatherv, broadcast, reducescatter,
+    alltoall, alltoallv, barrier, join, synchronize, poll, local_result,
+    replicated_stack, local_rank_count,
 )
 from .optim.distributed import (  # noqa: F401
     DistributedOptimizer, DistributedAdasumOptimizer, allreduce_gradients,

@@ -443,6 +443,93 @@ def broadcast_fused(arrays, root_rank: int = 0, *, name=None,
     return out
 
 
+def grouped_allgather(xs: Sequence, *, name=None, process_set=None):
+    """Fused multi-tensor allgather (reference ``hvd.grouped_allgather``).
+
+    Per-rank tensors are flattened and concatenated into one buffer, ONE
+    collective gathers it, and each tensor's dim-0 concatenation is sliced
+    back out -- the fusion-buffer treatment upstream gives grouped ops.
+    """
+    xs = [jnp.asarray(x) for x in xs]
+    if not xs:
+        return []
+    ps = _ps.get_process_set(process_set)
+    k = local_rank_count(ps)
+    n = ps.size()
+    _check_rank_stacked(xs, k, "grouped_allgather")
+    out: List[Any] = [None] * len(xs)
+    # Fuse per dtype (concatenating mixed dtypes would silently promote).
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, x in enumerate(xs):
+        by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
+    for dt, idxs in by_dtype.items():
+        flats = [xs[i].reshape(k, -1) for i in idxs]
+        widths = [f.shape[1] for f in flats]
+        fused = flats[0] if len(flats) == 1 \
+            else jnp.concatenate(flats, axis=1)
+        g = allgather(fused, name=f"{name or 'grouped_allgather'}.{dt.name}",
+                      process_set=ps)                # [k, n*S]
+        S = sum(widths)
+        rows = g.reshape(g.shape[0], n, S)
+        off = 0
+        for i, w in zip(idxs, widths):
+            piece = rows[:, :, off:off + w]          # [k, n, w]
+            out[i] = piece.reshape(
+                (g.shape[0], n * xs[i].shape[1]) + xs[i].shape[2:])
+            off += w
+    return out
+
+
+def grouped_reducescatter(xs: Sequence, op: ReduceOp = Average, *,
+                          name=None, process_set=None):
+    """Fused multi-tensor reducescatter (``hvd.grouped_reducescatter``).
+
+    Each tensor's dim 0 must divide by the set size.  Tensors reshape to
+    ``[k, n, d0/n * tail]`` and concatenate on the last axis, so ONE
+    scatter leaves every rank a contiguous fused shard that slices back
+    into per-tensor shards.
+    """
+    xs = [jnp.asarray(x) for x in xs]
+    if not xs:
+        return []
+    ps = _ps.get_process_set(process_set)
+    k = local_rank_count(ps)
+    n = ps.size()
+    _check_rank_stacked(xs, k, "grouped_reducescatter")
+    out: List[Any] = [None] * len(xs)
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, x in enumerate(xs):
+        if x.shape[1] % n:
+            raise ValueError(
+                f"grouped_reducescatter needs dim 0 divisible by the set "
+                f"size {n}, got {x.shape[1:]}")
+        by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
+    for dt, idxs in by_dtype.items():
+        parts = [xs[i].reshape(k, n, -1) for i in idxs]
+        widths = [p.shape[2] for p in parts]
+        fused = parts[0] if len(parts) == 1 \
+            else jnp.concatenate(parts, axis=2)
+        red = reducescatter(
+            fused, op, name=f"{name or 'grouped_reducescatter'}.{dt.name}",
+            process_set=ps)                          # [k, 1, S] shards
+        red = red.reshape(red.shape[0], -1)
+        off = 0
+        for i, w in zip(idxs, widths):
+            shard = red[:, off:off + w]
+            out[i] = shard.reshape(
+                (red.shape[0], xs[i].shape[1] // n) + xs[i].shape[2:])
+            off += w
+    return out
+
+
+def _check_rank_stacked(xs, k: int, what: str) -> None:
+    for x in xs:
+        if x.ndim < 2 or x.shape[0] != k:
+            raise ValueError(
+                f"{what} takes rank-stacked inputs with leading axis {k} "
+                f"(this process's local ranks); got shape {x.shape}")
+
+
 def allgather(x, *, name=None, process_set=None):
     """Each rank contributes its slice; all receive the concatenation.
 

@@ -97,6 +97,22 @@ def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
     return [_from_row(o, t) for o, t in zip(outs, tensors)]
 
 
+def grouped_allgather(tensors: Sequence, name=None,
+                      process_set=None) -> List[tf.Tensor]:
+    """Reference ``hvd.grouped_allgather``: one fused gather."""
+    outs = _eager.grouped_allgather([_to_stack(t) for t in tensors],
+                                    name=name, process_set=process_set)
+    return [_from_row(o, t) for o, t in zip(outs, tensors)]
+
+
+def grouped_reducescatter(tensors: Sequence, op: ReduceOp = Average,
+                          name=None, process_set=None) -> List[tf.Tensor]:
+    """Reference ``hvd.grouped_reducescatter``: one fused scatter."""
+    outs = _eager.grouped_reducescatter([_to_stack(t) for t in tensors], op,
+                                        name=name, process_set=process_set)
+    return [_from_row(o, t) for o, t in zip(outs, tensors)]
+
+
 def allgather(tensor, name: Optional[str] = None,
               process_set=None) -> tf.Tensor:
     """Reference parity: first dims MAY differ across ranks (sizes are

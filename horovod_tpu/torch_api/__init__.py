@@ -167,6 +167,22 @@ def grouped_allreduce_async_(tensors: List[torch.Tensor], **kwargs) -> int:
     return h
 
 
+def grouped_allgather(tensors: List[torch.Tensor], name=None,
+                      process_set=None) -> List[torch.Tensor]:
+    """Reference ``hvd.grouped_allgather``: one fused gather."""
+    outs = _eager.grouped_allgather([_to_stack(t) for t in tensors],
+                                    name=name, process_set=process_set)
+    return [_from_row(o, t) for o, t in zip(outs, tensors)]
+
+
+def grouped_reducescatter(tensors: List[torch.Tensor], op: ReduceOp = Average,
+                          name=None, process_set=None) -> List[torch.Tensor]:
+    """Reference ``hvd.grouped_reducescatter``: one fused scatter."""
+    outs = _eager.grouped_reducescatter([_to_stack(t) for t in tensors], op,
+                                        name=name, process_set=process_set)
+    return [_from_row(o, t) for o, t in zip(outs, tensors)]
+
+
 def sparse_allreduce_async(tensor: torch.Tensor,
                            name: Optional[str] = None,
                            op: ReduceOp = Average,

@@ -327,6 +327,26 @@ def test_alltoallv_in_step_truncates_consistently(hvd, n_devices):
                                    np.arange(max_count) + 10 * s)
 
 
+def test_grouped_allgather_and_reducescatter(hvd, n_devices):
+    """Reference grouped_allgather / grouped_reducescatter parity: one
+    fused collective, per-tensor results identical to the singles."""
+    n = n_devices
+    xs = [rank_stacked(n, (2, 3), jnp.float32, seed=1),
+          rank_stacked(n, (4,), jnp.float32, seed=2)]
+    gs = hvd.grouped_allgather(xs, name="gga")
+    for x, g in zip(xs, gs):
+        single = hvd.allgather(x, name="gga_single")
+        np.testing.assert_allclose(np.asarray(g), np.asarray(single),
+                                   rtol=1e-6)
+    ys = [rank_stacked(n, (n * 2, 3), jnp.float32, seed=3),
+          rank_stacked(n, (n,), jnp.float32, seed=4)]
+    rs = hvd.grouped_reducescatter(ys, hv.Sum, name="grs")
+    for y, r in zip(ys, rs):
+        single = hvd.reducescatter(y, hv.Sum, name="grs_single")
+        np.testing.assert_allclose(np.asarray(r), np.asarray(single),
+                                   rtol=1e-5)
+
+
 def test_grouped_allreduce(hvd, n_devices):
     xs = [rank_stacked(n_devices, shape, jnp.float32, seed=i)
           for i, shape in enumerate([(4,), (2, 3), (5, 1)])]

@@ -90,6 +90,21 @@ def test_alltoall_even_returns_bare_tensor(hvd_t, n_devices):
     np.testing.assert_allclose(out.numpy(), np.tile(t.numpy()[:2], n))
 
 
+def test_grouped_allgather_reducescatter(hvd_t, n_devices):
+    n = n_devices
+    ts = [torch.randn(3, 2), torch.randn(5)]
+    outs = hvd_t.grouped_allgather(ts)
+    for t, o in zip(ts, outs):
+        # replicated single-process input: concat of n identical copies
+        np.testing.assert_allclose(o.numpy(),
+                                   np.concatenate([t.numpy()] * n), rtol=1e-6)
+    rs_in = [torch.randn(n * 2, 3), torch.randn(n)]
+    outs = hvd_t.grouped_reducescatter(rs_in, op=thvd.Sum)
+    for t, o in zip(rs_in, outs):
+        expect = t.numpy()[: t.shape[0] // n] * n  # rank-0 shard of sum
+        np.testing.assert_allclose(o.numpy(), expect, rtol=1e-5)
+
+
 def test_grouped_allreduce(hvd_t, n_devices):
     ts = [torch.ones(3), torch.full((2, 2), 2.0)]
     outs = hvd_t.grouped_allreduce(ts, op=thvd.Sum)
