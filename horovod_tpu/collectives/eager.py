@@ -449,8 +449,13 @@ def grouped_allgather(xs: Sequence, *, name=None, process_set=None):
     Per-rank tensors are flattened and concatenated into one buffer, ONE
     collective gathers it, and each tensor's dim-0 concatenation is sliced
     back out -- the fusion-buffer treatment upstream gives grouped ops.
+
+    The fused buffer is static-shape: every rank must pass the SAME
+    per-tensor shapes (the reference's grouped gather also negotiates
+    ragged dims -- here ragged first dims go through per-tensor
+    :func:`allgatherv` instead).
     """
-    xs = [jnp.asarray(x) for x in xs]
+    xs = _as_stacks(xs)
     if not xs:
         return []
     ps = _ps.get_process_set(process_set)
@@ -458,15 +463,12 @@ def grouped_allgather(xs: Sequence, *, name=None, process_set=None):
     n = ps.size()
     _check_rank_stacked(xs, k, "grouped_allgather")
     out: List[Any] = [None] * len(xs)
-    # Fuse per dtype (concatenating mixed dtypes would silently promote).
-    by_dtype: Dict[Any, List[int]] = {}
-    for i, x in enumerate(xs):
-        by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
-    for dt, idxs in by_dtype.items():
+    cat = np.concatenate if isinstance(xs[0], np.ndarray) \
+        else jnp.concatenate
+    for dt, idxs in _dtype_buckets(xs).items():
         flats = [xs[i].reshape(k, -1) for i in idxs]
         widths = [f.shape[1] for f in flats]
-        fused = flats[0] if len(flats) == 1 \
-            else jnp.concatenate(flats, axis=1)
+        fused = flats[0] if len(flats) == 1 else cat(flats, axis=1)
         g = allgather(fused, name=f"{name or 'grouped_allgather'}.{dt.name}",
                       process_set=ps)                # [k, n*S]
         S = sum(widths)
@@ -480,6 +482,25 @@ def grouped_allgather(xs: Sequence, *, name=None, process_set=None):
     return out
 
 
+def _as_stacks(xs) -> List[Any]:
+    """Normalize inputs: keep all-numpy lists on the host (fusing there
+    costs one staging transfer per BUCKET instead of one per tensor --
+    each transfer is a round-trip on the tunnelled TPU)."""
+    xs = list(xs)
+    if all(isinstance(x, np.ndarray) for x in xs):
+        return xs
+    return [jnp.asarray(x) for x in xs]
+
+
+def _dtype_buckets(xs) -> Dict[Any, List[int]]:
+    """Indices grouped per dtype (concatenating mixed dtypes would
+    silently promote)."""
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, x in enumerate(xs):
+        by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
+    return by_dtype
+
+
 def grouped_reducescatter(xs: Sequence, op: ReduceOp = Average, *,
                           name=None, process_set=None):
     """Fused multi-tensor reducescatter (``hvd.grouped_reducescatter``).
@@ -489,7 +510,7 @@ def grouped_reducescatter(xs: Sequence, op: ReduceOp = Average, *,
     scatter leaves every rank a contiguous fused shard that slices back
     into per-tensor shards.
     """
-    xs = [jnp.asarray(x) for x in xs]
+    xs = _as_stacks(xs)
     if not xs:
         return []
     ps = _ps.get_process_set(process_set)
@@ -497,18 +518,17 @@ def grouped_reducescatter(xs: Sequence, op: ReduceOp = Average, *,
     n = ps.size()
     _check_rank_stacked(xs, k, "grouped_reducescatter")
     out: List[Any] = [None] * len(xs)
-    by_dtype: Dict[Any, List[int]] = {}
-    for i, x in enumerate(xs):
+    for x in xs:
         if x.shape[1] % n:
             raise ValueError(
                 f"grouped_reducescatter needs dim 0 divisible by the set "
                 f"size {n}, got {x.shape[1:]}")
-        by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
-    for dt, idxs in by_dtype.items():
+    cat = np.concatenate if isinstance(xs[0], np.ndarray) \
+        else jnp.concatenate
+    for dt, idxs in _dtype_buckets(xs).items():
         parts = [xs[i].reshape(k, n, -1) for i in idxs]
         widths = [p.shape[2] for p in parts]
-        fused = parts[0] if len(parts) == 1 \
-            else jnp.concatenate(parts, axis=2)
+        fused = parts[0] if len(parts) == 1 else cat(parts, axis=2)
         red = reducescatter(
             fused, op, name=f"{name or 'grouped_reducescatter'}.{dt.name}",
             process_set=ps)                          # [k, 1, S] shards

@@ -161,6 +161,49 @@ def grouped_allreduce_async(tensors: List[torch.Tensor], average=None,
         assemble=lambda r: _eager._unfuse_buckets(r, spec, to_host=True))
 
 
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    process_set=None) -> int:
+    """Like the sync :func:`allgather`, first dims MAY differ across
+    ranks; the ragged size negotiation is host-synchronous, so the handle
+    completes immediately (upstream's contract only promises a handle)."""
+    result = allgather(tensor, name=name, process_set=process_set)
+    return _handles.alloc_custom(lambda: result)
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None, process_set=None) -> int:
+    out = _eager.broadcast(_to_stack(tensor), root_rank, name=name,
+                           process_set=process_set)
+    return _handles.alloc(out, tensor, inplace=False)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int, **kwargs) -> int:
+    h = broadcast_async(tensor, root_rank, **kwargs)
+    _handles.mark_inplace(h)
+    return h
+
+
+def reducescatter_async(tensor: torch.Tensor, op: ReduceOp = Average,
+                        name: Optional[str] = None, process_set=None) -> int:
+    out = _eager.reducescatter(_to_stack(tensor), op, name=name,
+                               process_set=process_set)
+    return _handles.alloc(out, tensor, inplace=False)
+
+
+def alltoall_async(tensor: torch.Tensor,
+                   splits: Optional[torch.Tensor] = None,
+                   name: Optional[str] = None, process_set=None) -> int:
+    """With ``splits`` the ragged negotiation is host-synchronous (sizes
+    must be exchanged to shape the result), so the handle completes
+    immediately -- upstream's contract only promises a handle."""
+    if splits is None:
+        out = _eager.alltoall(_to_stack(tensor), name=name,
+                              process_set=process_set)
+        return _handles.alloc(out, tensor, inplace=False)
+    result = alltoall(tensor, splits, name=name, process_set=process_set)
+    return _handles.alloc_custom(lambda: result)
+
+
 def grouped_allreduce_async_(tensors: List[torch.Tensor], **kwargs) -> int:
     h = grouped_allreduce_async(tensors, **kwargs)
     _handles.mark_inplace(h)

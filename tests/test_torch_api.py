@@ -105,6 +105,33 @@ def test_grouped_allgather_reducescatter(hvd_t, n_devices):
         np.testing.assert_allclose(o.numpy(), expect, rtol=1e-5)
 
 
+def test_more_async_variants(hvd_t, n_devices):
+    n = n_devices
+    t = torch.randn(3, 2)
+    h = hvd_t.allgather_async(t)
+    g = hvd_t.synchronize(h)
+    np.testing.assert_allclose(g.numpy(),
+                               np.concatenate([t.numpy()] * n), rtol=1e-6)
+    h = hvd_t.broadcast_async(t, root_rank=0)
+    np.testing.assert_allclose(hvd_t.synchronize(h).numpy(), t.numpy(),
+                               rtol=1e-6)
+    u = torch.randn(3, 2)
+    h = hvd_t.broadcast_async_(u, root_rank=0)
+    assert hvd_t.synchronize(h) is u
+    rs_in = torch.randn(n * 2, 3)
+    h = hvd_t.reducescatter_async(rs_in, op=thvd.Sum)
+    np.testing.assert_allclose(hvd_t.synchronize(h).numpy(),
+                               rs_in.numpy()[:2] * n, rtol=1e-5)
+    a2a_in = torch.arange(n * 2, dtype=torch.float32)
+    h = hvd_t.alltoall_async(a2a_in)
+    np.testing.assert_allclose(hvd_t.synchronize(h).numpy(),
+                               np.tile(a2a_in.numpy()[:2], n), rtol=1e-6)
+    sp = torch.tensor([1] * n)
+    h = hvd_t.alltoall_async(torch.randn(n, 2), splits=sp)
+    out, rsp = hvd_t.synchronize(h)
+    assert out.shape == (n, 2) and tuple(rsp.shape) == (n,)
+
+
 def test_grouped_allreduce(hvd_t, n_devices):
     ts = [torch.ones(3), torch.full((2, 2), 2.0)]
     outs = hvd_t.grouped_allreduce(ts, op=thvd.Sum)
