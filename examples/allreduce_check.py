@@ -126,6 +126,19 @@ def main():
             assert np.allclose(rs[i], base[2 * g:2 * g + 2]), rs
         print(f"rank {rank}: reducescatter OK")
 
+        # grouped allgather + reducescatter (one fused collective each).
+        ga = hvd.grouped_allgather(
+            [np.full((s, 2), float(rank), np.float32),
+             np.full((s, 3, 2), 2.0 + rank, np.float32)], name="gga_check")
+        g0 = hvd.local_result(ga[0])
+        assert g0.shape == (s, world * 2), g0.shape
+        grs = hvd.grouped_reducescatter(
+            [np.tile(np.arange(world, dtype=np.float32), (s, 2))
+             .reshape(s, 2 * world)], hvd.Sum, name="grs_check")
+        r0 = hvd.local_result(grs[0])
+        assert r0.shape == (s, 2), r0.shape
+        print(f"rank {rank}: grouped gather/scatter OK")
+
         # grouped allreduce with bf16 wire compression.
         outs = hvd.grouped_allreduce(
             [np.full((s, 3), float(rank), np.float32),
