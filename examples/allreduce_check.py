@@ -132,11 +132,25 @@ def main():
              np.full((s, 3, 2), 2.0 + rank, np.float32)], name="gga_check")
         g0 = hvd.local_result(ga[0])
         assert g0.shape == (s, world * 2), g0.shape
+        # Each process contributed rows valued with its process rank
+        # (hvd.rank() here is the process-level id): concat over device
+        # ranks in order, 2 entries each.
+        proc_of = np.arange(world) // s
+        expect0 = np.repeat(proc_of, 2).astype(np.float32)
+        assert np.allclose(g0, expect0[None]), (g0, expect0)
+        g1 = hvd.local_result(ga[1])
+        assert g1.shape == (s, world * 3, 2), g1.shape
+        expect1 = np.repeat(2.0 + proc_of, 3)
+        assert np.allclose(g1[0, :, 0], expect1), (g1[0, :, 0], expect1)
         grs = hvd.grouped_reducescatter(
-            [np.tile(np.arange(world, dtype=np.float32), (s, 2))
-             .reshape(s, 2 * world)], hvd.Sum, name="grs_check")
+            [np.tile(np.arange(world, dtype=np.float32), (s, 2))],
+            hvd.Sum, name="grs_check")
         r0 = hvd.local_result(grs[0])
         assert r0.shape == (s, 2), r0.shape
+        base = np.tile(np.arange(world, dtype=np.float32), 2) * world
+        for i in range(s):
+            g = jax.process_index() * s + i
+            assert np.allclose(r0[i], base[2 * g:2 * g + 2]), (r0, base)
         print(f"rank {rank}: grouped gather/scatter OK")
 
         # grouped allreduce with bf16 wire compression.
