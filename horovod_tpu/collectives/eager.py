@@ -339,7 +339,8 @@ def allreduce_async(x, op: ReduceOp = Average, *, name=None, process_set=None,
 
 def grouped_allreduce(xs: Sequence, op: ReduceOp = Average, *, name=None,
                       process_set=None, compression=Compression.none,
-                      to_host: bool = False):
+                      to_host: bool = False, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
     """Fused multi-tensor eager allreduce (grouped_allreduce parity).
 
     Tensors are fused per dtype (concatenating mixed dtypes would silently
@@ -357,13 +358,16 @@ def grouped_allreduce(xs: Sequence, op: ReduceOp = Average, *, name=None,
     if not xs:
         return []
     reds, spec = _grouped_allreduce_buckets(
-        xs, op, name=name, process_set=process_set, compression=compression)
+        xs, op, name=name, process_set=process_set, compression=compression,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
     return _unfuse_buckets(reds, spec, to_host=to_host)
 
 
 def _grouped_allreduce_buckets(xs, op: ReduceOp = Average, *, name=None,
                                process_set=None,
-                               compression=Compression.none):
+                               compression=Compression.none,
+                               prescale_factor: float = 1.0,
+                               postscale_factor: float = 1.0):
     """Dispatch the per-dtype fused allreduces WITHOUT fetching: returns
     ``(bucket_results, spec)`` for :func:`_unfuse_buckets` -- the async
     framework-shim path keeps the device arrays in its handle and unfuses
@@ -386,7 +390,9 @@ def _grouped_allreduce_buckets(xs, op: ReduceOp = Average, *, name=None,
         fused = flats[0] if len(flats) == 1 else cat(flats, axis=1)
         reds.append(allreduce(
             fused, op, name=f"{name or 'grouped_allreduce'}.{dt.name}",
-            process_set=process_set, compression=compression))
+            process_set=process_set, compression=compression,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor))
         spec.append((idxs, widths, [xs[i].shape[1:] for i in idxs]))
     return reds, (spec, len(xs))
 

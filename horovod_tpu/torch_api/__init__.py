@@ -117,11 +117,15 @@ def allreduce_(tensor: torch.Tensor, **kwargs) -> torch.Tensor:
 
 def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
                     name: Optional[str] = None, op: Optional[ReduceOp] = None,
-                    compression=Compression.none, process_set=None) -> int:
+                    compression=Compression.none, process_set=None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
     op = _resolve_op(average, op)
     stacks, compression = _wire_stage([_to_stack(tensor)], compression)
     out = _eager.allreduce(stacks[0], op, name=name,
-                           process_set=process_set, compression=compression)
+                           process_set=process_set, compression=compression,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
     return _handles.alloc(out, tensor, inplace=False)
 
 
@@ -133,19 +137,25 @@ def allreduce_async_(tensor: torch.Tensor, **kwargs) -> int:
 
 def grouped_allreduce(tensors: List[torch.Tensor], average=None, name=None,
                       op=None, process_set=None,
-                      compression=Compression.none) -> List[torch.Tensor]:
+                      compression=Compression.none,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> List[torch.Tensor]:
     op = _resolve_op(average, op)
     stacks, compression = _wire_stage([_to_stack(t) for t in tensors],
                                       compression)
     outs = _eager.grouped_allreduce(stacks, op,
                                     name=name, process_set=process_set,
-                                    compression=compression, to_host=True)
+                                    compression=compression, to_host=True,
+                                    prescale_factor=prescale_factor,
+                                    postscale_factor=postscale_factor)
     return [_from_row(o, t) for o, t in zip(outs, tensors)]
 
 
 def grouped_allreduce_async(tensors: List[torch.Tensor], average=None,
                             name=None, op=None, process_set=None,
-                            compression=Compression.none) -> int:
+                            compression=Compression.none,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> int:
     """One handle for the whole group (``hvd.grouped_allreduce_async``
     parity); ``synchronize(handle)`` returns the list of results."""
     op = _resolve_op(average, op)
@@ -155,7 +165,8 @@ def grouped_allreduce_async(tensors: List[torch.Tensor], average=None,
     # ONCE per bucket at synchronize() via the assemble hook.
     reds, spec = _eager._grouped_allreduce_buckets(
         stacks, op, name=name, process_set=process_set,
-        compression=compression)
+        compression=compression, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor)
     return _handles.alloc(
         reds, list(tensors), inplace=False,
         assemble=lambda r: _eager._unfuse_buckets(r, spec, to_host=True))

@@ -52,11 +52,14 @@ class GradBatcher:
             return self._group_codes.setdefault(key, len(self._group_codes))
 
     def enqueue(self, tensor: torch.Tensor, name: str, op, compression,
-                process_set) -> int:
+                process_set, prescale_factor: float = 1.0,
+                postscale_factor: float = 1.0) -> int:
         h = self.handles.create()
         code = self._code((str(tensor.dtype), id(op), id(compression),
-                           id(process_set)))
-        payload = (h, tensor, op, compression, process_set)
+                           id(process_set), prescale_factor,
+                           postscale_factor))
+        payload = (h, tensor, op, compression, process_set,
+                   prescale_factor, postscale_factor)
         self._sched.enqueue(payload, name=name, dtype_code=code,
                             nbytes=tensor.numel() * tensor.element_size(),
                             handle=h)
@@ -67,10 +70,12 @@ class GradBatcher:
         try:
             from . import grouped_allreduce
             tensors = [p[1] for p in payloads]
-            _, _, op, compression, process_set = payloads[0]
+            _, _, op, compression, process_set, pre, post = payloads[0]
             outs = grouped_allreduce(tensors, op=op,
                                      compression=compression,
                                      process_set=process_set,
+                                     prescale_factor=pre,
+                                     postscale_factor=post,
                                      name="cycle_fused")
             for (h, t, *_), o in zip(payloads, outs):
                 t.copy_(o)

@@ -28,8 +28,18 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _init_distributed(self, named_parameters, compression, op,
                           backward_passes_per_step, process_set,
-                          sparse_as_dense) -> None:
+                          sparse_as_dense,
+                          gradient_predivide_factor: float = 1.0) -> None:
         self._sparse_as_dense = sparse_as_dense
+        # Reference semantics: with op=Average, split the averaging --
+        # grads scale by 1/factor BEFORE the reduction and factor/size
+        # after, controlling where the division's rounding lands (fp16
+        # ranges).  Rides the collective stack's prescale/postscale
+        # support, which composes correctly with process-set sizes and
+        # join-phase active-rank rescaling (op stays Average).
+        f = float(gradient_predivide_factor)
+        self._prescale = 1.0 / f
+        self._postscale = f
         # Every param needs a UNIQUE name: in multi-process mode the
         # native scheduler cuts fused buckets in name-sorted order, so
         # duplicate names would let bucket layouts diverge across ranks
@@ -117,12 +127,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if b is not None:
                 self._pending[p] = ("native", b.enqueue(
                     p.grad, name, self._op, self._compression,
-                    self._process_set))
+                    self._process_set, self._prescale, self._postscale))
             else:
                 self._pending[p] = ("eager", allreduce_async_(
                     p.grad, op=self._op, name=name,
                     compression=self._compression,
-                    process_set=self._process_set))
+                    process_set=self._process_set,
+                    prescale_factor=self._prescale,
+                    postscale_factor=self._postscale))
         return hook
 
     # -- sync -------------------------------------------------------------
@@ -167,15 +179,32 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op: ReduceOp = Average,
+                         gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0,
                          process_set=None,
                          sparse_as_dense: bool = False
                          ) -> torch.optim.Optimizer:
-    """Wrap a torch optimizer so ``step()`` sees globally-reduced grads."""
+    """Wrap a torch optimizer so ``step()`` sees globally-reduced grads.
+
+    ``num_groups`` is accepted for reference signature parity and has no
+    effect: bucketing here is byte-threshold driven by the native cycle
+    scheduler (``HOROVOD_FUSION_THRESHOLD``), the knob upstream's group
+    count approximates.
+    """
+    # Validate BEFORE mutating the instance: rebinding __class__ and then
+    # raising would leave the caller's optimizer half-initialized.
+    if gradient_predivide_factor != 1.0 and op is not Average:
+        raise ValueError("gradient_predivide_factor requires op=Average "
+                         "(reference behavior)")
+    if gradient_predivide_factor <= 0.0:
+        raise ValueError("gradient_predivide_factor must be positive, got "
+                         f"{gradient_predivide_factor}")
     named = list(named_parameters) if named_parameters is not None else None
     optimizer.__class__ = type(
         "Distributed" + optimizer.__class__.__name__,
         (_DistributedOptimizer, optimizer.__class__), {})
     optimizer._init_distributed(named, compression, op,
                                 backward_passes_per_step, process_set,
-                                sparse_as_dense)
+                                sparse_as_dense,
+                                gradient_predivide_factor)
     return optimizer

@@ -139,6 +139,36 @@ def test_grouped_allreduce(hvd_t, n_devices):
     np.testing.assert_allclose(outs[1].numpy(), 2.0 * n_devices)
 
 
+def test_gradient_predivide_factor(hvd_t, n_devices):
+    """Reference semantics: grads scale by 1/factor before the sum and
+    factor/size after; the result equals a plain Average (modulo
+    rounding), and factor=1 stays the Average path."""
+    torch.manual_seed(3)
+    model = torch.nn.Linear(4, 2)
+    ref = torch.nn.Linear(4, 2)
+    ref.load_state_dict(model.state_dict())
+    x = torch.randn(6, 4)
+
+    opt = hvd_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        gradient_predivide_factor=2.0)
+    opt_ref = hvd_t.DistributedOptimizer(
+        torch.optim.SGD(ref.parameters(), lr=0.1),
+        named_parameters=ref.named_parameters())
+    for o, m in ((opt, model), (opt_ref, ref)):
+        o.zero_grad()
+        m(x).pow(2).mean().backward()
+        o.step()
+    for a, b in zip(model.parameters(), ref.parameters()):
+        np.testing.assert_allclose(a.detach().numpy(), b.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="requires op=Average"):
+        hvd_t.DistributedOptimizer(
+            torch.optim.SGD(torch.nn.Linear(2, 2).parameters(), lr=0.1),
+            op=thvd.Sum, gradient_predivide_factor=2.0)
+
+
 def test_optimizer_matches_plain_sgd(hvd_t):
     torch.manual_seed(0)
     m = torch.nn.Linear(8, 4)
