@@ -71,30 +71,31 @@ def allreduce(tensor, average: Optional[bool] = None,
 
 
 def grouped_allreduce(tensors: Sequence, average=None, name=None, op=None,
-                      process_set=None) -> List[tf.Tensor]:
+                      process_set=None, compression=Compression.none,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> List[tf.Tensor]:
     if op is None:
         op = Sum if average is False else Average
     tensors = list(tensors)
+
+    def _dispatch(ts):
+        outs = _eager.grouped_allreduce(
+            [_to_stack(t) for t in ts], op, name=name,
+            process_set=process_set, compression=compression,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, to_host=True)
+        return [_from_row(o, t) for o, t in zip(outs, ts)]
+
     if not tf.executing_eagerly():
         # Inside a tf.function graph (keras fit): hop out via py_function
         # so the XLA-mesh collective runs eagerly (the reference registers
         # custom TF kernels for this; the bridge cost is equivalent).
-        def _reduce(*ts):
-            outs = _eager.grouped_allreduce([_to_stack(t) for t in ts], op,
-                                            name=name,
-                                            process_set=process_set,
-                                            to_host=True)
-            return [_from_row(o, t) for o, t in zip(outs, ts)]
-
-        reduced = tf.py_function(_reduce, tensors,
+        reduced = tf.py_function(lambda *ts: _dispatch(ts), tensors,
                                  [t.dtype for t in tensors])
         for r, t in zip(reduced, tensors):
             r.set_shape(t.shape)
         return reduced
-    outs = _eager.grouped_allreduce([_to_stack(t) for t in tensors], op,
-                                    name=name, process_set=process_set,
-                                    to_host=True)
-    return [_from_row(o, t) for o, t in zip(outs, tensors)]
+    return _dispatch(tensors)
 
 
 def grouped_allgather(tensors: Sequence, name=None,
@@ -198,15 +199,23 @@ class DistributedGradientTape(tf.GradientTape):
 
     def __init__(self, tape: tf.GradientTape,
                  compression=Compression.none, op: ReduceOp = Average,
-                 process_set=None, sparse_as_dense: bool = False):
+                 process_set=None, sparse_as_dense: bool = False,
+                 gradient_predivide_factor: float = 1.0):
         # Adopt the wrapped tape's recording state.  sparse_as_dense
         # defaults OFF like the reference: densifying an embedding grad
         # can be a huge silent memory cost, so it is explicit opt-in.
+        if gradient_predivide_factor != 1.0 and op is not Average:
+            raise ValueError("gradient_predivide_factor requires "
+                             "op=Average (reference behavior)")
+        if gradient_predivide_factor <= 0.0:
+            raise ValueError("gradient_predivide_factor must be positive")
         self.__dict__.update(tape.__dict__)
         self._hvd_compression = compression
         self._hvd_op = op
         self._hvd_process_set = process_set
         self._hvd_sparse_as_dense = sparse_as_dense
+        self._hvd_prescale = 1.0 / gradient_predivide_factor
+        self._hvd_postscale = gradient_predivide_factor
 
     def gradient(self, target, sources, output_gradients=None,
                  unconnected_gradients=tf.UnconnectedGradients.NONE):
@@ -228,7 +237,10 @@ class DistributedGradientTape(tf.GradientTape):
             reduced = grouped_allreduce(
                 [tf.convert_to_tensor(flat[i]) for i in idx],
                 op=self._hvd_op, name="gradtape",
-                process_set=self._hvd_process_set)
+                process_set=self._hvd_process_set,
+                compression=self._hvd_compression,
+                prescale_factor=self._hvd_prescale,
+                postscale_factor=self._hvd_postscale)
             for i, g in zip(idx, reduced):
                 flat[i] = g
         return tf.nest.pack_sequence_as(grads, flat)

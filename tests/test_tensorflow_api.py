@@ -47,6 +47,28 @@ def test_alltoall_splits(hvd_tf, n_devices):
     np.testing.assert_array_equal(rsp.numpy(), np.full(n, int(sp[0])))
 
 
+def test_gradient_tape_predivide_and_compression(hvd_tf, n_devices):
+    """Predivide composes through the tape (result == plain Average), and
+    the tape's compression parameter actually reaches the collective."""
+    v = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
+
+    def grads(**kw):
+        tape = tf.GradientTape()
+        with tape:
+            loss = tf.reduce_sum(v * v)
+        dtape = hvd_tf.DistributedGradientTape(tape, **kw)
+        return dtape.gradient(loss, [v])[0]
+
+    g_ref = grads()
+    g_pre = grads(gradient_predivide_factor=2.0)
+    np.testing.assert_allclose(g_pre.numpy(), g_ref.numpy(), rtol=1e-5)
+    g_bf16 = grads(compression=hvd_tf.Compression.bf16)
+    np.testing.assert_allclose(g_bf16.numpy(), g_ref.numpy(), rtol=2e-2)
+    with pytest.raises(ValueError, match="requires op=Average"):
+        hvd_tf.DistributedGradientTape(tf.GradientTape(), op=hvd_tf.Sum,
+                                       gradient_predivide_factor=2.0)
+
+
 def test_broadcast_variables(hvd_tf):
     v = tf.Variable([1.0, 2.0, 3.0])
     hvd_tf.broadcast_variables([v], root_rank=0)
