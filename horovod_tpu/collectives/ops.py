@@ -427,14 +427,34 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
       the split received from rank ``j`` (zero-padded past
       ``recv_counts[j]``); ``recv_counts`` is ``[size]``, every entry
       ``<= max_count``.
+
+    With a process set, ``send_counts`` is indexed by SET position (one
+    count per member, splits concatenated in member order) and the
+    results cover members only: ``recv`` is ``[len(set), max_count, ...]``
+    and ``recv_counts`` is ``[len(set)]``.  Non-member devices exchange
+    nothing (their results are all-zero).
     """
     axes, members = _resolve(axes, process_set)
-    if members is not None:
-        raise NotImplementedError(
-            "in-step alltoallv over a process set is not supported; use the "
-            "eager API, which runs on the member-only sub-mesh")
     if len(axes) != 1:
         raise NotImplementedError("alltoallv requires a flat mesh axis")
+    if members is not None:
+        # Subset ragged exchange over the full mesh: member counts
+        # (indexed by SET position) scatter into global slots, non-member
+        # devices' counts are masked to zero (they send nothing and, by
+        # construction, receive zero rows from every member).
+        m = len(members)
+        send_counts = jnp.asarray(send_counts, jnp.int32)
+        if send_counts.shape != (m,):
+            raise ValueError(
+                f"send_counts must have shape ({m},) (one count per set "
+                f"member), got {send_counts.shape}")
+        size = lax.axis_size(axes[0])
+        full = jnp.zeros((size,), jnp.int32).at[
+            np.asarray(members)].set(send_counts)
+        full = jnp.where(_member_mask(axes, members), full, 0)
+        recv, rc = alltoallv(x, full, axes=axes, max_count=max_count)
+        sel = np.asarray(members)
+        return recv[sel], rc[sel]
     a = axes[0]
     size = lax.axis_size(a)
     send_counts = jnp.asarray(send_counts, jnp.int32)

@@ -292,6 +292,57 @@ def test_in_step_process_set_reducescatter_average(hvd, n_devices, dtype):
         hv.remove_process_set("rs_avg")
 
 
+def test_alltoallv_in_step_process_set(hvd, n_devices):
+    """Subset ragged exchange: member counts are set-position indexed,
+    non-members exchange nothing."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    n = n_devices
+    members = (0, 3, 5)
+    m = len(members)
+    ps = hv.add_process_set(members, name="a2av_ps")
+    try:
+        max_count = 2
+        # Member at set position p sends (p + q) % 2 + 1 rows to member q.
+        counts = np.zeros((n, m), np.int32)
+        for p in range(m):
+            counts[members[p]] = [(p + q) % 2 + 1 for q in range(m)]
+        tot = int(counts.sum(axis=1).max())
+        data = np.zeros((n, tot, 2), np.float32)
+        for p, r in enumerate(members):
+            off = 0
+            for q in range(m):
+                c = counts[r, q]
+                data[r, off:off + c] = 100 * p + q
+                off += c
+
+        def f(xb, cb):
+            recv, rc = cops.alltoallv(xb[0], cb[0], axes=axes,
+                                      process_set=ps, max_count=max_count)
+            return recv[None], rc[None]
+
+        fs = jax.jit(jax.shard_map(f, mesh=mesh,
+                                   in_specs=(P(axes), P(axes)),
+                                   out_specs=(P(axes), P(axes))))
+        recv, rc = map(np.asarray, fs(jnp.asarray(data),
+                                      jnp.asarray(counts)))
+        assert recv.shape == (n, m, max_count, 2)
+        for q, r in enumerate(members):
+            for p in range(m):
+                c = (p + q) % 2 + 1
+                assert rc[r][p] == c
+                np.testing.assert_allclose(recv[r][p, :c], 100 * p + q)
+                assert np.all(recv[r][p, c:] == 0)
+        for r in range(n):
+            if r not in members:
+                assert np.all(rc[r] == 0) and np.all(recv[r] == 0)
+    finally:
+        hv.remove_process_set("a2av_ps")
+
+
 def test_alltoallv_in_step_truncates_consistently(hvd, n_devices):
     """A traced count above max_count truncates the split AND clamps the
     receiver's count -- never recv_counts[j] > max_count."""
