@@ -83,6 +83,24 @@ def grouped_allreduce(tensors, average: Optional[bool] = None, name=None,
     return [_from_row(mx, o, t.context) for o, t in zip(outs, tensors)]
 
 
+def grouped_allgather(tensors, name=None, process_set=None):
+    """Reference ``hvd.grouped_allgather``: one fused gather."""
+    mx = _require_mxnet()
+    outs = _eager.grouped_allgather([_to_stack(t) for t in tensors],
+                                    name=name, process_set=process_set)
+    return [_from_row(mx, o, t.context) for o, t in zip(outs, tensors)]
+
+
+def grouped_reducescatter(tensors, op: ReduceOp = Average, name=None,
+                          process_set=None):
+    """Reference ``hvd.grouped_reducescatter``: one fused scatter."""
+    mx = _require_mxnet()
+    outs = _eager.grouped_reducescatter([_to_stack(t) for t in tensors],
+                                        op, name=name,
+                                        process_set=process_set)
+    return [_from_row(mx, o, t.context) for o, t in zip(outs, tensors)]
+
+
 def allgather(tensor, name=None, process_set=None):
     """Ragged-capable allgather (first dims may differ across ranks)."""
     mx = _require_mxnet()
