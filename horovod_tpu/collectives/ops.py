@@ -370,16 +370,17 @@ def alltoall(x,
     With a process set, members exchange their ``len(set)`` splits through
     a masked full-mesh alltoall (non-member slots carry zeros; non-members
     receive zeros).  ``x.shape[split_axis]`` must divide by the set size.
+
+    Works on flat AND hierarchical meshes: a multi-axis exchange uses the
+    row-major flattened rank order (matching :func:`axis_index`).
     """
     axes, members = _resolve(axes, process_set)
-    if len(axes) != 1:
-        raise NotImplementedError("alltoall requires a flat mesh axis")
-    a = axes[0]
+    a = axes[0] if len(axes) == 1 else axes
     if members is None:
         return lax.all_to_all(x, a, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
     m = len(members)
-    size = lax.axis_size(a)
+    size = math.prod(lax.axis_size(ax) for ax in axes)
     d = x.shape[split_axis]
     if d % m:
         raise ValueError(
@@ -435,8 +436,6 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
     nothing (their results are all-zero).
     """
     axes, members = _resolve(axes, process_set)
-    if len(axes) != 1:
-        raise NotImplementedError("alltoallv requires a flat mesh axis")
     if members is not None:
         # Subset ragged exchange over the full mesh: member counts
         # (indexed by SET position) scatter into global slots, non-member
@@ -448,15 +447,15 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
             raise ValueError(
                 f"send_counts must have shape ({m},) (one count per set "
                 f"member), got {send_counts.shape}")
-        size = lax.axis_size(axes[0])
+        size = math.prod(lax.axis_size(ax) for ax in axes)
         full = jnp.zeros((size,), jnp.int32).at[
             np.asarray(members)].set(send_counts)
         full = jnp.where(_member_mask(axes, members), full, 0)
         recv, rc = alltoallv(x, full, axes=axes, max_count=max_count)
         sel = np.asarray(members)
         return recv[sel], rc[sel]
-    a = axes[0]
-    size = lax.axis_size(a)
+    a = axes[0] if len(axes) == 1 else axes
+    size = math.prod(lax.axis_size(ax) for ax in axes)
     send_counts = jnp.asarray(send_counts, jnp.int32)
     if send_counts.shape != (size,):
         raise ValueError(

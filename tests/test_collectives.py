@@ -292,6 +292,67 @@ def test_in_step_process_set_reducescatter_average(hvd, n_devices, dtype):
         hv.remove_process_set("rs_avg")
 
 
+def test_alltoall_and_v_on_hierarchical_mesh(n_devices):
+    """alltoall/alltoallv work over a (dcn, ici) mesh: the multi-axis
+    exchange follows the row-major flattened rank order."""
+    from jax.sharding import PartitionSpec as P
+    import horovod_tpu as hvd_mod
+    from horovod_tpu.collectives import ops as cops
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    hvd_mod.shutdown()
+    mesh = build_mesh(jax.devices()[:8], hierarchical=True, dcn_size=2)
+    hvd_mod.init(mesh=mesh)
+    try:
+        axes = tuple(mesh.axis_names)
+        n = 8
+
+        def f(xb, cb):
+            a2a = cops.alltoall(xb[0], axes=axes)
+            recv, rc = cops.alltoallv(xb[0], cb[0], axes=axes, max_count=2)
+            return a2a[None], recv[None], rc[None]
+
+        x = rank_stacked(n, (n, 2), jnp.float32, seed=21)
+        counts = jnp.asarray([[1] * n] * n, jnp.int32)
+        fs = jax.jit(jax.shard_map(f, mesh=mesh,
+                                   in_specs=(P(axes), P(axes)),
+                                   out_specs=(P(axes),) * 3))
+        a2a, recv, rc = map(np.asarray, fs(x, counts))
+        xs = np.asarray(x)
+        for r in range(n):
+            np.testing.assert_allclose(
+                a2a[r], np.stack([xs[s, r] for s in range(n)]), rtol=1e-6)
+            np.testing.assert_array_equal(rc[r], np.ones(n, np.int32))
+            for s in range(n):
+                np.testing.assert_allclose(recv[r][s, 0], xs[s, r],
+                                           rtol=1e-6)
+
+        # Process-set exchange on the hierarchical mesh: member routing
+        # must follow the same row-major flattened order.
+        members = (1, 2, 5, 6)
+        m = len(members)
+        ps = hvd_mod.add_process_set(members, name="hier_ps")
+        try:
+            def g(xb):
+                return cops.alltoall(xb[0], axes=axes,
+                                     process_set=ps)[None]
+
+            gs = jax.jit(jax.shard_map(g, mesh=mesh, in_specs=P(axes),
+                                       out_specs=P(axes)))
+            x2 = rank_stacked(n, (m, 2), jnp.float32, seed=22)
+            y2 = np.asarray(gs(x2))
+            xs2 = np.asarray(x2)
+            for pos, r in enumerate(members):
+                np.testing.assert_allclose(
+                    y2[r], np.stack([xs2[s][pos] for s in members]),
+                    rtol=1e-6)
+        finally:
+            hvd_mod.remove_process_set("hier_ps")
+    finally:
+        hvd_mod.shutdown()
+        hvd_mod.init()
+
+
 def test_alltoallv_in_step_process_set(hvd, n_devices):
     """Subset ragged exchange: member counts are set-position indexed,
     non-members exchange nothing."""
