@@ -110,9 +110,27 @@ def _run(kind: str, x, name: Optional[str], ps, per_rank_fn, op_label: str,
     st = global_state()
     ps = _ps.get_process_set(ps)
     mesh = ps.flat_mesh()
-    if publish_meta is not None:
+    def _publish_abort(e: Exception) -> None:
+        _join.publish(mesh, {"kind": "abort",
+                             "message": f"{type(e).__name__}: {e}"})
+
+    if publish_meta is None:
+        arr = _to_global(x, mesh)
+    else:
+        # Join phase: drained ranks are already blocked on this op's
+        # sequence slot (their presence round matched ours).  Validate
+        # BEFORE publishing so a bad input publishes an abort record --
+        # not op metadata they would replay against a never-dispatched
+        # collective -- and publish an abort for any later dispatch
+        # failure too (best effort: a drained rank that fetched the op
+        # metadata before the overwrite lands surfaces the failure as a
+        # transport error/timeout instead).
+        try:
+            arr = _to_global(x, mesh)
+        except Exception as e:
+            _publish_abort(e)
+            raise
         _join.publish(mesh, publish_meta)
-    arr = _to_global(x, mesh)
     key = signature(kind, name, (tuple(arr.shape), str(arr.dtype)), op_label,
                     ps.name)
     timeline = st.timeline
@@ -126,14 +144,19 @@ def _run(kind: str, x, name: Optional[str], ps, per_rank_fn, op_label: str,
                           out_specs=P(HVD_AXIS))
         return jax.jit(f)
 
-    if timeline:
-        with timeline.range(name or kind, "NEGOTIATE_" + kind.upper()):
+    try:
+        if timeline:
+            with timeline.range(name or kind, "NEGOTIATE_" + kind.upper()):
+                fn = st.cache.get_or_build(key, build)
+            with timeline.range(name or kind, kind.upper()):
+                out = fn(arr)
+        else:
             fn = st.cache.get_or_build(key, build)
-        with timeline.range(name or kind, kind.upper()):
             out = fn(arr)
-    else:
-        fn = st.cache.get_or_build(key, build)
-        out = fn(arr)
+    except Exception as e:
+        if publish_meta is not None:
+            _publish_abort(e)
+        raise
     if _is_multiprocess(mesh):
         # Serialize cross-process eager collectives.  Two hazards on the
         # multi-process CPU (Gloo) backend, both observed as

@@ -62,8 +62,27 @@ _presence_cache = {}  # mesh -> compiled presence program
 
 def reset() -> None:
     """Forget join state (``hvd.shutdown()``): a re-initialized world
-    starts at generation 0 with nobody joined."""
+    starts at generation 0 with nobody joined.
+
+    Also clears THIS process's ``draining/`` flag from the coordination
+    store (a stale flag would make every later multi-process subset
+    collective raise a spurious "drained in hvd.join" error).  Broader
+    records (``last/``, ``op/``) are deliberately left alone: a recursive
+    delete here races against slower processes still reading them at
+    program exit (measured: rank 0 mid-``_read_last`` timed out after a
+    faster rank's shutdown wiped the store).  Stale non-flag records only
+    matter to a world that re-initializes against the SAME coordination
+    service after using ``hvd.join()`` -- the elastic flow rebuilds the
+    service (new port) every epoch, so this is a documented limitation of
+    user-owned same-service re-init, not a reachable path of ours.
+    """
     global _gen, _joined, _replaying
+    cl = client()
+    if cl is not None:
+        try:
+            cl.key_value_delete(_drain_key(jax.process_index()))
+        except Exception:  # pragma: no cover - old client / no such key
+            pass
     with _lock:
         _gen = 0
         _joined = False
@@ -79,8 +98,42 @@ def _op_key(seq: int) -> str:
     return f"hvd_join/{_gen}/op/{seq}"
 
 
-def _last_key() -> str:
-    return f"hvd_join/{_gen}/last"
+def _last_prefix() -> str:
+    return f"hvd_join/{_gen}/last/"
+
+
+def _last_fallback_key() -> str:
+    return f"hvd_join/{_gen}/last_fallback"
+
+
+def _drain_prefix() -> str:
+    return f"hvd_join/{_gen}/draining/"
+
+
+def _drain_key(proc: int) -> str:
+    return f"{_drain_prefix()}{proc}"
+
+
+def _kv_int(v) -> int:
+    """KV values come back as str or bytes depending on jaxlib."""
+    return int(v.decode() if isinstance(v, bytes) else v)
+
+
+def _draining_procs() -> list:
+    """Processes currently inside :func:`join_drain` (best effort).
+
+    Read from the coordination KV store; empty when the client lacks
+    ``key_value_dir_get`` (old jaxlib) -- the check then degrades to the
+    pre-round-3 silent behavior.
+    """
+    cl = client()
+    dir_get = getattr(cl, "key_value_dir_get", None)
+    if dir_get is None:  # pragma: no cover - old jaxlib
+        return []
+    try:
+        return [_kv_int(v) for _k, v in dir_get(_drain_prefix())]
+    except Exception:  # pragma: no cover - store raced with _gen bump
+        return []
 
 
 def _timeout_ms() -> int:
@@ -132,7 +185,27 @@ def sync(ps) -> Optional[np.ndarray]:
 
     if _replaying or _joined:
         return None
-    if not ps.is_global() or client() is None:
+    if client() is None:
+        return None
+    if not ps.is_global():
+        # Join draining runs on the GLOBAL set only (reference restricts
+        # Join the same way).  A multi-process SUBSET collective issued
+        # while some member process is drained would deadlock: the drained
+        # process sits in a global-mesh presence psum, the survivors wait
+        # on the member-only sub-mesh program.  Fail loudly instead
+        # (best-effort: a process entering join_drain concurrently with
+        # this check can still slip through and hit HOROVOD_JOIN_TIMEOUT).
+        mesh = ps.flat_mesh()
+        if eager._is_multiprocess(mesh):
+            members = {d.process_index for d in mesh.devices.flat}
+            draining = sorted(members.intersection(_draining_procs()))
+            if draining:
+                raise RuntimeError(
+                    f"eager collective on process set {ps.name!r} while "
+                    f"member process(es) {draining} are drained in "
+                    f"hvd.join(): join draining only covers the global "
+                    f"process set; finish the join before issuing subset "
+                    f"collectives")
         return None
     mesh = ps.flat_mesh()
     if not eager._is_multiprocess(mesh):
@@ -229,12 +302,25 @@ def join_drain(mesh) -> int:
 
     cl = client()
     positions = eager._local_member_positions(_ps.get_process_set(None))
-    # Last KV writer ~ last joiner (every write happens before its
-    # writer's first inactive presence round, so all processes read the
-    # same settled value after the mask drains to zero).  A process's
-    # ranks join together; report its highest (reference "last rank").
-    cl.key_value_set(_last_key(), str(positions[-1]), allow_overwrite=True)
     procs = tuple(sorted({d.process_index for d in mesh.devices.flat}))
+    # Record WHEN this process joined: the fence sequence the next
+    # collective would use.  Two processes joining between the same pair
+    # of presence rounds get the same seq; the tie breaks on rank, so
+    # every reader resolves the same "last rank to join" (reference
+    # controller behavior).  A process's ranks join together; report its
+    # highest.  Every write happens before its writer's first inactive
+    # presence round, so all writes are visible once the mask drains to
+    # zero.
+    join_seq = eager._peek_next_seq(procs)
+    cl.key_value_set(f"{_last_prefix()}{join_seq:012d}_{positions[-1]:012d}",
+                     str(positions[-1]), allow_overwrite=True)
+    # Old-jaxlib fallback (no key_value_dir_get): a single overwritten
+    # key -- last-writer-wins, the pre-round-3 nondeterministic-on-ties
+    # behavior, better than failing the join outright.
+    cl.key_value_set(_last_fallback_key(), str(positions[-1]),
+                     allow_overwrite=True)
+    cl.key_value_set(_drain_key(jax.process_index()),
+                     str(jax.process_index()), allow_overwrite=True)
     _joined = True
     try:
         while True:
@@ -246,7 +332,29 @@ def join_drain(mesh) -> int:
             _replay(json.loads(raw))
     finally:
         _joined = False
-    last = int(cl.blocking_key_value_get(_last_key(), _timeout_ms()))
+        # An exception exit (abort replay, KV timeout) leaves _gen
+        # un-bumped: clear the drain flag so a survived error does not
+        # make every later subset collective raise "drained in hvd.join".
+        try:
+            cl.key_value_delete(_drain_key(jax.process_index()))
+        except Exception:  # pragma: no cover - old client / already gone
+            pass
+    last = _read_last(cl)
     with _lock:
         _gen += 1
     return last
+
+
+def _read_last(cl) -> int:
+    """Deterministic "last rank to join": max (join_seq, rank) over every
+    joiner's record.  Keys are fixed-width so the lexicographic max IS the
+    numeric max; falls back to the single last-writer-wins key when dir
+    listing is unavailable (old jaxlib)."""
+    dir_get = getattr(cl, "key_value_dir_get", None)
+    if dir_get is not None:
+        entries = dir_get(_last_prefix())
+        if entries:
+            _k, v = max(entries, key=lambda kv: kv[0])
+            return _kv_int(v)
+    return _kv_int(cl.blocking_key_value_get(_last_fallback_key(),
+                                             _timeout_ms()))

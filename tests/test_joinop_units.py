@@ -50,3 +50,79 @@ def test_replay_rejects_unknown_kind(hvd):
 def test_replay_abort_raises_with_message(hvd):
     with pytest.raises(RuntimeError, match="root has left"):
         joinop._replay({"kind": "abort", "message": "root has left"})
+
+
+class _FakeKV:
+    """Dict-backed stand-in for the coordination-service client."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, k, v, allow_overwrite=False):
+        self.store[k] = v
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in sorted(self.store.items())
+                if k.startswith(prefix)]
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        return self.store[k]
+
+
+def test_read_last_max_seq_then_max_rank(hvd):
+    """Last joiner resolves deterministically: max join seq, ties on rank
+    (two processes joining between the same presence rounds)."""
+    kv = _FakeKV()
+    kv.key_value_set(f"{joinop._last_prefix()}{2:012d}_{5:012d}", "5")
+    kv.key_value_set(f"{joinop._last_prefix()}{3:012d}_{1:012d}", "1")
+    kv.key_value_set(f"{joinop._last_prefix()}{3:012d}_{2:012d}", "2")
+    assert joinop._read_last(kv) == 2
+
+
+def test_read_last_fallback_without_dir_get(hvd):
+    """Old jaxlib (no key_value_dir_get): the single last-writer-wins
+    fallback key still resolves the join."""
+
+    class Bare:
+        def __init__(self, store):
+            self.store = store
+
+        def blocking_key_value_get(self, k, timeout_ms):
+            return self.store[k]
+
+    assert joinop._read_last(Bare({joinop._last_fallback_key(): "3"})) == 3
+
+
+def test_read_last_decodes_bytes(hvd):
+    kv = _FakeKV()
+    kv.key_value_set(f"{joinop._last_prefix()}{1:012d}_{4:012d}", b"4")
+    assert joinop._read_last(kv) == 4
+
+
+def test_subset_collective_raises_while_draining(hvd, monkeypatch):
+    """A multi-process subset eager collective while some process is
+    drained in hvd.join() fails loudly (reference: Join covers the global
+    set only) instead of deadlocking on mismatched presence rounds."""
+    import horovod_tpu as hv
+    from horovod_tpu.collectives import eager
+    from horovod_tpu.core import process_sets as ps_mod
+
+    hv.add_process_set([0, 1, 2], name="sub_join")
+    try:
+        ps = ps_mod.get_process_set("sub_join")
+        kv = _FakeKV()
+        monkeypatch.setattr(joinop, "client", lambda: kv)
+        monkeypatch.setattr(eager, "_is_multiprocess", lambda mesh: True)
+        # Nothing draining: the subset dispatch skips join handling.
+        assert joinop.sync(ps) is None
+        # A drained process that is NOT a member of the subset cannot
+        # deadlock it (its presence psum shares no Gloo pairs with a
+        # survivors-only program) -- no error.
+        kv.key_value_set(joinop._drain_key(5), "5")
+        assert joinop.sync(ps) is None
+        # A drained MEMBER process deadlocks the subset program: raise.
+        kv.key_value_set(joinop._drain_key(0), "0")
+        with pytest.raises(RuntimeError, match="drained in hvd.join"):
+            joinop.sync(ps)
+    finally:
+        hv.remove_process_set("sub_join")
