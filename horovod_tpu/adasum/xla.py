@@ -25,6 +25,7 @@ from __future__ import annotations
 import math
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 _TOL = 1e-30
@@ -97,7 +98,7 @@ def adasum_local_tree(vectors):
                  adasum_local_tree(vectors[half:]))
 
 
-def adasum_allreduce(x, axis: str = "hvd"):
+def adasum_allreduce(x, axis: str = "hvd", members=None):
     """Adasum-allreduce ``x`` across the (power-of-two) flat mesh axis.
 
     Vector-halving distance-doubling (the reference's ``adasum.h``
@@ -110,30 +111,49 @@ def adasum_allreduce(x, axis: str = "hvd"):
     over the group (an all_gather of 3 floats per level -- the analogue of
     the reference's per-level MPI scalar allreduce, negligible bytes).  A
     reverse-order distance-halving allgather rebuilds the full vector.
+
+    ``members`` (static tuple of global ranks, power-of-two count): run the
+    SAME schedule among the members only -- the masked-VHDD process-set
+    variant.  The permutes pair members by their position in the tuple, so
+    bytes stay O(n) per member regardless of subset or mesh size (replacing
+    a gather-everything-everywhere approach that moved O(mesh * n)).
+    Non-member devices trace the same program but their ppermute slots
+    receive zeros and their scalar partials are masked out of the group
+    sums; their output is GARBAGE -- the caller masks it back to the
+    original input (``ops.allreduce`` does).
     """
     n = lax.axis_size(axis)
-    if n & (n - 1) != 0:
-        raise ValueError(f"Adasum requires a power-of-two world size, got {n}")
-    if n == 1:
+    if members is None:
+        members = tuple(range(n))
+    m = len(members)
+    if m & (m - 1) != 0:
+        raise ValueError(f"Adasum requires a power-of-two member count, "
+                         f"got {m}")
+    if m == 1:
         return x
+    pos_table = np.zeros((n,), np.int32)        # rank -> member position
+    is_member = np.zeros((n,), bool)
+    for p, r in enumerate(members):
+        pos_table[r] = p
+        is_member[r] = True
     idx = lax.axis_index(axis)
-    levels = int(math.log2(n))
+    pos = jnp.asarray(pos_table)[idx]
+    levels = int(math.log2(m))
     shape = x.shape
     flat = x.ravel()
-    pad = (-flat.size) % n  # divisible by 2 at every halving level
+    pad = (-flat.size) % m  # divisible by 2 at every halving level
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     y = flat  # my piece of my (size-2^k) group's combined vector
-    ranks = jnp.arange(n)
     for k in range(levels):
         bit = 1 << k
-        perm = [(i, i ^ bit) for i in range(n)]
+        perm = [(members[i], members[i ^ bit]) for i in range(m)]
         half = y.shape[0] // 2
-        is_lo = (idx & bit) == 0
+        is_lo = (pos & bit) == 0
         first, second = y[:half], y[half:]
-        # Lower rank keeps the first half; partner (same position within
-        # its group) keeps the second -- retained pieces stay aligned on
-        # the same global index range by induction.
+        # Lower position keeps the first half; partner (same position
+        # within its group) keeps the second -- retained pieces stay
+        # aligned on the same global index range by induction.
         mine = jnp.where(is_lo, first, second)
         give = jnp.where(is_lo, second, first)
         recv = lax.ppermute(give, axis, perm)
@@ -144,7 +164,12 @@ def adasum_allreduce(x, axis: str = "hvd"):
         partial = jnp.stack([jnp.dot(a32, b32), jnp.dot(a32, a32),
                              jnp.dot(b32, b32)])
         dots_all = lax.all_gather(partial, axis, axis=0)     # [n, 3]
-        in_group = ((ranks >> (k + 1)) == (idx >> (k + 1)))
+        # Ranks in my merged group: members whose position shares my
+        # position's high bits.  The static membership mask excludes
+        # non-member rows of dots_all, so their garbage partials never
+        # contaminate a member's group sum.
+        group_of_rank = jnp.asarray(pos_table >> (k + 1))
+        in_group = jnp.asarray(is_member) & (group_of_rank == (pos >> (k + 1)))
         dot, anormsq, bnormsq = jnp.sum(
             jnp.where(in_group[:, None], dots_all, 0.0), axis=0)
         acoeff = jnp.where(anormsq < _TOL, 1.0, 1.0 - dot / (2.0 * anormsq))
@@ -154,8 +179,8 @@ def adasum_allreduce(x, axis: str = "hvd"):
     # Distance-halving allgather, inverting the split order.
     for k in reversed(range(levels)):
         bit = 1 << k
-        perm = [(i, i ^ bit) for i in range(n)]
-        is_lo = (idx & bit) == 0
+        perm = [(members[i], members[i ^ bit]) for i in range(m)]
+        is_lo = (pos & bit) == 0
         recv = lax.ppermute(y, axis, perm)
         y = jnp.where(is_lo, jnp.concatenate([y, recv]),
                       jnp.concatenate([recv, y]))

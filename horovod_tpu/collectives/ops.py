@@ -167,16 +167,25 @@ def allreduce(x,
                                   adasum_allreduce_hierarchical,
                                   adasum_local_tree)
         if members is not None:
-            # Subset Adasum: gather member vectors, then run the same
-            # binary-tree mixing locally on every device (compute is
-            # replicated, comm is one gather -- fine at subset scale; the
-            # global path below stays bandwidth-optimal).
             if len(members) & (len(members) - 1) != 0:
                 raise ValueError(
                     f"Adasum requires a power-of-two member count, got "
                     f"{len(members)}")
-            sel = _gather_rows(x, axes)[np.asarray(members)]
-            y = adasum_local_tree([sel[i] for i in range(len(members))])
+            if len(axes) == 1:
+                # Masked VHDD over the full flat mesh: the same
+                # vector-halving schedule paired by member POSITION, so
+                # subset Adasum moves O(n) bytes per member like the
+                # global path (was: gather O(mesh * n) everywhere + a
+                # replicated local tree).
+                y = adasum_allreduce(x, axis=axes[0], members=members)
+            else:
+                # Hierarchical (multi-axis) mesh: ppermute needs a flat
+                # axis, so the subset falls back to gather + replicated
+                # binary tree -- O(mesh * n) bytes, fine for the small
+                # sets this path serves.
+                sel = _gather_rows(x, axes)[np.asarray(members)]
+                y = adasum_local_tree([sel[i]
+                                       for i in range(len(members))])
         elif len(axes) == 1:
             y = adasum_allreduce(x, axis=axes[0])
         elif len(axes) == 2:
@@ -401,7 +410,8 @@ def alltoall(x,
 
 
 def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
-              process_set=None, max_count: int):
+              process_set=None, max_count: int,
+              return_overflow: bool = False):
     """Uneven alltoall (padded alltoallv; NCCLAlltoall with ``splits``).
 
     The reference exchanges ragged splits directly (its negotiation shares
@@ -421,19 +431,27 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
         exceeding it is truncated: only the first ``max_count`` rows of
         that split transfer and the receiver's count reports the clamped
         value (size your bound for the worst case, like an MoE capacity
-        factor).
+        factor).  The reference ERRORS on inconsistent splits and never
+        drops rows; request ``return_overflow=True`` to detect truncation
+        (dropped tokens in an MoE exchange are otherwise invisible).
+      return_overflow: also return the per-sender count of rows DROPPED by
+        clamping.  Costs nothing extra: the original counts ride the same
+        counts collective as the clamped ones.
 
     Returns:
       ``(recv, recv_counts)``: ``recv[j]`` is ``[max_count, ...]`` holding
       the split received from rank ``j`` (zero-padded past
       ``recv_counts[j]``); ``recv_counts`` is ``[size]``, every entry
-      ``<= max_count``.
+      ``<= max_count``.  With ``return_overflow=True``, a third element
+      ``overflow`` ([size] int32): ``overflow[j]`` rows addressed to this
+      device by rank ``j`` were dropped (0 everywhere means the exchange
+      was lossless).
 
     With a process set, ``send_counts`` is indexed by SET position (one
     count per member, splits concatenated in member order) and the
     results cover members only: ``recv`` is ``[len(set), max_count, ...]``
-    and ``recv_counts`` is ``[len(set)]``.  Non-member devices exchange
-    nothing (their results are all-zero).
+    and ``recv_counts``/``overflow`` are ``[len(set)]``.  Non-member
+    devices exchange nothing (their results are all-zero).
     """
     axes, members = _resolve(axes, process_set)
     if members is not None:
@@ -451,9 +469,10 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
         full = jnp.zeros((size,), jnp.int32).at[
             np.asarray(members)].set(send_counts)
         full = jnp.where(_member_mask(axes, members), full, 0)
-        recv, rc = alltoallv(x, full, axes=axes, max_count=max_count)
         sel = np.asarray(members)
-        return recv[sel], rc[sel]
+        out = alltoallv(x, full, axes=axes, max_count=max_count,
+                        return_overflow=return_overflow)
+        return tuple(o[sel] for o in out)
     a = axes[0] if len(axes) == 1 else axes
     size = math.prod(lax.axis_size(ax) for ax in axes)
     send_counts = jnp.asarray(send_counts, jnp.int32)
@@ -464,7 +483,8 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
     # Offsets follow the caller's layout (the ORIGINAL counts); a split
     # larger than max_count is truncated to max_count rows, and the clamped
     # count is what the receiver sees -- overflow loses the tail but stays
-    # internally consistent (recv_counts[j] <= max_count always).
+    # internally consistent (recv_counts[j] <= max_count always), and is
+    # reported via ``return_overflow``.
     offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(send_counts)[:-1]])
     clamped = jnp.minimum(send_counts, max_count)
@@ -480,8 +500,13 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
     valid = valid.reshape(valid.shape + (1,) * (x.ndim - 1))
     pieces = jnp.where(valid, pieces, jnp.zeros((), x.dtype))
     recv = lax.all_to_all(pieces, a, split_axis=0, concat_axis=0, tiled=True)
-    recv_counts = lax.all_to_all(clamped, a, split_axis=0, concat_axis=0,
-                                 tiled=True)
+    # One counts collective carries BOTH the clamped and the original
+    # counts ([size, 2] rows), so overflow detection is free.
+    pair = lax.all_to_all(jnp.stack([clamped, send_counts], axis=1), a,
+                          split_axis=0, concat_axis=0, tiled=True)
+    recv_counts = pair[:, 0]
+    if return_overflow:
+        return recv, recv_counts, pair[:, 1] - pair[:, 0]
     return recv, recv_counts
 
 

@@ -236,6 +236,54 @@ def test_alltoallv_in_step_traced_counts(hvd, n_devices):
             off += c
 
 
+def test_alltoallv_overflow_is_detectable(hvd, n_devices):
+    """A traced split exceeding max_count truncates (capacity-factor
+    semantics) -- and return_overflow reports exactly how many rows each
+    sender dropped, so the loss is detectable (the reference errors on
+    inconsistent splits and never drops silently)."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    n = n_devices
+    max_count = 2
+    # Rank s sends (i % 4) rows to peer i: splits of 3 overflow by 1.
+    splits = np.asarray([[i % 4 for i in range(n)]] * n, np.int32)
+    tot = int(splits[0].sum())
+    # Row values encode (sender, destination, position) for verification.
+    datas = np.zeros((n, tot, 1), np.float32)
+    for s in range(n):
+        off = 0
+        for i in range(n):
+            for p in range(splits[s, i]):
+                datas[s, off] = s * 1000 + i * 10 + p
+                off += 1
+
+    def f(x, c):
+        recv, rc, ov = cops.alltoallv(x[0], c[0], axes=axes,
+                                      max_count=max_count,
+                                      return_overflow=True)
+        return recv[None], rc[None], ov[None]
+
+    fs = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(axes), P(axes)),
+        out_specs=(P(axes),) * 3))
+    recv, rc, ov = map(np.asarray, fs(jnp.asarray(datas),
+                                      jnp.asarray(splits)))
+    for r in range(n):
+        want = min(r % 4, max_count)
+        np.testing.assert_array_equal(rc[r], np.full(n, want, np.int32))
+        # overflow[j] = rows sender j dropped for me; zero iff lossless.
+        np.testing.assert_array_equal(
+            ov[r], np.full(n, (r % 4) - want, np.int32))
+        for s in range(n):
+            # The FIRST `want` rows of the split survive.
+            np.testing.assert_allclose(
+                recv[r, s, :want, 0],
+                [s * 1000 + r * 10 + p for p in range(want)], rtol=1e-6)
+
+
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
 def test_alltoallv_eager_dtype_sweep(hvd, n_devices, dtype):
     n = n_devices
@@ -402,6 +450,60 @@ def test_alltoallv_in_step_process_set(hvd, n_devices):
                 assert np.all(rc[r] == 0) and np.all(recv[r] == 0)
     finally:
         hv.remove_process_set("a2av_ps")
+
+
+def test_alltoallv_process_set_overflow(hvd, n_devices):
+    """return_overflow through the masked subset path: member at set
+    position 0 over-sends to everyone; receivers see the dropped-row
+    counts, non-members stay all-zero."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    n = n_devices
+    members = (1, 2, 5)
+    m = len(members)
+    ps = hv.add_process_set(members, name="a2av_ov")
+    try:
+        max_count = 2
+        # Position 0 sends 3 rows to every member (overflow 1); the other
+        # positions send 1 row each.
+        counts = np.zeros((n, m), np.int32)
+        for p, r in enumerate(members):
+            counts[r] = [3] * m if p == 0 else [1] * m
+        tot = int(counts.sum(axis=1).max())
+        data = np.zeros((n, tot, 1), np.float32)
+        for p, r in enumerate(members):
+            off = 0
+            for q in range(m):
+                c = counts[r, q]
+                data[r, off:off + c, 0] = [100 * p + 10 * q + i
+                                           for i in range(c)]
+                off += c
+
+        def f(xb, cb):
+            recv, rc, ov = cops.alltoallv(
+                xb[0], cb[0], axes=axes, process_set=ps,
+                max_count=max_count, return_overflow=True)
+            return recv[None], rc[None], ov[None]
+
+        fs = jax.jit(jax.shard_map(f, mesh=mesh,
+                                   in_specs=(P(axes), P(axes)),
+                                   out_specs=(P(axes),) * 3))
+        recv, rc, ov = map(np.asarray, fs(jnp.asarray(data),
+                                          jnp.asarray(counts)))
+        for q, r in enumerate(members):
+            np.testing.assert_array_equal(rc[r], [2, 1, 1])
+            np.testing.assert_array_equal(ov[r], [1, 0, 0])
+            # Position 0's split truncates to its FIRST max_count rows.
+            np.testing.assert_allclose(recv[r][0, :, 0],
+                                       [10 * q, 10 * q + 1])
+        for r in range(n):
+            if r not in members:
+                assert np.all(ov[r] == 0) and np.all(rc[r] == 0)
+    finally:
+        hv.remove_process_set("a2av_ov")
 
 
 def test_alltoallv_in_step_truncates_consistently(hvd, n_devices):

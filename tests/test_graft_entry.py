@@ -34,13 +34,17 @@ def test_full_dryrun_multichip():
 
 
 @pytest.mark.slow
-def test_dryrun_multichip_driver_invocation():
+@pytest.mark.parametrize("n", [8, 16, 32])
+def test_dryrun_multichip_driver_invocation(n):
     """Reproduce the driver's exact call: a FRESH process with neither
     XLA_FLAGS nor JAX_PLATFORMS set (no conftest help), so the entry itself
-    must force the 8-device virtual CPU mesh before backend init.
+    must force the n-device virtual CPU mesh before backend init.
 
     Round 1 failed exactly here: the entry probed jax.devices() first,
     initializing the 1-device backend, and the CPU fallback saw 1 device.
+    n=16/32 additionally cover mesh-factorization edge cases (VHDD levels,
+    dcn factoring, 5-axis extents) beyond the driver's n=8 gate before
+    real hardware ever sees them.
     """
     import os
     import subprocess
@@ -51,7 +55,7 @@ def test_dryrun_multichip_driver_invocation():
     repo = dirname(dirname(abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, "-c",
-         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+         f"import __graft_entry__; __graft_entry__.dryrun_multichip({n})"],
         cwd=repo, env=env, capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
-    assert "dryrun_multichip(8)" in proc.stdout
+    assert f"dryrun_multichip({n})" in proc.stdout
