@@ -177,9 +177,7 @@ class _ShardWriter:
                 self._flush_rank(r)
 
     def _write_npz(self, path: str, feats, labels) -> None:
-        buf = io.BytesIO()
-        np.savez(buf, features=feats, labels=labels)
-        self.store.write(path, buf.getvalue())
+        _npz_write(self.store, path, feats, labels)
 
     def _flush_rank(self, r: int) -> None:
         if not self.buf_rows[r]:
@@ -232,6 +230,152 @@ class _ShardWriter:
         total_val = self.seen - self.train_seen
         self._flush_val()
         return total_val
+
+
+def _npz_write(store: Store, path: str, feats, labels) -> None:
+    buf = io.BytesIO()
+    np.savez(buf, features=feats, labels=labels)
+    store.write(path, buf.getvalue())
+
+
+def _executor_partition_writer(store: Store, feature_cols, label_cols,
+                               num_proc: int, val_threshold,
+                               chunk_rows: int = _CHUNK_ROWS):
+    """Build the ``mapPartitionsWithIndex`` task that writes one
+    partition's rows straight to the Store from the executor.
+
+    Rows are consumed in bounded sub-chunks (executor memory stays
+    O(chunk_rows)); the validation stripe uses the same multiplicative
+    hash as the driver path, keyed by a 64-bit (partition, ordinal)
+    index so the split is deterministic without any global row count.
+    Train rows round-robin over ranks with a per-partition rotating
+    offset, so rank totals stay within one row per partition of equal.
+    Yields ``(kind, rank, path, rows)`` records for the driver to
+    aggregate and equalize.
+    """
+
+    def task(pid: int, rows):
+        import itertools
+
+        results = []
+        seen = 0
+        train_seen = 0
+        sub = 0
+        rows = iter(rows)
+        while True:
+            buf = list(itertools.islice(rows, chunk_rows))
+            if not buf:
+                break
+            import pandas as pd
+            pdf = pd.DataFrame(
+                [r.asDict() if hasattr(r, "asDict") else r for r in buf])
+            arrs = _as_arrays(pdf, feature_cols, label_cols)
+            feats, labels = arrs["features"], arrs["labels"]
+            n = len(feats)
+            # Mix the partition id into the LOW 32 bits (a high shift
+            # would vanish under the 32-bit mask, making every partition
+            # reuse one per-ordinal pattern -- and always send ordinal 0
+            # to validation).  Both constants are odd, so each term is a
+            # bijection mod 2^32.
+            ordinals = np.arange(seen + 1, seen + n + 1, dtype=np.uint64)
+            h = (ordinals * _HASH_MULT
+                 + np.uint64(pid) * np.uint64(2246822519)) & _HASH_MASK
+            seen += n
+            val_mask = h < val_threshold
+            if val_mask.any():
+                path = (f"{store.get_val_data_path()}"
+                        f".chunk{pid:07d}_{sub:03d}")
+                _npz_write(store, path, feats[val_mask], labels[val_mask])
+                results.append(("val", -1, path, int(val_mask.sum())))
+            tf_, tl = feats[~val_mask], labels[~val_mask]
+            ranks = (pid + train_seen + np.arange(len(tf_))) % num_proc
+            train_seen += len(tf_)
+            for r in range(num_proc):
+                sel = ranks == r
+                if sel.any():
+                    path = (f"{store.get_train_data_path(r)}"
+                            f".chunk{pid:07d}_{sub:03d}")
+                    _npz_write(store, path, tf_[sel], tl[sel])
+                    results.append(("train", r, path, int(sel.sum())))
+            sub += 1
+        return iter(results)
+
+    return task
+
+
+def _trim_rank_to(store: Store, chunks: List, excess: int) -> None:
+    """Drop ``excess`` rows from the END of a rank's chunk list (rewrite
+    or delete tail chunks)."""
+    while excess > 0:
+        path, count = chunks[-1]
+        if count <= excess:
+            store.delete(path)
+            chunks.pop()
+            excess -= count
+            continue
+        with np.load(io.BytesIO(store.read(path)), allow_pickle=False) as z:
+            _npz_write(store, path, z["features"][:-excess],
+                       z["labels"][:-excess])
+        chunks[-1] = (path, count - excess)
+        excess = 0
+
+
+def _write_shards_on_executors(store: Store, df, feature_cols, label_cols,
+                               num_proc: int,
+                               val_fraction: float) -> Optional[int]:
+    """Materialize the rank shards FROM THE EXECUTORS, in parallel.
+
+    Reference behavior (SURVEY.md 3.6): Petastorm materializes the
+    DataFrame by writing parquet from the Spark workers; the driver never
+    streams the rows.  Here each partition task writes its own Store
+    chunks (requires ``store.executor_writable`` -- shared FS / object
+    store) and returns (rank, rows) records; the driver only aggregates
+    the records and trims tail chunks so every rank shard has EQUAL
+    length (collective step counts must match across workers).
+
+    Returns the validation row count, or ``None`` when the input is not
+    an RDD-bearing DataFrame or the store is not executor-writable (the
+    caller falls back to the streamed driver path).
+    """
+    rdd = getattr(df, "rdd", None)
+    if rdd is None or not hasattr(rdd, "mapPartitionsWithIndex"):
+        return None
+    if not getattr(store, "executor_writable", False):
+        return None
+    _clean_intermediate(store, num_proc)
+    thresh = np.uint64(int(val_fraction * float(2 ** 32)))
+    task = _executor_partition_writer(store, feature_cols, label_cols,
+                                      num_proc, thresh)
+    records = list(rdd.mapPartitionsWithIndex(task).collect())
+    train_rows = [0] * num_proc
+    val_rows = 0
+    by_rank: Dict[int, List] = {r: [] for r in range(num_proc)}
+    for kind, r, path, count in records:
+        if kind == "val":
+            val_rows += count
+        else:
+            train_rows[r] += count
+            by_rank[r].append((path, count))
+    total = sum(train_rows)
+    if total < num_proc:
+        raise ValueError(f"{total} training rows < num_proc={num_proc}")
+    # Equal shard lengths are a correctness requirement (step counts
+    # derive from shard length); trim every rank to the smallest -- the
+    # per-partition rotating round-robin bounds the loss to at most one
+    # row per partition per rank.
+    target = min(train_rows)
+    if target == 0:
+        # Possible with more ranks than rows-per-partition spread; an
+        # empty shard would crash its worker, and trimming everyone to
+        # zero destroys the dataset.
+        raise ValueError(
+            f"executor materialization left rank(s) with zero rows "
+            f"(per-rank counts {train_rows}); use fewer workers or "
+            f"repartition the DataFrame")
+    for r in range(num_proc):
+        by_rank[r].sort(key=lambda pc: pc[0])
+        _trim_rank_to(store, by_rank[r], train_rows[r] - target)
+    return val_rows
 
 
 def _clean_intermediate(store: Store, num_proc: int) -> None:
@@ -348,6 +492,10 @@ class EstimatorParams:
     run_id: Optional[str] = None
     verbose: int = 1
     backend: str = "local"  # "local" (spawned procs) or "spark" (barrier)
+    # Write intermediate shards from the Spark executors (Petastorm-style
+    # parallel materialization) when the input has an RDD and the store is
+    # executor-writable; falls back to the streamed driver path otherwise.
+    materialize_on_executors: bool = True
 
 
 class _EstimatorBase:
@@ -363,8 +511,23 @@ class _EstimatorBase:
         store = p.store or LocalStore(os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "hvd_tpu_estimator"))
         run_id = p.run_id or f"run_{uuid.uuid4().hex[:8]}"
-        chunks = _iter_chunks(df, p.feature_cols, p.label_cols)
-        _write_shards(store, chunks, p.num_proc, p.validation)
+        val_rows = None
+        if p.materialize_on_executors:
+            try:
+                val_rows = _write_shards_on_executors(
+                    store, df, p.feature_cols, p.label_cols, p.num_proc,
+                    p.validation)
+            except ValueError:
+                raise           # too few rows: not a fallback situation
+            except Exception:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "executor-parallel materialization failed; falling "
+                    "back to the streamed driver path", exc_info=True)
+                val_rows = None
+        if val_rows is None:
+            chunks = _iter_chunks(df, p.feature_cols, p.label_cols)
+            _write_shards(store, chunks, p.num_proc, p.validation)
         spec = dict(self._make_worker_spec(),
                     store_prefix=store.prefix_path,
                     run_id=run_id, num_proc=p.num_proc,

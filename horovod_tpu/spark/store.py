@@ -17,6 +17,12 @@ from typing import Optional
 class Store:
     """Abstract storage layout: run-scoped checkpoint/log/data prefixes."""
 
+    #: True when executor/worker processes can write the store's paths
+    #: directly (shared filesystem, object store) -- enables the
+    #: executor-parallel shard materialization (SURVEY.md 3.6: Petastorm
+    #: writes shards from Spark workers, not through the driver).
+    executor_writable = False
+
     def __init__(self, prefix_path: str):
         self.prefix_path = prefix_path
 
@@ -71,7 +77,14 @@ class Store:
 
 
 class LocalStore(Store):
-    """Local-filesystem store (the reference's ``FilesystemStore``)."""
+    """Local-filesystem store (the reference's ``FilesystemStore``).
+
+    ``executor_writable`` assumes the path is reachable from every
+    executor -- true for local-mode Spark and for NFS-style shared
+    mounts, the same assumption the reference's FilesystemStore makes.
+    """
+
+    executor_writable = True
 
     def exists(self, path: str) -> bool:
         return os.path.exists(path)

@@ -224,6 +224,157 @@ def test_write_shards_streams_without_materializing(tmp_path):
     assert len(np.unique(got)) == len(got)
 
 
+class _FakeRow:
+    def __init__(self, d):
+        self._d = d
+
+    def asDict(self):
+        return dict(self._d)
+
+
+class _FakeCollected:
+    def __init__(self, items):
+        self._items = items
+
+    def collect(self):
+        return self._items
+
+
+class _FakeRDD:
+    """Executes the partition task per 'executor' (sequentially here) --
+    the shape of pyspark's RDD.mapPartitionsWithIndex().collect()."""
+
+    def __init__(self, parts):
+        self.parts = parts
+
+    def mapPartitionsWithIndex(self, fn):
+        out = []
+        for i, part in enumerate(self.parts):
+            out.extend(fn(i, iter(part)))
+        return _FakeCollected(out)
+
+
+class _FakeSparkDF:
+    """Spark-DataFrame stand-in: partitioned rows behind an .rdd; the
+    driver-streaming path is booby-trapped so tests prove it is unused."""
+
+    def __init__(self, parts):
+        self.rdd = _FakeRDD(parts)
+        self.sparkSession = object()
+
+    def toLocalIterator(self):
+        raise AssertionError("driver streaming must not be used when the "
+                             "executor path is available")
+
+
+def _fake_spark_blobs(n=64, n_parts=5, seed=0):
+    rng = np.random.RandomState(seed)
+    x, y = _blobs(n=n, d=2)
+    x = x.astype(np.float64)  # Spark rows carry Python floats
+    order = rng.permutation(n)
+    rows = [_FakeRow({"x0": float(x[i, 0]), "x1": float(x[i, 1]),
+                      "label": int(y[i])}) for i in order]
+    # Deliberately unequal partitions.
+    cuts = sorted(rng.choice(range(1, n), n_parts - 1, replace=False))
+    parts = np.split(np.arange(n), cuts)
+    return _FakeSparkDF([[rows[i] for i in p] for p in parts]), x, y
+
+
+def test_executor_parallel_materialization(tmp_path):
+    """SURVEY.md 3.6 (Petastorm writes shards from Spark workers): N
+    unequal partitions materialize Store shards through the partition
+    tasks -- the driver never iterates rows -- with equal-length rank
+    shards, every kept row exactly once, and a working val stripe."""
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.estimator import (_load_shard,
+                                             _write_shards_on_executors)
+
+    df, x, y = _fake_spark_blobs(n=97, n_parts=6)
+    store = LocalStore(str(tmp_path))
+    num_proc = 3
+    val = _write_shards_on_executors(store, df, ["x0", "x1"], ["label"],
+                                     num_proc, val_fraction=0.1)
+    assert val is not None and 0 < val < 40
+    shards = [_load_shard(store, store.get_train_data_path(r))
+              for r in range(num_proc)]
+    lens = [len(s["features"]) for s in shards]
+    assert len(set(lens)) == 1, lens              # equal-length shards
+    total_train = sum(lens)
+    # Accounting: train + val <= all rows, and the equalization trim
+    # loses less than one row per partition per rank.
+    assert 97 - val - 6 * num_proc <= total_train <= 97 - val
+    vals = _load_shard(store, store.get_val_data_path())
+    # Every (feature, label) row in the shards is a real input row and no
+    # train row is duplicated.
+    rows_seen = np.concatenate([s["features"] for s in shards])
+    assert len(np.unique(rows_seen, axis=0)) == len(rows_seen)
+    all_rows = {tuple(r) for r in x}
+    for r_ in rows_seen:
+        assert tuple(r_) in all_rows
+    for r_ in vals["features"]:
+        assert tuple(r_) in all_rows
+
+
+def test_executor_materialization_matches_driver_training(tmp_path):
+    """End-to-end fit() through the executor path trains to the same
+    quality as the driver-streamed path on the same data."""
+    from horovod_tpu.spark import JaxEstimator, LocalStore
+
+    df, x, y = _fake_spark_blobs(n=64, n_parts=4)
+    est = JaxEstimator(model=_FlaxMLP(), loss="xent", lr=0.05,
+                       num_proc=2, batch_size=8, epochs=12,
+                       feature_cols=["x0", "x1"], label_cols=["label"],
+                       store=LocalStore(str(tmp_path)))
+    fitted = est.fit(df)     # _FakeSparkDF raises if the driver streams
+    assert fitted.history[-1] < fitted.history[0]
+    preds = fitted.transform(x).argmax(-1)
+    assert (preds == y).mean() > 0.8
+
+
+def test_executor_val_hash_mixes_partition_id(tmp_path):
+    """Regression: a high-bit-shifted partition key vanishes under the
+    32-bit hash mask, sending every partition's FIRST row to validation
+    and reusing one per-ordinal pattern across partitions.  With a tiny
+    fraction, far fewer than one row per partition must be selected."""
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.estimator import _write_shards_on_executors
+
+    df, _x, _y = _fake_spark_blobs(n=97, n_parts=6)
+    store = LocalStore(str(tmp_path))
+    val = _write_shards_on_executors(store, df, ["x0", "x1"], ["label"],
+                                     2, val_fraction=0.01)
+    assert val < 6  # old bug: >= one per partition, always
+
+
+def test_executor_materialization_rejects_empty_shard(tmp_path):
+    """More ranks than the partition layout can feed -> loud error, not
+    shards trimmed to zero."""
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.estimator import _write_shards_on_executors
+
+    rows = [_FakeRow({"x0": 1.0, "x1": 2.0, "label": 0}) for _ in range(3)]
+    df = _FakeSparkDF([rows[:2], rows[2:]])
+    with pytest.raises(ValueError, match="zero rows"):
+        _write_shards_on_executors(LocalStore(str(tmp_path)), df,
+                                   ["x0", "x1"], ["label"], 3, 0.0)
+
+
+def test_executor_materialization_requires_writable_store(tmp_path):
+    """A store the executors cannot write falls back (returns None)."""
+    from horovod_tpu.spark import LocalStore
+    from horovod_tpu.spark.estimator import _write_shards_on_executors
+
+    df, _x, _y = _fake_spark_blobs(n=16, n_parts=2)
+    store = LocalStore(str(tmp_path))
+    store.executor_writable = False
+    assert _write_shards_on_executors(store, df, ["x0", "x1"], ["label"],
+                                      2, 0.0) is None
+    # And a plain dict input has no RDD: also None.
+    writable = LocalStore(str(tmp_path))
+    assert _write_shards_on_executors(
+        writable, {"features": _x, "labels": _y}, None, None, 2, 0.0) is None
+
+
 def test_write_shards_validation_stripe(tmp_path):
     from horovod_tpu.spark import LocalStore
     from horovod_tpu.spark.estimator import (_iter_chunks, _load_shard,
