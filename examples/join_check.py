@@ -50,6 +50,17 @@ def main():
         assert np.allclose(rows, 7.0), rows
         print(f"rank {rank}: allgatherv-during-join OK {rows.shape}")
 
+        # Grouped (2 dtype buckets): ONE presence round covers both
+        # bucket collectives (the batched-flush protocol); drained ranks
+        # replay both with identity payloads.
+        outs = hvd.grouped_allreduce(
+            [np.full((s, 2), 6.0, np.float32),
+             np.full((s, 3), 2, np.int32)], hvd.Sum,
+            name="join_grouped", to_host=True)
+        assert np.allclose(outs[0][0], 6.0), outs[0]
+        assert (outs[1][0] == 2).all(), outs[1]
+        print(f"rank {rank}: grouped-during-join OK")
+
     last = hvd.join()
     print(f"rank {rank}: join OK last={last}")
     assert last == n - 1, (last, n)  # the rank with the most batches

@@ -407,16 +407,18 @@ def _grouped_allreduce_buckets(xs, op: ReduceOp = Average, *, name=None,
         by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
     cat = np.concatenate if host_in else jnp.concatenate
     reds, spec = [], []
-    for dt, idxs in by_dtype.items():
-        flats = [xs[i].reshape(k, -1) for i in idxs]
-        widths = [f.shape[1] for f in flats]
-        fused = flats[0] if len(flats) == 1 else cat(flats, axis=1)
-        reds.append(allreduce(
-            fused, op, name=f"{name or 'grouped_allreduce'}.{dt.name}",
-            process_set=process_set, compression=compression,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor))
-        spec.append((idxs, widths, [xs[i].shape[1:] for i in idxs]))
+    from . import joinop as _join
+    with _join.flush(ps, len(by_dtype)):  # ONE presence round per flush
+        for dt, idxs in by_dtype.items():
+            flats = [xs[i].reshape(k, -1) for i in idxs]
+            widths = [f.shape[1] for f in flats]
+            fused = flats[0] if len(flats) == 1 else cat(flats, axis=1)
+            reds.append(allreduce(
+                fused, op, name=f"{name or 'grouped_allreduce'}.{dt.name}",
+                process_set=process_set, compression=compression,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor))
+            spec.append((idxs, widths, [xs[i].shape[1:] for i in idxs]))
     return reds, (spec, len(xs))
 
 
@@ -458,17 +460,19 @@ def broadcast_fused(arrays, root_rank: int = 0, *, name=None,
     by_dtype: Dict[Any, List[int]] = {}
     for i, a in enumerate(arrays):
         by_dtype.setdefault(a.dtype, []).append(i)
-    for dt, idxs in sorted(by_dtype.items(), key=lambda kv: str(kv[0])):
-        flat = np.concatenate([arrays[i].ravel() for i in idxs])
-        res = broadcast(replicated_stack(flat, ps), root_rank,
-                        name=f"{name or 'broadcast_fused'}.{dt}",
-                        process_set=ps)
-        row = one_row(res)
-        off = 0
-        for i in idxs:
-            cnt = arrays[i].size
-            out[i] = row[off:off + cnt].reshape(arrays[i].shape)
-            off += cnt
+    from . import joinop as _join
+    with _join.flush(ps, len(by_dtype)):
+        for dt, idxs in sorted(by_dtype.items(), key=lambda kv: str(kv[0])):
+            flat = np.concatenate([arrays[i].ravel() for i in idxs])
+            res = broadcast(replicated_stack(flat, ps), root_rank,
+                            name=f"{name or 'broadcast_fused'}.{dt}",
+                            process_set=ps)
+            row = one_row(res)
+            off = 0
+            for i in idxs:
+                cnt = arrays[i].size
+                out[i] = row[off:off + cnt].reshape(arrays[i].shape)
+                off += cnt
     return out
 
 
@@ -494,20 +498,24 @@ def grouped_allgather(xs: Sequence, *, name=None, process_set=None):
     out: List[Any] = [None] * len(xs)
     cat = np.concatenate if isinstance(xs[0], np.ndarray) \
         else jnp.concatenate
-    for dt, idxs in _dtype_buckets(xs).items():
-        flats = [xs[i].reshape(k, -1) for i in idxs]
-        widths = [f.shape[1] for f in flats]
-        fused = flats[0] if len(flats) == 1 else cat(flats, axis=1)
-        g = allgather(fused, name=f"{name or 'grouped_allgather'}.{dt.name}",
-                      process_set=ps)                # [k, n*S]
-        S = sum(widths)
-        rows = g.reshape(g.shape[0], n, S)
-        off = 0
-        for i, w in zip(idxs, widths):
-            piece = rows[:, :, off:off + w]          # [k, n, w]
-            out[i] = piece.reshape(
-                (g.shape[0], n * xs[i].shape[1]) + xs[i].shape[2:])
-            off += w
+    from . import joinop as _join
+    buckets = _dtype_buckets(xs)
+    with _join.flush(ps, len(buckets)):
+        for dt, idxs in buckets.items():
+            flats = [xs[i].reshape(k, -1) for i in idxs]
+            widths = [f.shape[1] for f in flats]
+            fused = flats[0] if len(flats) == 1 else cat(flats, axis=1)
+            g = allgather(fused,
+                          name=f"{name or 'grouped_allgather'}.{dt.name}",
+                          process_set=ps)            # [k, n*S]
+            S = sum(widths)
+            rows = g.reshape(g.shape[0], n, S)
+            off = 0
+            for i, w in zip(idxs, widths):
+                piece = rows[:, :, off:off + w]      # [k, n, w]
+                out[i] = piece.reshape(
+                    (g.shape[0], n * xs[i].shape[1]) + xs[i].shape[2:])
+                off += w
     return out
 
 
@@ -554,20 +562,24 @@ def grouped_reducescatter(xs: Sequence, op: ReduceOp = Average, *,
                 f"size {n}, got {x.shape[1:]}")
     cat = np.concatenate if isinstance(xs[0], np.ndarray) \
         else jnp.concatenate
-    for dt, idxs in _dtype_buckets(xs).items():
-        parts = [xs[i].reshape(k, n, -1) for i in idxs]
-        widths = [p.shape[2] for p in parts]
-        fused = parts[0] if len(parts) == 1 else cat(parts, axis=2)
-        red = reducescatter(
-            fused, op, name=f"{name or 'grouped_reducescatter'}.{dt.name}",
-            process_set=ps)                          # [k, 1, S] shards
-        red = red.reshape(red.shape[0], -1)
-        off = 0
-        for i, w in zip(idxs, widths):
-            shard = red[:, off:off + w]
-            out[i] = shard.reshape(
-                (red.shape[0], xs[i].shape[1] // n) + xs[i].shape[2:])
-            off += w
+    from . import joinop as _join
+    buckets = _dtype_buckets(xs)
+    with _join.flush(ps, len(buckets)):
+        for dt, idxs in buckets.items():
+            parts = [xs[i].reshape(k, n, -1) for i in idxs]
+            widths = [p.shape[2] for p in parts]
+            fused = parts[0] if len(parts) == 1 else cat(parts, axis=2)
+            red = reducescatter(
+                fused, op,
+                name=f"{name or 'grouped_reducescatter'}.{dt.name}",
+                process_set=ps)                      # [k, 1, S] shards
+            red = red.reshape(red.shape[0], -1)
+            off = 0
+            for i, w in zip(idxs, widths):
+                shard = red[:, off:off + w]
+                out[i] = shard.reshape(
+                    (red.shape[0], xs[i].shape[1] // n) + xs[i].shape[2:])
+                off += w
     return out
 
 
@@ -638,19 +650,21 @@ def allgatherv(arrs, *, name=None, process_set=None) -> np.ndarray:
         raise ValueError("allgatherv arrays may differ only in dim 0; got "
                          f"shapes {[a.shape for a in arrs]}, "
                          f"dtypes {sorted(map(str, dtypes))}")
-    # Phase 1: exchange sizes (the reference's negotiation does this).
-    sizes = np.asarray([[a.shape[0]] for a in arrs], np.int32)
-    all_sizes = local_result(
-        allgather(sizes, name=f"{name or 'allgatherv'}.sizes",
-                  process_set=ps))[0].ravel()
-    max_len = int(all_sizes.max())
-    # Phase 2: pad to the max and gather (one static-shape collective).
-    tail = arrs[0].shape[1:]
-    padded = np.zeros((k, max_len) + tail, arrs[0].dtype)
-    for i, a in enumerate(arrs):
-        padded[i, :a.shape[0]] = a
-    g = allgather(padded, name=f"{name or 'allgatherv'}.data",
-                  process_set=ps)
+    from . import joinop as _join
+    with _join.flush(ps, 2):  # sizes + data: one presence round
+        # Phase 1: exchange sizes (the reference's negotiation does this).
+        sizes = np.asarray([[a.shape[0]] for a in arrs], np.int32)
+        all_sizes = local_result(
+            allgather(sizes, name=f"{name or 'allgatherv'}.sizes",
+                      process_set=ps))[0].ravel()
+        max_len = int(all_sizes.max())
+        # Phase 2: pad to the max and gather (one static-shape collective).
+        tail = arrs[0].shape[1:]
+        padded = np.zeros((k, max_len) + tail, arrs[0].dtype)
+        for i, a in enumerate(arrs):
+            padded[i, :a.shape[0]] = a
+        g = allgather(padded, name=f"{name or 'allgatherv'}.data",
+                      process_set=ps)
     rows = local_result(g)[0].reshape((ps.size(), max_len) + tail)
     return np.concatenate([rows[r, :all_sizes[r]]
                            for r in range(ps.size())], axis=0)
@@ -777,32 +791,35 @@ def alltoallv(arrs, splits, *, name=None, process_set=None):
         raise ValueError("alltoallv arrays may differ only in dim 0; got "
                          f"shapes {[a.shape for a in arrs]}, "
                          f"dtypes {sorted(map(str, dtypes))}")
-    # Phase 1: exchange the split matrix (negotiation analogue).  Row r of
-    # ``all_splits`` is global rank r's splits vector.
-    stacked = np.stack(splits)                      # [k, n]
-    all_splits = local_result(
-        allgather(stacked, name=f"{name or 'alltoallv'}.splits",
-                  process_set=ps))[0].reshape(n, n)
-    max_len = max(int(all_splits.max()), 1)
-    tail = arrs[0].shape[1:]
-    # Phase 2: pad each split to the max and exchange (one static-shape
-    # alltoall).  Send layout per rank: [n, max_len, ...].
-    padded = np.zeros((k, n, max_len) + tail, arrs[0].dtype)
-    for r, (a, s) in enumerate(zip(arrs, splits)):
-        off = 0
-        for i, c in enumerate(s):
-            padded[r, i, :c] = a[off:off + c]
-            off += int(c)
+    from . import joinop as _join
+    with _join.flush(ps, 2):  # split matrix + exchange: one presence round
+        # Phase 1: exchange the split matrix (negotiation analogue).  Row
+        # r of ``all_splits`` is global rank r's splits vector.
+        stacked = np.stack(splits)                  # [k, n]
+        all_splits = local_result(
+            allgather(stacked, name=f"{name or 'alltoallv'}.splits",
+                      process_set=ps))[0].reshape(n, n)
+        max_len = max(int(all_splits.max()), 1)
+        tail = arrs[0].shape[1:]
+        # Phase 2: pad each split to the max and exchange (one
+        # static-shape alltoall).  Send layout per rank: [n, max_len, ...].
+        padded = np.zeros((k, n, max_len) + tail, arrs[0].dtype)
+        for r, (a, s) in enumerate(zip(arrs, splits)):
+            off = 0
+            for i, c in enumerate(s):
+                padded[r, i, :c] = a[off:off + c]
+                off += int(c)
 
-    # Join phase: drained ranks replay this as a plain alltoall of zeros
-    # on the padded shape (identical traced program) -- their zero split
-    # rows in ``all_splits`` already make receivers take 0 rows from them.
-    _, jmeta, _mask = _join_sync(ps, "alltoall", padded, name)
+        # Join phase: drained ranks replay this as a plain alltoall of
+        # zeros on the padded shape (identical traced program) -- their
+        # zero split rows in ``all_splits`` already make receivers take 0
+        # rows from them.
+        _, jmeta, _mask = _join_sync(ps, "alltoall", padded, name)
 
-    def per_rank(t):
-        return _ops.alltoall(t, axes=(HVD_AXIS,))
-    out = _run("alltoallv", padded, name, ps, per_rank, "a2av",
-               publish_meta=jmeta)
+        def per_rank(t):
+            return _ops.alltoall(t, axes=(HVD_AXIS,))
+        out = _run("alltoallv", padded, name, ps, per_rank, "a2av",
+                   publish_meta=jmeta)
     rows = local_result(out)                        # [k, n, max_len, ...]
     local_global_ranks = _local_member_positions(ps)
     datas, recv_splits = [], []

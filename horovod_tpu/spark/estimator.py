@@ -347,6 +347,19 @@ def _write_shards_on_executors(store: Store, df, feature_cols, label_cols,
     task = _executor_partition_writer(store, feature_cols, label_cols,
                                       num_proc, thresh)
     records = list(rdd.mapPartitionsWithIndex(task).collect())
+    # The chunks must be visible HERE for the trim (and for workers): a
+    # non-shared filesystem (each executor's private /tmp) would
+    # otherwise silently yield partial, unequal shards.  Fall back to the
+    # driver-streamed path instead.
+    missing = [path for _k, _r, path, _c in records
+               if not store.exists(path)]
+    if missing:
+        import logging
+        logging.getLogger(__name__).warning(
+            "%d executor-written chunk(s) not visible from the driver "
+            "(non-shared store path? e.g. %s); falling back to driver "
+            "materialization", len(missing), missing[0])
+        return None
     train_rows = [0] * num_proc
     val_rows = 0
     by_rank: Dict[int, List] = {r: [] for r in range(num_proc)}

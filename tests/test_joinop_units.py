@@ -69,6 +69,13 @@ class _FakeKV:
         return self.store[k]
 
 
+def test_join_timeout_env_is_honored(hvd, monkeypatch):
+    """Regression: the lookup used the already-prefixed name, consulting
+    HOROVOD_HOROVOD_JOIN_TIMEOUT -- the documented knob never worked."""
+    monkeypatch.setenv("HOROVOD_JOIN_TIMEOUT", "123")
+    assert joinop._timeout_ms() == 123_000
+
+
 def test_read_last_max_seq_then_max_rank(hvd):
     """Last joiner resolves deterministically: max join seq, ties on rank
     (two processes joining between the same presence rounds)."""

@@ -155,6 +155,7 @@ def test_join_drains_stragglers(np_):
     last = np_ - 1
     assert f"rank 0: join OK last={last}" in out.stdout
     assert f"rank {last}: allgatherv-during-join OK" in out.stdout
+    assert f"rank {last}: grouped-during-join OK" in out.stdout
     assert f"rank {last}: join2 OK last={last}" in out.stdout
 
 
