@@ -97,6 +97,15 @@ def main():
             state.batch += 1
             print(f"rank {hvd.rank()}/{n} batch {state.batch} "
                   f"loss {float(loss):.4f}", flush=True)
+            # Preemption-test hook: deliver a real SIGTERM to this worker
+            # at the given batch (what a cloud preemption notice does).
+            sig_at = int(os.environ.get("ELASTIC_SELF_SIGTERM_AT", "0"))
+            sig_host = os.environ.get("ELASTIC_SIGTERM_HOST", "")
+            wid = os.environ.get("HVD_TPU_ELASTIC_WORKER_ID", "")
+            if sig_at and state.batch == sig_at and sig_host and \
+                    wid.split(":")[0] == sig_host:
+                import signal
+                os.kill(os.getpid(), signal.SIGTERM)
             time.sleep(delay)
             state.commit()
         return state.batch

@@ -58,6 +58,17 @@ class ElasticDriver:
         self.extra_env = dict(extra_env or {})
         self.epoch = -1
         self.blacklist: set = set()
+        self._preempted_seen: set = set()
+        self._preempted_leaving: set = set()  # graceful leavers: excluded
+        # from desired while departing, cleared when their host leaves
+        # discovery (a restarted preemptible VM may rejoin -- departure is
+        # not a fault, unlike the blacklist)
+        self._ever_spawned: set = set()  # KV preemption markers are
+        # keyed by worker id; a reaped worker is gone from self.workers
+        # by the time its marker is polled, so remember everyone.
+        self._dying: List = []  # (proc, kill_deadline) for removed
+        # workers: their SIGTERM may be latched as a preemption notice
+        # (or ignored by a wedged collective), so escalate to SIGKILL.
         self.workers: Dict[str, TaggedProcess] = {}  # worker_id -> proc
         # SIGTERM time per evicted worker, for SIGKILL escalation: a worker
         # wedged in a blocking collective (the very case the stall-gated
@@ -84,11 +95,22 @@ class ElasticDriver:
     # -- membership -------------------------------------------------------
     def _desired_workers(self) -> List[str]:
         hosts = self.discovery.find_available_hosts_and_slots()
+        # A preemption departure is NOT a fault: the slot is excluded only
+        # while leaving.  Once its host vanishes from discovery the entry
+        # clears, so a reclaimed VM that comes back under the same name
+        # rejoins (unlike the failure blacklist, which is permanent).
+        for wid in list(self._preempted_leaving):
+            if wid.rsplit(":", 1)[0] not in hosts:
+                self._preempted_leaving.discard(wid)
+                # Re-armed: if the slot is re-spawned and preempted again
+                # later, its fresh marker must be honored.
+                self._preempted_seen.discard(wid)
         ids = []
         for host in sorted(hosts):
             for slot in range(hosts[host]):
                 wid = f"{host}:{slot}"
-                if wid not in self.blacklist:
+                if wid not in self.blacklist and \
+                        wid not in self._preempted_leaving:
                     ids.append(wid)
         if self.max_np is not None:
             ids = ids[:self.max_np]
@@ -110,6 +132,7 @@ class ElasticDriver:
         return ranks
 
     def _spawn(self, wid: str, rank: int, size: int, port: int) -> None:
+        self._ever_spawned.add(wid)
         # A previous incarnation of this slot may have left a heartbeat
         # file behind; its stale mtime would get the fresh worker evicted
         # before it writes its first beat.
@@ -170,6 +193,45 @@ class ElasticDriver:
                 proc.terminate()
                 self._terminated_at[wid] = now
 
+    def _read_preempted(self) -> set:
+        """Worker ids newly self-marked as preempted (graceful leavers).
+
+        A preempted worker exits rc 0 AND its host usually vanishes from
+        discovery at the same time, so neither the failure path nor the
+        desired-vs-current comparison would trigger a republish -- the
+        marker forces one so survivors get a fresh epoch.  Consumed
+        markers are deleted (the id may be re-spawned and legitimately
+        preempted again later).
+        """
+        import glob
+
+        from .notify import read_preempted_markers
+
+        marked = read_preempted_markers(self.assignment_path)
+        if self._kv is not None:
+            for wid in self._ever_spawned - self._preempted_seen:
+                if wid in self.blacklist:
+                    continue
+                try:
+                    if self._kv.get("preempted", wid):
+                        marked.add(wid)
+                except ConnectionError:  # pragma: no cover
+                    pass
+        new = marked - self._preempted_seen - self.blacklist
+        for wid in new:
+            if self._kv is not None:
+                try:
+                    self._kv.delete("preempted", wid)
+                except ConnectionError:  # pragma: no cover
+                    pass
+        if new:
+            for p in glob.glob(self.assignment_path + ".preempted.*"):
+                try:
+                    os.unlink(p)
+                except OSError:  # pragma: no cover
+                    pass
+        return new
+
     def _kv_heartbeat_age(self, wid: str) -> Optional[float]:
         """Age of a worker's KV heartbeat (None: no beat yet)."""
         import time as _time
@@ -213,6 +275,13 @@ class ElasticDriver:
         while True:
             time.sleep(self.poll_interval_s)
             self._check_heartbeats()
+            # 0. Escalate removed-but-still-alive workers to SIGKILL.
+            for proc, deadline in list(self._dying):
+                if proc.poll() is not None:
+                    self._dying.remove((proc, deadline))
+                elif time.monotonic() > deadline:
+                    proc.kill()
+                    self._dying.remove((proc, deadline))
             # 1. Reap exits.
             finished_ok = []
             failed = []
@@ -231,14 +300,24 @@ class ElasticDriver:
             if not self.workers and (finished_ok or failed):
                 # Everyone exited: success only if nothing failed.
                 return failed[0][1] if failed else 0
-            if finished_ok and self.workers:
+            # 1b. Graceful preemption leavers: they exit rc 0 and usually
+            # vanish from discovery simultaneously, so neither the
+            # failure path nor desired-vs-current would republish --
+            # without this the survivors wait on the old epoch forever.
+            preempted = self._read_preempted()
+            for wid in preempted:
+                logger.warning("worker %s is leaving after a preemption "
+                               "notice; republishing without it", wid)
+                self._preempted_leaving.add(wid)
+                self._preempted_seen.add(wid)
+            if finished_ok and self.workers and not preempted:
                 # Graceful finish is collective; stragglers follow shortly.
                 continue
 
             # 2. Discover the desired set.
             desired = self._desired_workers()
             current = set(self.workers)
-            if failed or set(desired) != current:
+            if failed or preempted or set(desired) != current:
                 alive = [wid for wid in desired if wid in current]
                 newcomers = [wid for wid in desired if wid not in current]
                 removed = [wid for wid in current if wid not in desired]
@@ -247,13 +326,27 @@ class ElasticDriver:
                     logger.error("%d worker(s) < min-np=%d; aborting",
                                  len(next_set), self.min_np)
                     for proc in self.workers.values():
-                        proc.terminate()
+                        # Terminal abort: SIGKILL outright -- workers'
+                        # SIGTERM handlers would latch the signal as a
+                        # preemption notice and keep training forever.
+                        proc.kill()
                     return 1
                 port = free_port()
                 ranks = self._publish(next_set, port)
                 for wid in removed:
-                    self.workers[wid].terminate()
-                    self.workers.pop(wid, None)
+                    proc = self.workers.pop(wid)
+                    if wid not in self._preempted_leaving:
+                        # Plain eviction: SIGTERM.  An announced graceful
+                        # leaver is NOT signalled -- its handler already
+                        # re-armed SIG_DFL after the platform's notice,
+                        # so a driver SIGTERM would kill it mid-step
+                        # before its commit-boundary exit.
+                        proc.terminate()
+                    # Either way, escalate to SIGKILL after the grace so
+                    # a wedged or latched worker cannot leak as an
+                    # orphan.
+                    self._dying.append((proc, time.monotonic()
+                                        + self.term_grace_s))
                 for wid in newcomers:
                     self._spawn(wid, ranks[wid], len(next_set), port)
                 # Survivors pick the new epoch up from the assignment file

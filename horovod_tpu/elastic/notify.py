@@ -106,3 +106,66 @@ class Notifier:
 
     def accept(self, doc: dict) -> None:
         self.current_epoch = doc["epoch"]
+
+    def excluded_from_current(self) -> Optional[bool]:
+        """True when the LATEST assignment no longer ranks this worker
+        (the driver evicted it -- its SIGTERM was an eviction, not a
+        cloud preemption); None when unknown (no doc readable)."""
+        if not self.enabled or not self.worker_id:
+            return None
+        doc = self.read()
+        if not doc:
+            return None
+        return self.worker_id not in doc.get("ranks", {})
+
+    def mark_preempted(self) -> bool:
+        """Tell the driver this worker is leaving after a preemption
+        notice (graceful commit-boundary exit); True on success (the
+        caller retries at the next commit otherwise).
+
+        Required even when discovery drops the host at the same time: the
+        driver's rescale trigger compares desired vs CURRENT workers, and
+        a cleanly-exited worker has already left both sets -- without this
+        marker no new epoch would be published and the survivors would
+        wait on the old assignment forever.
+        """
+        if not self.enabled or not self.worker_id:
+            return True  # nothing to deliver to
+        if self.path.startswith("http://"):
+            from ..run.http_kv import KVClient
+            from ..run.secret import SECRET_ENV
+
+            secret = os.environ.get(SECRET_ENV)
+            if not secret:
+                return False
+            try:
+                KVClient.from_url(self.path, secret).put(
+                    "preempted", self.worker_id, b"1")
+                return True
+            except (ConnectionError, OSError):  # pragma: no cover
+                return False
+        safe = self.worker_id.replace(":", "_").replace("/", "_")
+        try:
+            with open(f"{self.path}.preempted.{safe}", "w") as f:
+                f.write(self.worker_id)
+            return True
+        except OSError:  # pragma: no cover - driver dir gone
+            return False
+
+
+def read_preempted_markers(path: str) -> set:
+    """Driver side (file transport): worker ids that marked themselves
+    preempted.  The KV transport is read through the driver's own store
+    (:meth:`ElasticDriver._read_preempted`)."""
+    import glob
+
+    out = set()
+    for p in glob.glob(path + ".preempted.*"):
+        try:
+            with open(p) as f:
+                wid = f.read().strip()
+            if wid:
+                out.add(wid)
+        except OSError:  # pragma: no cover - racing cleanup
+            pass
+    return out

@@ -37,12 +37,64 @@ from .state import State
 logger = logging.getLogger("horovod_tpu.elastic")
 
 
+def _comm_error_types() -> tuple:
+    """Exception types the JAX/XLA runtime raises for transport and
+    coordination failures (pinned by the live peer-death test)."""
+    types = [RuntimeError, OSError, TimeoutError, ConnectionError]
+    try:
+        from jax.errors import JaxRuntimeError
+        types.append(JaxRuntimeError)
+    except Exception:  # pragma: no cover - older jax
+        pass
+    try:  # pragma: no cover - alias of JaxRuntimeError on current jaxlib
+        from jax._src.lib import xla_client
+        types.append(xla_client.XlaRuntimeError)
+    except Exception:
+        pass
+    return tuple(types)
+
+
+# XLA status codes the runtime prefixes its messages with; jax maps some
+# of them onto PYTHON BUILTIN exception types (measured live: a peer
+# dying mid-allreduce raises ValueError("UNKNOWN: Gloo all-reduce
+# failed: ... Connection closed by peer")), so type checks alone cannot
+# recognize the transport layer.
+_STATUS_PREFIXES = ("UNKNOWN:", "INTERNAL:", "UNAVAILABLE:",
+                    "DEADLINE_EXCEEDED:", "ABORTED:", "CANCELLED:",
+                    "FAILED_PRECONDITION:")
+
+
 def _looks_like_comm_failure(err: BaseException) -> bool:
+    """Classify an exception as a recoverable comm-plane failure.
+
+    Two gates, both required (a user ``ValueError`` whose message merely
+    mentions "connection" must not be silently converted into a
+    rollback):
+
+    1. the exception must look like it came from the runtime layer --
+       either by TYPE (JaxRuntimeError / RuntimeError / OSError /
+       TimeoutError) or, for the builtin types jax maps XLA status codes
+       onto, by the status-code PREFIX the runtime stamps on its
+       messages;
+    2. the message must carry a transport/coordination signature.
+
+    The gate set is pinned against the CURRENT jax's live error surface
+    by ``test_run.py::test_peer_death_error_classification`` -- a renamed
+    runtime message fails that test rather than silently converting a
+    recoverable fault into a crash.
+    """
+    if isinstance(err, HorovodInternalError):
+        return True
     text = f"{type(err).__name__}: {err}"
     needles = ("DEADLINE_EXCEEDED", "UNAVAILABLE", "connection",
                "Connection", "gloo", "Gloo", "distributed", "heartbeat",
-               "coordinator", "barrier timed out", "preempt")
-    return any(n in text for n in needles)
+               "coordinator", "barrier timed out", "preempt",
+               "Socket closed", "recv", "peer")
+    if isinstance(err, _comm_error_types()):
+        return any(n in text for n in needles)
+    if str(err).startswith(_STATUS_PREFIXES):
+        return any(n in text for n in needles)
+    return False
 
 
 def check_for_host_updates(state: State) -> None:
@@ -51,7 +103,32 @@ def check_for_host_updates(state: State) -> None:
     Call at commit boundaries (``JaxState.commit`` callers do this via the
     run loop; explicit calls are allowed anywhere in user code).
     """
+    from . import preemption
     notifier: Notifier = getattr(state, "_hvd_notifier", None)
+    if preemption.notice_received():
+        if notifier is not None and notifier.enabled:
+            if notifier.excluded_from_current():
+                # The latest epoch already excludes this worker: the
+                # SIGTERM was the DRIVER's eviction (scale-down,
+                # heartbeat), not a cloud preemption -- don't mark, just
+                # take the interrupt and leave via the loop top.
+                state.on_hosts_updated()
+                raise HostsUpdatedInterrupt()
+            # Announce ONCE and keep participating: exiting now would
+            # strand peers already inside the next step's collective
+            # (Gloo blocks forever on a vanished member -- measured).
+            # The driver answers the marker with a new epoch excluding
+            # this worker, which interrupts EVERYONE at a commit
+            # boundary -- the same coordinated teardown the scale-down
+            # path uses (SURVEY.md 5.3 graceful preemption).
+            if not preemption.announced():
+                if notifier.mark_preempted():  # else: retry next commit
+                    preemption.set_announced()
+        else:
+            # No driver to coordinate: best effort, leave at this
+            # boundary with the snapshot saved.
+            state.on_hosts_updated()
+            raise HostsUpdatedInterrupt()
     if notifier is None or not notifier.enabled:
         return
     doc = notifier.updated()
@@ -103,6 +180,12 @@ def run(func: Callable[..., Any]) -> Callable[..., Any]:
 
     @functools.wraps(func)
     def wrapper(state: State, *args, **kwargs):
+        from ..core.config import _env_bool
+        from . import preemption
+        if not _env_bool("ELASTIC_NO_SIGTERM"):
+            preemption.install_sigterm()
+        if _env_bool("ELASTIC_PREEMPT_POLL"):
+            preemption.start_gce_poll()
         notifier = Notifier()
         state._hvd_notifier = notifier
         heartbeat = None
@@ -143,10 +226,25 @@ def _desync_max_retries() -> int:
 
 
 def _elastic_loop(func, state, notifier, args, kwargs):
+    from . import preemption
+
     reset_required = False
     desync_retries = 0
     commit_baseline = None  # commit count right after the last sync()
     while True:
+        if preemption.notice_received():
+            # Reached after the coordinated interrupt (or a comm
+            # failure): state is committed, the driver already has the
+            # marker, peers are rolling to the new epoch.  Leave without
+            # an explicit comm-plane teardown -- the process exit closes
+            # the transports (same as a scale-down removal), while an
+            # in-loop jax.distributed.shutdown here would tangle its
+            # coordination Shutdown barrier with the survivors'
+            # re-initialization.
+            logger.warning("preemption notice honored (%s); exiting "
+                           "after commit", preemption.reason())
+            print("preempted: exiting gracefully after commit", flush=True)
+            return None
         if reset_required:
             _reinitialize(notifier)
             state.on_reset()

