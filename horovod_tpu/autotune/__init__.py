@@ -21,14 +21,18 @@ architecture here:
   sampled configurations as CSV and warm-starts the next run (reference
   warm-start file behavior).
 
-The reference's ParameterManager additionally flips the hierarchical-
-allreduce/-allgather flags and the response-cache toggle.  Those knobs
-collapse under XLA: the (dcn, ici) mesh is fixed at ``init`` and a
-reduction over both axes IS the hierarchical algorithm (XLA schedules
-the two-level exchange; there is no per-op flat-vs-hierarchical choice
-to search), and the executable cache has no bitvector fast path to
-toggle -- a hit is always strictly cheaper than a retrace.  So the
-tunable surface here is exactly the two knobs that still exist.
+Round 3 widened the surface to the reference ParameterManager's other
+knobs where a real choice survives under XLA:
+
+* **hierarchical allreduce** (on 2-axis (dcn, ici) meshes only): XLA's
+  own schedule for a both-axes ``psum`` vs the explicit two-level
+  reduce-scatter/DCN-allreduce/allgather
+  (:func:`~horovod_tpu.collectives.ops.hierarchical_allreduce`);
+* **compression codec** (OPT-IN via ``HOROVOD_AUTOTUNE_COMPRESSION=1``,
+  because it changes wire numerics): configured default vs bf16 vs fp16.
+
+The response-cache toggle stays collapsed: an executable-cache hit is
+always strictly cheaper than a retrace, so there is nothing to search.
 """
 
 from __future__ import annotations
@@ -44,10 +48,25 @@ _MiB = 1024 * 1024
 _THRESHOLDS = [2 * _MiB, 8 * _MiB, 32 * _MiB, 64 * _MiB, 128 * _MiB]
 _CYCLES_MS = [0.5, 1.0, 5.0]
 MAX_SAMPLES = 12
+# Compression axis encoding (grid value -> codec); 0 keeps whatever the
+# optimizer was configured with.
+COMP_DEFAULT, COMP_BF16, COMP_FP16 = 0, 1, 2
 
 
-def _grid(thresholds, cycles) -> List[Tuple[int, float]]:
-    return [(t, c) for t in thresholds for c in cycles]
+def _grid(thresholds, cycles, hiers,
+          comps) -> List[Tuple[int, float, int, int]]:
+    return [(t, c, h, k) for t in thresholds for c in cycles
+            for h in hiers for k in comps]
+
+
+def _mesh_is_two_level() -> bool:
+    """True when the initialized mesh has two non-trivial axes (a real
+    dcn x ici factorization) -- otherwise the hierarchical knob has
+    nothing to choose between."""
+    from ..core.state import global_state
+    mesh = global_state().mesh
+    return (mesh is not None and len(mesh.axis_names) == 2
+            and all(s > 1 for s in mesh.devices.shape))
 
 
 class Autotuner:
@@ -70,12 +89,21 @@ class Autotuner:
         cycles = list(_CYCLES_MS) if torch_shim else []
         if config.cycle_time not in cycles:
             cycles.append(config.cycle_time)
-        self.grid = _grid(sorted(self.candidates), sorted(cycles))
+        # Hierarchical-allreduce choice only exists on a true 2-level
+        # mesh; compression retuning is opt-in (it changes numerics).
+        hiers = [0, 1] if _mesh_is_two_level() else \
+            [1 if config.hierarchical_allreduce else 0]
+        from ..core.config import _env_bool
+        comps = [COMP_DEFAULT, COMP_BF16, COMP_FP16] \
+            if _env_bool("AUTOTUNE_COMPRESSION") else [COMP_DEFAULT]
+        self.grid = _grid(sorted(self.candidates), sorted(cycles), hiers,
+                          comps)
         self.steps_per_sample = steps_per_sample
         self.max_samples = min(max_samples, len(self.grid))
         self.log_path = config.autotune_log
         self._opt = BayesianOptimizer(
-            [(float(t), c) for t, c in self.grid])
+            [(float(t), c, float(h), float(k))
+             for t, c, h, k in self.grid])
         self._samples: List[tuple] = []
         self._best: Optional[Tuple[int, float]] = None
         self._step = 0
@@ -85,11 +113,38 @@ class Autotuner:
         self._idx = self._next_index()
 
     # -- current knobs ----------------------------------------------------
+    def _current(self) -> Tuple[int, float, int, int]:
+        return self._best or self.grid[self._idx]
+
     def fusion_threshold(self) -> int:
-        return (self._best or self.grid[self._idx])[0]
+        return self._current()[0]
 
     def cycle_time_ms(self) -> float:
-        return (self._best or self.grid[self._idx])[1]
+        return self._current()[1]
+
+    def hierarchical_explicit(self) -> bool:
+        """Use the explicit two-level (dcn, ici) allreduce schedule."""
+        return bool(self._current()[2])
+
+    def compression_override(self, configured):
+        """The codec this sample runs with (``configured`` unless the
+        opt-in compression axis picked another)."""
+        from ..collectives.compression import Compression
+        k = self._current()[3]
+        if k == COMP_BF16:
+            return Compression.bf16
+        if k == COMP_FP16:
+            return Compression.fp16
+        return configured
+
+    def trace_key(self) -> tuple:
+        """The TRACE-TIME knobs of the current sample (the compiled step
+        cache in ``training.make_train_step`` keys on this).  Cycle time
+        is deliberately excluded: it is a RUNTIME knob applied through
+        ``_apply_to_batcher``, and keying on it would recompile an
+        identical trace for every cycle-axis sample."""
+        thr, _cyc, hier, comp = self._current()
+        return (thr, hier, comp)
 
     @property
     def done(self) -> bool:
@@ -172,12 +227,19 @@ class Autotuner:
                         if line.startswith(("fusion", "#")):
                             continue
                         parts = line.strip().split(",")
-                        if len(parts) < 3:
+                        if len(parts) == 3:     # pre-round-3 log format
+                            cfg = (int(float(parts[0])), float(parts[1]),
+                                   0, COMP_DEFAULT)
+                            score = float(parts[2])
+                        elif len(parts) >= 5:
+                            cfg = (int(float(parts[0])), float(parts[1]),
+                                   int(float(parts[2])),
+                                   int(float(parts[3])))
+                            score = float(parts[4])
+                        else:
                             continue
-                        cfg = (int(float(parts[0])), float(parts[1]))
                         if cfg in self.grid:
-                            obs.append((self.grid.index(cfg),
-                                        float(parts[2])))
+                            obs.append((self.grid.index(cfg), score))
             except (OSError, ValueError):  # pragma: no cover - corrupt log
                 obs = []
         obs = self._sync(obs)
@@ -192,7 +254,9 @@ class Autotuner:
         if not self.log_path:
             return
         with open(self.log_path, "w") as f:
-            f.write("fusion_threshold_bytes,cycle_time_ms,score_bytes_per_s\n")
-            for thr, cyc, score in self._samples:
-                f.write(f"{thr},{cyc},{score}\n")
-            f.write(f"# best,{self._best[0]},{self._best[1]}\n")
+            f.write("fusion_threshold_bytes,cycle_time_ms,hierarchical,"
+                    "compression,score_bytes_per_s\n")
+            for thr, cyc, hier, comp, score in self._samples:
+                f.write(f"{thr},{cyc},{hier},{comp},{score}\n")
+            f.write(f"# best,{self._best[0]},{self._best[1]},"
+                    f"{self._best[2]},{self._best[3]}\n")

@@ -206,6 +206,50 @@ def allreduce(x,
     return y
 
 
+def hierarchical_allreduce(x,
+                           op: ReduceOp = Average,
+                           *,
+                           dcn_axis: str,
+                           ici_axis: str,
+                           prescale_factor: float = 1.0,
+                           postscale_factor: float = 1.0):
+    """Explicit two-level allreduce on a ``(dcn, ici)`` mesh
+    (HOROVOD_HIERARCHICAL_ALLREDUCE parity, ``NCCLHierarchicalAllreduce``):
+    intra-slice reduce-scatter over ICI, cross-slice allreduce of the
+    1/n_ici shard over DCN, intra-slice allgather.
+
+    A plain ``psum`` over both axes leaves the schedule to XLA (usually
+    right on ICI-only meshes); this explicit form moves only the shard
+    over the slow DCN links -- the reference's hierarchical algorithm --
+    and is what the autotuner's ``hierarchical`` knob selects.  Sum and
+    Average only (min/max/product don't scatter).
+    """
+    if op not in (Sum, Average):
+        raise ValueError(
+            f"hierarchical_allreduce supports Sum/Average, got {op}")
+    n_ici = lax.axis_size(ici_axis)
+    n = n_ici * lax.axis_size(dcn_axis)
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+    shape = x.shape
+    flat = x.ravel()
+    pad = (-flat.size) % n_ici
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0,
+                             tiled=True)
+    shard = lax.psum(shard, dcn_axis)
+    if op is Average:
+        shard = _divide_in_dtype(shard, n)
+    y = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    if pad:
+        y = y[:-pad]
+    y = y.reshape(shape)
+    if postscale_factor != 1.0:
+        y = y * jnp.asarray(postscale_factor, dtype=y.dtype)
+    return y
+
+
 def grouped_allreduce(xs: Sequence,
                       op: ReduceOp = Average,
                       *,

@@ -39,13 +39,42 @@ def allreduce_gradients(grads,
                         process_set=None,
                         prescale_factor: float = 1.0,
                         postscale_factor: float = 1.0):
-    """Fused in-step allreduce of a gradient pytree (the hot path)."""
+    """Fused in-step allreduce of a gradient pytree (the hot path).
+
+    Two further knobs resolve at TRACE time (the reference's
+    ParameterManager tunes both; ours does too under
+    ``HOROVOD_AUTOTUNE=1``): the hierarchical-allreduce algorithm choice
+    on (dcn, ici) meshes (``HOROVOD_HIERARCHICAL_ALLREDUCE`` /
+    autotuned) and -- opt-in, it changes wire numerics
+    (``HOROVOD_AUTOTUNE_COMPRESSION=1``) -- the compression codec.
+    """
+    from ..core.state import global_state
+    st = global_state()
+    tuner = st.autotuner
+    if tuner is not None:
+        compression = tuner.compression_override(compression)
+        explicit_hier = tuner.hierarchical_explicit()
+    else:
+        explicit_hier = bool(st.config and st.config.hierarchical_allreduce)
+
+    def resolved_axes():
+        if axes is not None:
+            return tuple((axes,) if isinstance(axes, str) else axes)
+        return tuple(st.mesh.axis_names) if st.mesh is not None else ()
 
     def collective(buf):
         c, ctx = compression.compress(buf)
-        r = _ops.allreduce(c, op, axes=axes, process_set=process_set,
-                           prescale_factor=prescale_factor,
-                           postscale_factor=postscale_factor)
+        ax = resolved_axes()
+        if (explicit_hier and process_set is None and len(ax) == 2
+                and op in (_ops.Sum, Average)):
+            r = _ops.hierarchical_allreduce(
+                c, op, dcn_axis=ax[0], ici_axis=ax[1],
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+        else:
+            r = _ops.allreduce(c, op, axes=axes, process_set=process_set,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor)
         return compression.decompress(r, ctx)
 
     # Axis sizes are static at trace time: a one-device reduction is the

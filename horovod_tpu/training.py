@@ -167,11 +167,11 @@ def make_train_step(
     grad_nbytes = [0]
 
     def tuned_step(params, opt_state, batch):
-        thr = tuner.fusion_threshold()
-        fn = compiled.get(thr)
+        key = tuner.trace_key()  # every trace-time knob of this sample
+        fn = compiled.get(key)
         if fn is None:
             fn = jax.jit(shard, donate_argnums=donate_argnums)
-            compiled[thr] = fn
+            compiled[key] = fn
         if tuner.done:
             return fn(params, opt_state, batch)
         if not grad_nbytes[0]:
