@@ -59,10 +59,12 @@ class ElasticDriver:
         self.epoch = -1
         self.blacklist: set = set()
         self._preempted_seen: set = set()
-        self._preempted_leaving: set = set()  # graceful leavers: excluded
-        # from desired while departing, cleared when their host leaves
-        # discovery (a restarted preemptible VM may rejoin -- departure is
-        # not a fault, unlike the blacklist)
+        self._preempted_leaving: Dict[str, float] = {}  # wid -> expiry.
+        # Graceful leavers: excluded from desired while departing,
+        # cleared when their host leaves discovery OR after the expiry
+        # (a restarted preemptible VM may rejoin, and an operator SIGTERM
+        # whose host never leaves the listing must not lose the slot
+        # forever -- departure is not a fault, unlike the blacklist).
         self._ever_spawned: set = set()  # KV preemption markers are
         # keyed by worker id; a reaped worker is gone from self.workers
         # by the time its marker is polled, so remember everyone.
@@ -75,6 +77,9 @@ class ElasticDriver:
         # heartbeat detects) may never service SIGTERM.
         self._terminated_at: Dict[str, float] = {}
         self.term_grace_s = 15.0
+        # How long a graceful preemption excludes a slot that stays in
+        # the discovery listing (~a preemptible VM's restart latency).
+        self.preempt_exclusion_s = 120.0
         self._assignment_dir = tempfile.mkdtemp(prefix="hvd_tpu_elastic_")
         self.assignment_path = os.path.join(self._assignment_dir,
                                             "assignment.json")
@@ -99,9 +104,11 @@ class ElasticDriver:
         # while leaving.  Once its host vanishes from discovery the entry
         # clears, so a reclaimed VM that comes back under the same name
         # rejoins (unlike the failure blacklist, which is permanent).
+        now = time.monotonic()
         for wid in list(self._preempted_leaving):
-            if wid.rsplit(":", 1)[0] not in hosts:
-                self._preempted_leaving.discard(wid)
+            if wid.rsplit(":", 1)[0] not in hosts \
+                    or now > self._preempted_leaving[wid]:
+                del self._preempted_leaving[wid]
                 # Re-armed: if the slot is re-spawned and preempted again
                 # later, its fresh marker must be honored.
                 self._preempted_seen.discard(wid)
@@ -203,11 +210,10 @@ class ElasticDriver:
         markers are deleted (the id may be re-spawned and legitimately
         preempted again later).
         """
-        import glob
-
         from .notify import read_preempted_markers
 
-        marked = read_preempted_markers(self.assignment_path)
+        markers = read_preempted_markers(self.assignment_path)
+        marked = set(markers)
         if self._kv is not None:
             for wid in self._ever_spawned - self._preempted_seen:
                 if wid in self.blacklist:
@@ -218,14 +224,17 @@ class ElasticDriver:
                 except ConnectionError:  # pragma: no cover
                     pass
         new = marked - self._preempted_seen - self.blacklist
+        # Consume exactly the markers processed this round: a glob-wide
+        # delete would race a marker written between read and cleanup,
+        # losing that worker's (announce-once) notice forever.
         for wid in new:
             if self._kv is not None:
                 try:
                     self._kv.delete("preempted", wid)
                 except ConnectionError:  # pragma: no cover
                     pass
-        if new:
-            for p in glob.glob(self.assignment_path + ".preempted.*"):
+            p = markers.get(wid)
+            if p is not None:
                 try:
                     os.unlink(p)
                 except OSError:  # pragma: no cover
@@ -251,6 +260,13 @@ class ElasticDriver:
         try:
             return self._run()
         finally:
+            # Whatever the exit path (all-finished, min-np abort, error),
+            # a removed worker parked in _dying must not outlive the
+            # driver as an orphan (its SIGTERM may have been latched by
+            # the preemption handler, or ignored by a wedged collective).
+            for proc, _deadline in self._dying:
+                if proc.poll() is None:
+                    proc.kill()
             if self._rdv is not None:
                 self._rdv.stop()
 
@@ -308,7 +324,8 @@ class ElasticDriver:
             for wid in preempted:
                 logger.warning("worker %s is leaving after a preemption "
                                "notice; republishing without it", wid)
-                self._preempted_leaving.add(wid)
+                self._preempted_leaving[wid] = \
+                    time.monotonic() + self.preempt_exclusion_s
                 self._preempted_seen.add(wid)
             if finished_ok and self.workers and not preempted:
                 # Graceful finish is collective; stragglers follow shortly.

@@ -153,19 +153,22 @@ class Notifier:
             return False
 
 
-def read_preempted_markers(path: str) -> set:
-    """Driver side (file transport): worker ids that marked themselves
-    preempted.  The KV transport is read through the driver's own store
+def read_preempted_markers(path: str) -> Dict[str, str]:
+    """Driver side (file transport): ``{worker_id: marker_path}`` for
+    workers that marked themselves preempted -- the path is returned so
+    the driver can delete EXACTLY the markers it consumed (deleting by
+    glob would race a marker written between read and cleanup).  The KV
+    transport is read through the driver's own store
     (:meth:`ElasticDriver._read_preempted`)."""
     import glob
 
-    out = set()
+    out: Dict[str, str] = {}
     for p in glob.glob(path + ".preempted.*"):
         try:
             with open(p) as f:
                 wid = f.read().strip()
             if wid:
-                out.add(wid)
+                out[wid] = p
         except OSError:  # pragma: no cover - racing cleanup
             pass
     return out
