@@ -106,6 +106,12 @@ class ElasticDriver:
         # rejoins (unlike the failure blacklist, which is permanent).
         now = time.monotonic()
         for wid in list(self._preempted_leaving):
+            if wid in self.workers:
+                # Still departing: pruning now would let the removal loop
+                # SIGTERM it mid-step in this very iteration (its handler
+                # has re-armed SIG_DFL), defeating the commit-boundary
+                # exit.  Prune only once the process is gone.
+                continue
             if wid.rsplit(":", 1)[0] not in hosts \
                     or now > self._preempted_leaving[wid]:
                 del self._preempted_leaving[wid]
@@ -224,10 +230,15 @@ class ElasticDriver:
                 except ConnectionError:  # pragma: no cover
                     pass
         new = marked - self._preempted_seen - self.blacklist
-        # Consume exactly the markers processed this round: a glob-wide
-        # delete would race a marker written between read and cleanup,
-        # losing that worker's (announce-once) notice forever.
-        for wid in new:
+        # Consume exactly the markers acted upon (a glob-wide delete
+        # would race a marker written between read and cleanup, losing
+        # that worker's announce-once notice): the newly-processed ones,
+        # plus markers from already-seen or blacklisted wids, which will
+        # never be processed and would otherwise be re-read every poll.
+        for wid in marked:
+            if not (wid in new or wid in self.blacklist
+                    or wid in self._preempted_seen):
+                continue
             if self._kv is not None:
                 try:
                     self._kv.delete("preempted", wid)
@@ -260,11 +271,15 @@ class ElasticDriver:
         try:
             return self._run()
         finally:
-            # Whatever the exit path (all-finished, min-np abort, error),
-            # a removed worker parked in _dying must not outlive the
-            # driver as an orphan (its SIGTERM may have been latched by
+            # Whatever the exit path (all-finished, min-np abort, error,
+            # an exception out of publish/spawn), neither a removed
+            # worker parked in _dying nor a live tracked worker may
+            # outlive the driver as an orphan (SIGTERM may be latched by
             # the preemption handler, or ignored by a wedged collective).
             for proc, _deadline in self._dying:
+                if proc.poll() is None:
+                    proc.kill()
+            for proc in self.workers.values():
                 if proc.poll() is None:
                     proc.kill()
             if self._rdv is not None:
