@@ -222,23 +222,21 @@ class ElasticDriver:
         marked = set(markers)
         if self._kv is not None:
             for wid in self._ever_spawned - self._preempted_seen:
-                if wid in self.blacklist:
-                    continue
                 try:
                     if self._kv.get("preempted", wid):
                         marked.add(wid)
                 except ConnectionError:  # pragma: no cover
                     pass
         new = marked - self._preempted_seen - self.blacklist
-        # Consume exactly the markers acted upon (a glob-wide delete
-        # would race a marker written between read and cleanup, losing
-        # that worker's announce-once notice): the newly-processed ones,
-        # plus markers from already-seen or blacklisted wids, which will
-        # never be processed and would otherwise be re-read every poll.
+        # Consume EVERY marker read this round (each is either newly
+        # processed, or from a seen/blacklisted wid that will never be
+        # processed and would otherwise be re-read every poll); deleting
+        # only what was read cannot race a marker written after the read.
+        # A blacklisted wid's stale marker counts as seen so the KV loop
+        # stops polling for it.
         for wid in marked:
-            if not (wid in new or wid in self.blacklist
-                    or wid in self._preempted_seen):
-                continue
+            if wid in self.blacklist:
+                self._preempted_seen.add(wid)
             if self._kv is not None:
                 try:
                     self._kv.delete("preempted", wid)

@@ -2,6 +2,7 @@
 and a live rescale integration run with a mutating discovery script
 (reference ``test/integration/test_elastic_torch.py`` pattern)."""
 
+import glob
 import json
 import os
 import stat
@@ -220,6 +221,48 @@ def test_preemption_notice_interrupts_at_commit(tmp_path, hvd):
         assert s.x == 7  # snapshot happened before the interrupt
     finally:
         preemption.reset()
+
+
+def test_driver_reads_preempted_markers_file_and_kv(tmp_path):
+    """Driver-side marker ingestion on both transports: new markers are
+    returned once and consumed; blacklisted/seen wids are filtered and
+    their stale markers cleaned up rather than re-read every poll."""
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    disc = tmp_path / "d.sh"
+    disc.write_text("#!/bin/sh\necho a\n")
+    disc.chmod(disc.stat().st_mode | stat.S_IEXEC)
+    d = ElasticDriver(["true"], str(disc))
+    d._ever_spawned.update({"a:0", "b:0", "c:0"})
+
+    # File transport: markers written the way Notifier.mark_preempted does.
+    for wid in ("a:0", "b:0"):
+        safe = wid.replace(":", "_")
+        with open(f"{d.assignment_path}.preempted.{safe}", "w") as f:
+            f.write(wid)
+    d.blacklist.add("b:0")
+    new = d._read_preempted()
+    assert new == {"a:0"}
+    # Both markers consumed: the new one and the blacklisted stale one.
+    assert not glob.glob(d.assignment_path + ".preempted.*")
+    d._preempted_seen.add("a:0")
+    assert d._read_preempted() == set()
+
+    # KV transport: a fake store behind the same accessor the heartbeats
+    # use.
+    class _KV:
+        def __init__(self):
+            self.store = {("preempted", "c:0"): b"1"}
+
+        def get(self, scope, key):
+            return self.store.get((scope, key))
+
+        def delete(self, scope, key):
+            self.store.pop((scope, key), None)
+
+    d._kv = _KV()
+    assert d._read_preempted() == {"c:0"}
+    assert ("preempted", "c:0") not in d._kv.store  # consumed
 
 
 def test_gce_poll_stops_without_metadata_server(monkeypatch):
