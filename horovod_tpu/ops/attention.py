@@ -44,11 +44,18 @@ _LANES = 128          # TPU lane count: last-dim tile granularity.
 _MIN_BLOCK = 8        # f32 sublane tile; smallest sane seq block.
 _NEG_INF = -1e30      # Softmax mask value (finite: avoids NaN on empty rows).
 
-DEFAULT_BLOCK_Q = 256
-# Swept on the v5e (fwd+bwd, bf16, D128, within-run comparisons): kv=512
-# beats kv=256 by ~19% at S=2048 (17.2 -> 14.0 ms) and ~39% at S=8192
-# (26.8 -> 16.3 ms) -- the wider kv block halves the grid-iteration VMEM
-# swaps per q block and feeds the MXU longer runs.
+# Swept on the v5e (B1 H8 S8192 D128 causal bf16 fwd+bwd, value-fetch
+# fenced, WITHIN-RUN comparisons).  Round 2: kv=512 beats kv=256 by
+# ~19% at S=2048 and ~39% at S=8192 -- the wider kv block halves the
+# grid-iteration VMEM swaps per q block and feeds the MXU longer runs.
+# Round 3 (differential scan-chains, which cancel the tunnel's
+# ~60-120 ms dispatch overhead that inflated round-2's absolute
+# numbers ~4x at this shape): q=512 beats q=256 by ~16% at S=8192
+# (5.18 -> 4.33 ms true kernel time, ~57% MFU) and directionally at
+# S=2048 -- the bigger q tile amortizes the backward's dq/dk/dv
+# re-reads.  Shorter sequences clamp the block to the sequence
+# automatically.
+DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_KV = 512
 
 
