@@ -8,9 +8,13 @@ Parity surface (reference ``horovod/tensorflow/__init__.py`` +
 ``broadcast_variables``, and ``DistributedOptimizer`` for Keras.
 
 TF stays the user-facing autograd engine on host CPU; collectives stage
-through numpy onto the XLA mesh (same bridge as the torch shim).  TF2
-eager only -- the reference's TF1 session hooks
-(``BroadcastGlobalVariablesHook``) are intentionally out of scope.
+through numpy onto the XLA mesh (same bridge as the torch shim).  The
+design is TF2-eager-first, but the reference's TF1 session surface
+(``broadcast_global_variables`` + ``BroadcastGlobalVariablesHook``) is
+provided through ``tf.compat.v1``: the broadcast is a re-runnable graph
+op (a ``tf.py_function`` hop into the mesh collective feeding grouped
+assigns), so ``MonitoredTrainingSession``/estimator-style TF1 scripts
+port unchanged.
 """
 
 from __future__ import annotations
@@ -176,6 +180,77 @@ def broadcast_variables(variables, root_rank: int = 0,
                                   process_set=process_set)
     for v, row in zip(variables, rows):
         v.assign(tf.convert_to_tensor(row, dtype=v.dtype))
+
+
+def broadcast_global_variables(root_rank: int = 0, process_set=None):
+    """Broadcast all TF1 global variables from ``root_rank``.
+
+    Reference parity: ``horovod.tensorflow.broadcast_global_variables``
+    (SURVEY.md 3.4, the TF1 half of the API).  Graph mode
+    (``tf.compat.v1`` sessions): returns a re-runnable op -- a
+    ``tf.py_function`` that runs the fused mesh broadcast and feeds one
+    assign per variable (the reference registers a native
+    ``HorovodBroadcast`` kernel; the py_function hop is this shim's
+    standard graph bridge, same as ``grouped_allreduce``).  Eager mode
+    raises like the reference: eager variables never reach the
+    ``global_variables()`` collection, so a silent no-op would leave
+    every rank on its own init -- use ``broadcast_variables``.
+    """
+    v1 = tf.compat.v1
+    if tf.executing_eagerly():
+        raise RuntimeError(
+            "hvd.broadcast_global_variables() does not support eager "
+            "execution. Please use `hvd.broadcast_variables(<model/"
+            "optimizer variables>)` instead.")
+    variables = v1.global_variables()
+    if not variables:
+        return tf.no_op(name="horovod_broadcast_global_variables")
+
+    def _dispatch(*ts):
+        rows = _eager.broadcast_fused(
+            [np.asarray(t) for t in ts], root_rank,
+            name="broadcast.global_vars", process_set=process_set)
+        return [tf.convert_to_tensor(r) for r in rows]
+
+    outs = tf.py_function(_dispatch, [v.read_value() for v in variables],
+                          [v.dtype.base_dtype for v in variables])
+    assigns = []
+    for v, o in zip(variables, outs):
+        o.set_shape(v.shape)
+        assigns.append(v1.assign(v, o))
+    return tf.group(*assigns, name="horovod_broadcast_global_variables")
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """TF1 ``SessionRunHook`` broadcasting initial state from ``root_rank``.
+
+    Reference parity: ``horovod.tensorflow.BroadcastGlobalVariablesHook``
+    (SURVEY.md 3.4 -- the last TF1 surface).  Use with
+    ``tf.compat.v1.train.MonitoredTrainingSession`` or estimators: the
+    broadcast op is (re)built in ``begin()`` against the current graph and
+    run once in ``after_create_session``, i.e. after variable
+    initialization, exactly the reference's hook protocol.  ``device`` is
+    accepted for signature parity (placement is the mesh's concern here).
+    """
+
+    def __init__(self, root_rank: int = 0, device: str = "",
+                 process_set=None):
+        super().__init__()
+        self.root_rank = root_rank
+        self.device = device
+        self.process_set = process_set
+        self.bcast_op = None
+
+    def begin(self):
+        if (self.bcast_op is None
+                or self.bcast_op.graph is not
+                tf.compat.v1.get_default_graph()):
+            with tf.device(self.device):
+                self.bcast_op = broadcast_global_variables(
+                    self.root_rank, process_set=self.process_set)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
 
 
 def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):

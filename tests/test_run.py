@@ -515,3 +515,41 @@ def test_launcher_log_level_flag():
         env=env, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "horovod_tpu initialized" in out.stdout + out.stderr
+
+
+_TF1_HOOK_SCRIPT = '''
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import tensorflow as tf
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+r = int(os.environ["HOROVOD_RANK"])
+v1 = tf.compat.v1
+with tf.Graph().as_default():
+    # Ranks initialize DIFFERENTLY; the hook must impose rank 0's values.
+    v = v1.get_variable("w", initializer=tf.constant([100.0 * r, 1.0 + r]))
+    hook = hvd.BroadcastGlobalVariablesHook(root_rank=0)
+    with v1.train.MonitoredTrainingSession(hooks=[hook]) as sess:
+        out = sess.run(v)
+np.testing.assert_allclose(out, [0.0, 1.0])
+print(f"rank {{r}}: tf1 hook OK", flush=True)
+'''
+
+
+@pytest.mark.integration
+def test_tf1_hook_broadcasts_across_processes(tmp_path):
+    """The TF1 session hook moves rank 0's initial variable values to every
+    rank through the mesh broadcast (reference hook semantics)."""
+    script = tmp_path / "tf1_hook_check.py"
+    script.write_text(_TF1_HOOK_SCRIPT.format(repo=REPO))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--cpu",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "rank 0: tf1 hook OK" in out.stdout
+    assert "rank 1: tf1 hook OK" in out.stdout

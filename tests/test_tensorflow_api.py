@@ -240,3 +240,57 @@ def test_tensorflow_keras_import_path(hvd):
     assert khvd.size() == hvd.size()
     # __all__ keeps implementation modules out of the alias surface.
     assert not hasattr(khvd, "np")
+
+
+def test_tf1_broadcast_global_variables_graph_mode(hvd_tf):
+    """Reference TF1 parity: broadcast_global_variables is a re-runnable
+    graph op under tf.compat.v1 sessions."""
+    v1 = tf.compat.v1
+    with tf.Graph().as_default():
+        a = v1.get_variable("bgv_a", initializer=tf.constant([1.0, 2.0]))
+        b = v1.get_variable("bgv_b", initializer=tf.constant([[3]]))
+        op = hvd_tf.broadcast_global_variables(0)
+        with v1.Session() as sess:
+            sess.run(v1.global_variables_initializer())
+            sess.run(a.assign([5.0, 6.0]))
+            sess.run(op)   # single-process: values survive the mesh hop
+            out_a, out_b = sess.run([a, b])
+    np.testing.assert_allclose(out_a, [5.0, 6.0])
+    np.testing.assert_allclose(out_b, [[3]])
+
+
+def test_tf1_broadcast_hook_monitored_session(hvd_tf):
+    """The reference hook protocol: built in begin(), run once after
+    variable init by MonitoredTrainingSession."""
+    v1 = tf.compat.v1
+    with tf.Graph().as_default():
+        v = v1.get_variable("hook_v", initializer=tf.constant([7.0, 8.0]))
+        hook = hvd_tf.BroadcastGlobalVariablesHook(root_rank=0)
+        with v1.train.MonitoredTrainingSession(hooks=[hook]) as sess:
+            assert hook.bcast_op is not None
+            out = sess.run(v)
+    np.testing.assert_allclose(out, [7.0, 8.0])
+
+
+def test_tf1_hook_rebuilds_op_per_graph(hvd_tf):
+    """begin() must rebuild the op when the default graph changes
+    (reference behavior: one hook object reused across estimator runs)."""
+    v1 = tf.compat.v1
+    hook = hvd_tf.BroadcastGlobalVariablesHook(root_rank=0)
+    with tf.Graph().as_default() as g1:
+        v1.get_variable("r1", initializer=tf.constant(1.0))
+        hook.begin()
+        op1 = hook.bcast_op
+        assert op1.graph is g1
+    with tf.Graph().as_default() as g2:
+        v1.get_variable("r2", initializer=tf.constant(2.0))
+        hook.begin()
+        assert hook.bcast_op is not op1
+        assert hook.bcast_op.graph is g2
+
+
+def test_tf1_broadcast_global_variables_eager_raises(hvd_tf):
+    # Reference parity: loud RuntimeError under eager (a silent no-op
+    # would leave each rank on its own init).
+    with pytest.raises(RuntimeError, match="does not support eager"):
+        hvd_tf.broadcast_global_variables(0)
