@@ -178,3 +178,44 @@ def test_bench_scaling_gate_rn50():
     assert rn50["spread"] <= 0.02
     # North star: >= 90% at 256 v5e chips even without overlap.
     assert rn50["eff_256_v5e"][0] >= 0.90
+
+
+def test_reference_headline_models_beat_reference_scaling():
+    """The reference's own headline table (SURVEY.md section 6): ~90%
+    (Inception V3), ~90% (ResNet-101), ~68% (comm-bound VGG-16) of linear
+    at 128 GPUs on 25 GbE.  The same three models, projected from OUR
+    measured batch-128 single-chip step times and HLO-verified payloads
+    (bench_scaling runs recorded in docs/benchmarks.md), beat every row
+    at 128 v5e chips even with ZERO overlap -- ICI bandwidth removes the
+    comm-bound regime that cost the reference 32 points on VGG."""
+    cases = {
+        # payload bytes from the HLO wire accounting (planner-matched)
+        "resnet101": (128 / 1269.0, 178618020, 0.95),
+        "inception-v3": (128 / 1325.0, 95476004, 0.95),
+        "vgg16": (128 / 1001.0, 553430180, 0.90),
+    }
+    for name, (step_s, payload, bar) in cases.items():
+        pts = scaling.predict_efficiency(step_s, payload, scaling.V5E)
+        e128 = [p for p in pts if p.n == 128][0]
+        assert e128.eff_no_overlap >= bar, (name, e128.eff_no_overlap)
+
+
+@pytest.mark.slow
+def test_bench_scaling_gate_vgg16():
+    """VGG-16 through the live harness: the comm-bound reference case.
+    527.8 MiB of fp32 wire (its 224x224 fc1 dominates -- the payload is
+    resolution-dependent, unlike the other CNNs) still projects >= 90%
+    at 128 v5e chips; the payload invariants gate like rn50's."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_scaling.py"),
+         "--models", "vgg16", "--ns", "8", "16"],
+        capture_output=True, text=True, timeout=1200, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["ok"] is True
+    vgg = summary["models"]["vgg16"]
+    assert vgg["buckets"] == 5                   # 527.8 MiB fp32 @ 64 MiB
+    assert vgg["payload_bytes"] == pytest.approx(553430180, rel=1e-6)
+    assert vgg["eff_128_v5e"][0] >= 0.90
