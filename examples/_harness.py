@@ -54,3 +54,61 @@ def timed_training(step, params, opt_state, data, steps: int,
         print(f"{rate:.1f} {unit}/s ({rate / hvd.size():.1f}/chip), "
               f"final loss {float(losses[-1]):.4f}")
     return params, opt_state
+
+
+def nonlinear_tap(carry, val):
+    """Chain ``val`` into ``carry`` through a non-linear full-tensor tap.
+
+    The tap must consume EVERY element of ``val`` NON-LINEARLY: a sliced
+    tap lets XLA dead-code the producing op (slice-of-conv ->
+    conv-of-slice) and a plain sum lets the algebraic simplifier collapse
+    reduce-through-contraction -- both measured producing impossible
+    above-peak readings.  A sum of squares survives and fuses with the
+    producer's output write.
+    """
+    import jax.numpy as jnp
+    v32 = val.astype(jnp.float32)
+    s = jnp.sum(v32 * v32)
+    return carry * (1.0 + s * 1e-24).astype(carry.dtype), s
+
+
+def differential_bench(make_body, example_carry, iters: int,
+                       k_spread: int = 256, reps: int = 3):
+    """Seconds/op by DIFFERENTIAL timing on the tunnelled chip.
+
+    The tunnel adds a large fixed per-dispatch overhead (tens of ms) and
+    +-15% jitter, so one scan-chained dispatch of K1 ops and one of
+    K1+k_spread are timed (best of ``reps``, honest device->host
+    value-fetch fence) and the slope (t2-t1)/(k2-k1) cancels both.
+    ``make_body()`` returns a ``lax.scan`` body whose iterations
+    data-depend through :func:`nonlinear_tap` so XLA can neither hoist
+    nor batch them.  Returns ``(secs_per_op, reliable)`` -- ``reliable``
+    is False when the spread is within ~2x the jitter envelope and the
+    slope must not be read as a throughput claim.
+    """
+    import jax
+    from jax import lax
+
+    def make(k):
+        @jax.jit
+        def run(c):
+            _o, taps = lax.scan(make_body(), c, None, length=k)
+            return taps[-1]
+        return run
+
+    k1, k2 = iters, iters + k_spread
+    r1, r2 = make(k1), make(k2)
+
+    def timed(fn):
+        float(fn(example_carry))          # compile + warm fence
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(fn(example_carry))      # value fetch = honest fence
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t2 = timed(r1), timed(r2)
+    secs = max((t2 - t1) / (k2 - k1), 1e-9)
+    reliable = (t2 - t1) > 0.2 * t1
+    return secs, reliable

@@ -3,7 +3,7 @@
 Benchmark workload parity: VGG-16 is the reference's *comm-bound*
 headline workload (~68% of linear at 128 accelerators, and the one where
 RDMA vs TCP mattered -- ``docs/benchmarks.rst``, SURVEY.md section 6).
-Its ~134M parameters (102M of them in the first FC layer) make the
+Its ~138M parameters (103M of them in the first FC layer) make the
 gradient allreduce the bottleneck, which is exactly what it stresses in
 this framework too: one fused bucket sweep moves >500 MB of fp32
 gradients per step through the collective layer.
