@@ -2,11 +2,13 @@
 
 The per-op roofline (``rn50_op_roofline.py``, docs/benchmarks.md "The
 per-op account") measured the backward pass at 3.0x the forward's wall
-time with only 2x its FLOPs: the dgrad/wgrad convolutions XLA emits run
-~1.5x slower per FLOP than the forward convs, and the TPU compiler flags
-that steer their layouts are rejected by the tunnelled plugin.  The one
-layout knob still in user hands is the MODEL's data layout, so this
-probe answers, by measurement: would an NCHW ResNet be faster?
+time with only 2x its FLOPs; round 2 INFERRED the dgrad/wgrad convs ran
+~1.5x slower per FLOP (this probe and ``rn50_bwd_roofline.py`` later
+showed the kernels are in fact near peak and the gap is HBM-bound glue).
+The TPU compiler flags that steer backward layouts are rejected by the
+tunnelled plugin, so the one layout knob in user hands is the MODEL's
+data layout; this probe answers, by measurement: would an NCHW ResNet
+be faster?  (Measured answer: no -- NCHW loses on backward.)
 
 Method: for each stride-1 SAME 3x3 conv shape in RN50 (where the FLOPs
 live; Cin==Cout so cotangents chain shape-stably), time forward, dgrad

@@ -142,6 +142,7 @@ def main():
           "eff TFLOP/s | % peak (eff) |")
     print("|---|---|---|---|---|---|---|---|")
     total_time = 0.0
+    low_signal_n = 0
     for cfg in sel:
         (lhs_s, _lt, rhs_s, _rt, _o, strides, _pad, ld, _rd, _dn,
          _fg, _bg) = cfg
@@ -149,18 +150,24 @@ def main():
         nf = naive_flops(cfg)
         ef = nf / int(np.prod(ld))
         n = counts[cfg]
-        total_time += secs * n
         naive_tf = nf / secs / 1e12
         eff_tf = ef / secs / 1e12
         # Naive rate legitimately exceeds peak for dilated convs (XLA
         # skips the inserted zeros); only the EFFECTIVE rate is bounded
         # by physics, so the above-peak sanity cap applies to it.
         ok = ok and eff_tf * 1e12 <= 1.05 * V5E_BF16_PEAK
+        if ok:
+            total_time += secs * n
+        else:
+            low_signal_n += n
         tag = "" if ok else " (low signal)"
         print(f"| {lhs_s} x {rhs_s} | s{strides} | {ld} | {n} "
               f"| {secs*1e3:.3f} | {naive_tf:.1f} | {eff_tf:.1f} "
               f"| {eff_tf*1e12/V5E_BF16_PEAK:.0%}{tag} |", flush=True)
-    print(f"\nselected configs sum: {total_time*1e3:.1f} ms/backward "
+    caveat = (f"; {low_signal_n} low-signal convs EXCLUDED from the sum"
+              if low_signal_n else "")
+    print(f"\nselected configs sum (reliable rows only): "
+          f"{total_time*1e3:.1f} ms/backward{caveat} "
           f"(skipped tail: {skipped_fl/1e9:.1f} naive GFLOP)")
     return 0
 

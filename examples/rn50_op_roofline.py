@@ -25,10 +25,10 @@ space-to-depth stem)::
 
 import sys as _sys
 from os.path import abspath as _abs, dirname as _dir
-_sys.path.insert(0, _dir(_dir(_abs(__file__))))
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root
+_sys.path.insert(0, _dir(_abs(__file__)))        # examples/ (_harness)
 
 import argparse
-import time
 
 
 V5E_BF16_PEAK = 197e12      # published v5e peak, bf16
@@ -100,61 +100,27 @@ def main():
     prec = (lax.Precision.HIGHEST if args.precision == "highest"
             else lax.Precision.DEFAULT)
 
+    from _harness import differential_bench, nonlinear_tap
+
     def bench_conv(lhs_s, rhs_s, out_s, strides, padding, iters):
-        """Seconds/conv by DIFFERENTIAL timing: the tunnel adds a large
-        fixed per-dispatch overhead (tens of ms), so one scan-chained
-        dispatch of K1 convs and one of K2 are timed and the slope
-        (t2-t1)/(K2-K1) cancels it.  Iterations data-depend through a
-        scalar tap so XLA cannot hoist or parallelize them."""
+        """Seconds/conv via the shared differential scan-chain method
+        (``_harness.differential_bench`` -- overhead cancels in the
+        slope; the non-linear tap defeats dead-coding)."""
         key = jax.random.PRNGKey(1)
         xb = jax.random.normal(key, lhs_s, jnp.bfloat16)
         w = jax.random.normal(key, rhs_s, jnp.bfloat16) * 0.01
 
-        def body(carry, _):
-            y = lax.conv_general_dilated(
-                carry, w, window_strides=strides, padding=list(padding),
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                precision=prec)
-            # The tap must consume EVERY output element NON-LINEARLY: a
-            # single-element slice lets XLA dead-code the conv
-            # (slice-of-conv -> conv-of-slice), and a plain sum lets the
-            # algebraic simplifier collapse reduce-through-contraction
-            # (measured both: "9,400 TFLOP/s convs").  A sum of SQUARES
-            # survives; it fuses with the conv's output write.
-            y32 = y.astype(jnp.float32)
-            s = jnp.sum(y32 * y32)
-            return carry * (1.0 + s * 1e-24).astype(carry.dtype), s
+        def make_body():
+            def body(carry, _):
+                y = lax.conv_general_dilated(
+                    carry, w, window_strides=strides,
+                    padding=list(padding),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    precision=prec)
+                return nonlinear_tap(carry, y)
+            return body
 
-        def make(k):
-            @jax.jit
-            def run(xb):
-                _out, taps = lax.scan(body, xb, None, length=k)
-                return taps[-1]
-            return run
-
-        # The spread must dwarf the tunnel's +-15% dispatch jitter (the
-        # fixed dispatch overhead alone is ~60-120 ms), so the long chain
-        # runs 256 more convs than the short one, and each program takes
-        # the best of 3 runs.
-        k1, k2 = iters, iters + 256
-        r1, r2 = make(k1), make(k2)
-
-        def timed(fn, reps=3):
-            float(fn(xb))             # compile + warm fence
-            best = float("inf")
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                float(fn(xb))         # value fetch: the only honest fence
-                best = min(best, time.perf_counter() - t0)
-            return best
-
-        t1, t2 = timed(r1), timed(r2)
-        secs = max((t2 - t1) / (k2 - k1), 1e-9)
-        # Low-signal flag: when the 256-iter spread is within ~2x the
-        # tunnel's run-to-run jitter (~10% of a dispatch), the slope is
-        # noise and the row must not be read as a throughput claim.
-        reliable = (t2 - t1) > 0.2 * t1
-        return secs, reliable
+        return differential_bench(make_body, xb, iters)
 
     # Cap to the FLOP-dominant configs (the tail adds compile time, not
     # information); track the skipped share honestly.
@@ -184,31 +150,14 @@ def main():
 
     # ---- full forward for the residual, same differential method (a
     # scan chains forwards through a scalar tap on the logits).
-    def fwd_body(carry, _):
-        logits = model.apply(variables, carry, train=False)
-        l32 = logits.astype(jnp.float32)
-        s = jnp.sum(l32 * l32)  # nonlinear full consumption (see above)
-        return carry * (1.0 + s * 1e-24).astype(carry.dtype), s
+    def make_fwd_body():
+        def fwd_body(carry, _):
+            logits = model.apply(variables, carry, train=False)
+            return nonlinear_tap(carry, logits)
+        return fwd_body
 
-    def make_fwd(k):
-        @jax.jit
-        def run(xb):
-            _o, taps = lax.scan(fwd_body, xb, None, length=k)
-            return taps[-1]
-        return run
-
-    def timed(fn, arg, reps=2):
-        float(fn(arg))
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            float(fn(arg))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    t1 = timed(make_fwd(3), x, reps=3)
-    t2 = timed(make_fwd(13), x, reps=3)
-    fwd_secs = max((t2 - t1) / 10, 1e-9)
+    fwd_secs, _fwd_ok = differential_bench(make_fwd_body, x, 3,
+                                           k_spread=10)
 
     # ---- fwd+bwd (no BN-stat mutation): is the backward's per-FLOP rate
     # really ~the forward's, or is the step-time gap elsewhere?
@@ -221,25 +170,20 @@ def main():
         l32 = logits.astype(jnp.float32)
         return jnp.sum(l32 * l32) * 1e-6
 
-    def fb_body(carry, _):
-        loss, grads = jax.value_and_grad(loss_of)(carry, x)
-        # Consume EVERY gradient leaf nonlinearly, or XLA dead-codes the
-        # unconsumed parts of the backward.
-        s = loss + sum(jnp.sum(g.astype(jnp.float32) ** 2)
-                       for g in jax.tree.leaves(grads))
-        return jax.tree.map(
-            lambda p: p * (1.0 + s * 1e-24).astype(p.dtype), carry), s
+    def make_fb_body():
+        def fb_body(carry, _):
+            loss, grads = jax.value_and_grad(loss_of)(carry, x)
+            # Consume EVERY gradient leaf nonlinearly, or XLA dead-codes
+            # the unconsumed parts of the backward (a pytree carry, so
+            # the scalar tap maps over leaves instead of nonlinear_tap).
+            s = loss + sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                           for g in jax.tree.leaves(grads))
+            return jax.tree.map(
+                lambda p: p * (1.0 + s * 1e-24).astype(p.dtype), carry), s
+        return fb_body
 
-    def make_fb(k):
-        @jax.jit
-        def run(p):
-            _o, taps = lax.scan(fb_body, p, None, length=k)
-            return taps[-1]
-        return run
-
-    t1 = timed(make_fb(2), params0, reps=3)
-    t2 = timed(make_fb(8), params0, reps=3)
-    fb_secs = max((t2 - t1) / 6, 1e-9)
+    fb_secs, _fb_ok = differential_bench(make_fb_body, params0, 2,
+                                         k_spread=6)
 
     hdr = ("| conv (in -> kernel, stride) | count | ms/op | TFLOP/s | "
            "% of roofline |")

@@ -126,10 +126,78 @@ class Config:
     compile_cache: Optional[str] = None
 
 
+# The fixed port worker 0 serves the JAX coordination service on when
+# the pod environment does not name one (matches jax's own TPU cluster
+# detection, so mixed bootstrap paths still rendezvous).
+TPU_POD_COORDINATOR_PORT = 8476
+
+
+def detect_tpu_pod() -> Optional[dict]:
+    """Multi-host Cloud TPU slice environment -> process identity.
+
+    On a multi-host TPU slice the runtime exports
+    ``TPU_WORKER_HOSTNAMES`` (comma-separated, worker 0 first) and
+    ``TPU_WORKER_ID`` (this host's index; older images spell it
+    ``CLOUD_TPU_TASK_ID``).  This is the pod-native analogue of the
+    launcher's LSF allocation detection (``run/lsf.py``) and of the
+    reference inheriting placement from ``mpirun`` (SURVEY.md 4.4):
+    ``hvd.init()`` on each pod host bootstraps unaided, with worker 0
+    hosting the coordination service.  Explicit ``HOROVOD_RANK``/
+    ``HVD_TPU_COORDINATOR_ADDR`` always win; disable detection entirely
+    with ``HOROVOD_NO_TPU_POD_DETECT=1``.
+
+    Returns ``{"addr", "port", "rank", "size"}`` or ``None`` when not on
+    a multi-host slice (single-host slices need no coordination).
+    """
+    if _env_bool("NO_TPU_POD_DETECT"):
+        return None
+    names = [h.strip() for h in
+             os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+             if h.strip()]
+    if len(names) < 2:
+        return None
+    # Like _env_int, a set-but-empty variable counts as unset (a wrapper
+    # exporting TPU_WORKER_ID= must not mask a valid CLOUD_TPU_TASK_ID).
+    wid = os.environ.get("TPU_WORKER_ID", "").strip() or \
+        os.environ.get("CLOUD_TPU_TASK_ID", "").strip()
+    if not wid.isdigit():
+        return None
+    rank = int(wid)
+    if rank >= len(names):
+        return None
+    return {"addr": names[0], "port": TPU_POD_COORDINATOR_PORT,
+            "rank": rank, "size": len(names)}
+
+
 def load_config() -> Config:
     """Parse the environment into a :class:`Config`."""
     addr = _env("COORDINATOR_ADDR") or _env("GLOO_RENDEZVOUS_ADDR")
     port = _env_int("COORDINATOR_PORT", _env_int("GLOO_RENDEZVOUS_PORT", 0))
+    env_rank = _env_int("RANK", -1)
+    env_size = _env_int("SIZE", -1)
+    env_local_rank = _env_int("LOCAL_RANK", -1)
+    env_local_size = _env_int("LOCAL_SIZE", -1)
+    env_cross_rank = _env_int("CROSS_RANK", -1)
+    env_cross_size = _env_int("CROSS_SIZE", -1)
+    if addr is None:
+        pod = detect_tpu_pod()
+        if pod is not None:
+            addr = pod["addr"]
+            if port == 0:
+                port = pod["port"]
+            if env_rank < 0:
+                env_rank = pod["rank"]
+            if env_size < 0:
+                env_size = pod["size"]
+            # One process per pod host: host index IS the cross rank.
+            if env_cross_rank < 0:
+                env_cross_rank = pod["rank"]
+            if env_cross_size < 0:
+                env_cross_size = pod["size"]
+            if env_local_rank < 0:
+                env_local_rank = 0
+            if env_local_size < 0:
+                env_local_size = 1
     return Config(
         fusion_threshold=_env_int("FUSION_THRESHOLD", 64 * _MiB),
         cache_capacity=_env_int("CACHE_CAPACITY", 1024),
@@ -149,12 +217,12 @@ def load_config() -> Config:
         elastic_timeout=_env_float("ELASTIC_TIMEOUT", 600.0),
         log_level=_env("LOG_LEVEL", "warning") or "warning",
         log_hide_timestamp=_env_bool("LOG_HIDE_TIMESTAMP"),
-        env_rank=_env_int("RANK", -1),
-        env_size=_env_int("SIZE", -1),
-        env_local_rank=_env_int("LOCAL_RANK", -1),
-        env_local_size=_env_int("LOCAL_SIZE", -1),
-        env_cross_rank=_env_int("CROSS_RANK", -1),
-        env_cross_size=_env_int("CROSS_SIZE", -1),
+        env_rank=env_rank,
+        env_size=env_size,
+        env_local_rank=env_local_rank,
+        env_local_size=env_local_size,
+        env_cross_rank=env_cross_rank,
+        env_cross_size=env_cross_size,
         coordinator_addr=addr,
         coordinator_port=port,
         compile_cache=_env("COMPILE_CACHE"),

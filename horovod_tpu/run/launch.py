@@ -124,6 +124,12 @@ def check_build() -> str:
         "    [X] sequence parallelism (ring + Ulysses attention)",
         f"jax {jax.__version__}",
     ]
+    from ..core.config import detect_tpu_pod
+    pod = detect_tpu_pod()
+    if pod is not None:
+        lines.append(
+            f"TPU pod slice detected: worker {pod['rank']}/{pod['size']}, "
+            f"coordinator {pod['addr']}:{pod['port']}")
     return "\n".join(lines)
 
 

@@ -110,3 +110,72 @@ def test_remove_process_set_accepts_object(hvd):
     ps2 = h.add_process_set([0, 1] if hvd.size() > 1 else [0],
                             name="rm_by_obj")  # re-register must succeed
     h.remove_process_set("rm_by_obj")  # name form still works
+
+
+def test_tpu_pod_detection(monkeypatch):
+    """Multi-host TPU slice env bootstraps identity unaided (the
+    launcher-less pod path: SURVEY 4.4 mpirun-placement analogue)."""
+    from horovod_tpu.core.config import (TPU_POD_COORDINATOR_PORT,
+                                         detect_tpu_pod)
+    for k in ("TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
+              "HOROVOD_RANK", "HOROVOD_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    assert detect_tpu_pod() is None               # not on a pod
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1k-w0, t1k-w1 ,t1k-w2")
+    assert detect_tpu_pod() is None               # hostnames but no id
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    pod = detect_tpu_pod()
+    assert pod == {"addr": "t1k-w0", "port": TPU_POD_COORDINATOR_PORT,
+                   "rank": 2, "size": 3}
+
+    cfg = load_config()
+    assert cfg.coordinator_addr == "t1k-w0"
+    assert cfg.coordinator_port == TPU_POD_COORDINATOR_PORT
+    assert cfg.env_rank == 2 and cfg.env_size == 3
+    assert cfg.env_cross_rank == 2 and cfg.env_cross_size == 3
+    assert cfg.env_local_rank == 0 and cfg.env_local_size == 1
+
+    # Single-host slice: one hostname -> no coordination needed.
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1k-w0")
+    assert detect_tpu_pod() is None
+
+    # Out-of-range / non-numeric ids are rejected, not crashed on.
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b")
+    monkeypatch.setenv("TPU_WORKER_ID", "7")
+    assert detect_tpu_pod() is None
+    monkeypatch.setenv("TPU_WORKER_ID", "not-a-number")
+    assert detect_tpu_pod() is None
+
+
+def test_tpu_pod_detection_precedence(monkeypatch):
+    """Explicit launcher identity and coordinator always win; the kill
+    switch disables detection outright."""
+    from horovod_tpu.core.config import detect_tpu_pod
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    cfg = load_config()
+    assert cfg.env_rank == 0 and cfg.env_size == 2   # launcher wins
+    assert cfg.coordinator_addr == "w0"              # addr still derived
+
+    monkeypatch.setenv("HVD_TPU_COORDINATOR_ADDR", "10.0.0.9")
+    monkeypatch.setenv("HVD_TPU_COORDINATOR_PORT", "7777")
+    cfg = load_config()
+    assert cfg.coordinator_addr == "10.0.0.9"
+    assert cfg.coordinator_port == 7777
+
+    monkeypatch.delenv("HVD_TPU_COORDINATOR_ADDR")
+    monkeypatch.setenv("HOROVOD_NO_TPU_POD_DETECT", "1")
+    assert detect_tpu_pod() is None
+    cfg = load_config()
+    assert cfg.coordinator_addr is None
+
+    # Older image spelling.
+    monkeypatch.delenv("HOROVOD_NO_TPU_POD_DETECT")
+    monkeypatch.delenv("TPU_WORKER_ID")
+    monkeypatch.setenv("CLOUD_TPU_TASK_ID", "0")
+    pod = detect_tpu_pod()
+    assert pod is not None and pod["rank"] == 0
