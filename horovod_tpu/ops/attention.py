@@ -85,16 +85,40 @@ def _block(seq: int, preferred: int) -> int:
     return max(b, 0)
 
 
+def _block_lane(seq: int, preferred: int) -> int:
+    """Largest block <= preferred dividing seq that also satisfies the
+    LANE-dim rule (multiple of 128, or the whole sequence), else 0.
+
+    The whole-sequence case still requires the 8-sublane rule (the same
+    block tiles q/k/v), so non-8-multiple sequences fall back like the
+    non-segment path does.
+    """
+    if seq <= preferred:
+        return seq if seq % _MIN_BLOCK == 0 else 0
+    b = min(preferred, seq) // _LANES * _LANES
+    while b >= _LANES and seq % b:
+        b -= _LANES
+    return max(b, 0)
+
+
 # ---------------------------------------------------------------------------
 # Reference (XLA) implementation -- ground truth + CPU fallback.
 # ---------------------------------------------------------------------------
 
 def attention_reference(q, k, v, *, causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        segment_ids=None, kv_segment_ids=None):
     """Plain XLA attention. q,k,v: (batch, heads, seq, head_dim).
 
     Causal masking is bottom-right aligned: with ``tq < tk`` (decode with a
     KV cache), query ``i`` attends keys ``0 .. tk - tq + i``.
+
+    ``segment_ids``/``kv_segment_ids`` (``(batch, tq)`` / ``(batch, tk)``
+    int): a query attends only keys with an EQUAL segment id -- the
+    packed-sequence convention (and padding isolation: give pad tokens a
+    segment of their own).  A row whose segment matches no key degenerates
+    to a uniform softmax (garbage output on pad rows; mask them in the
+    loss), identical between this reference and the Pallas kernels.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -104,7 +128,22 @@ def attention_reference(q, k, v, *, causal: bool = False,
         tq, tk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
         logits = jnp.where(mask, logits, _NEG_INF)
+    if segment_ids is not None:
+        if kv_segment_ids is None:
+            if q.shape[2] != k.shape[2]:
+                raise ValueError("kv_segment_ids is required when "
+                                 "tq != tk")
+            kv_segment_ids = segment_ids
+        seg = (segment_ids[:, None, :, None]
+               == kv_segment_ids[:, None, None, :])
+        logits = jnp.where(seg, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
+    if segment_ids is not None:
+        # DEAD rows (segment matches no key, e.g. padding): zero output
+        # and zero gradients, matching the Pallas kernels -- not the
+        # uniform softmax a plain -inf mask degenerates to.
+        alive = jnp.max(logits, axis=-1, keepdims=True) > _NEG_INF / 2
+        probs = jnp.where(alive, probs, 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v,
                      preferred_element_type=jnp.float32)
     return out.astype(v.dtype)
@@ -116,12 +155,25 @@ def _causal_mask(s, qi, ki, bq, bk, off):
     return jnp.where(rows >= cols, s, _NEG_INF)
 
 
+def _seg_mask(s, qseg_ref, kseg_ref):
+    """Mask logits where query/key segment ids differ (refs hold the
+    ``(1, bq)`` / ``(1, bk)`` id blocks for this grid cell)."""
+    qs = qseg_ref[0, 0][:, None]                  # (bq, 1)
+    ks = kseg_ref[0, 0][None, :]                  # (1, bk)
+    return jnp.where(qs == ks, s, _NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel.
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk, off):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, has_seg,
+                bq, bk, nk, off):
+    if has_seg:
+        qseg_ref, kseg_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -132,6 +184,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # Causal: block is live unless it lies entirely above the diagonal.
+    # (Segment boundaries are dynamic, so segment masking skips no
+    # blocks -- it only masks within them.)
     live = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
 
     @pl.when(live)
@@ -142,6 +196,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk, off)
+        if has_seg:
+            s = _seg_mask(s, qseg_ref, kseg_ref)
 
         m_prev = m_scr[:, :1]                        # (bq, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)    # (bq, 1)
@@ -160,12 +216,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finish():
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        o = acc_scr[:] / l_safe
         lse = m_scr[:, :1] + jnp.log(l_safe)
+        if has_seg:
+            # DEAD rows (m never rose above the mask floor): zero the
+            # output, and push lse to +BIG so both backward kernels'
+            # p = exp(s - lse) underflows to exactly 0 -- without this,
+            # f32 absorbs log(l) into -1e30 and the backward sees
+            # p = 1 PER KEY (a ~tk-fold gradient explosion on pad rows;
+            # caught by review, regression-tested).
+            dead = m_scr[:, :1] <= _NEG_INF / 2
+            o = jnp.where(dead, 0.0, o)
+            lse = jnp.where(dead, -_NEG_INF, lse)
+        o_ref[0, 0] = o.astype(o_ref.dtype)
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[-2:])
 
 
-def _flash_fwd(q, k, v, *, scale, causal, bq, bk):
+def _flash_fwd(q, k, v, qseg, kseg, *, scale, causal, bq, bk):
     batch, heads, tq, d = q.shape
     tk = k.shape[2]
     rep = heads // k.shape[1]
@@ -174,18 +241,31 @@ def _flash_fwd(q, k, v, *, scale, causal, bq, bk):
     nq, nk = tq // bq, tk // bk
     off = tk - tq
     grid = (batch, heads, nq, nk)
+    has_seg = qseg is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk, off=off)
+                               has_seg=has_seg, bq=bq, bk=bk, nk=nk,
+                               off=off)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b, h, i, j: (b, h // rep, j, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b, h, i, j: (b, h // rep, j, 0)),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        # (batch, 1, t) with a (1, 1, block) spec: the sublane block dim
+        # equals the array dim (Mosaic's last-two-dims rule); the lane
+        # dim must divide by 128 or equal t (dispatcher guarantees it).
+        in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j)),
+        ]
+        operands += [qseg[:, None, :], kseg[:, None, :]]
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b, h, i, j: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b, h, i, j: (b, h // rep, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, _LANES), lambda b, h, i, j: (b, h, i, 0)),
@@ -200,7 +280,7 @@ def _flash_fwd(q, k, v, *, scale, causal, bq, bk):
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
     return o, lse[..., 0]
 
 
@@ -208,8 +288,13 @@ def _flash_fwd(q, k, v, *, scale, causal, bq, bk):
 # Backward kernels.
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, bq, bk, nk, off):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, has_seg, bq, bk, nk, off):
+    if has_seg:
+        qseg_ref, kseg_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -231,6 +316,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk, off)
+        if has_seg:
+            s = _seg_mask(s, qseg_ref, kseg_ref)
         p = jnp.exp(s - lse)                               # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -243,9 +330,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, bq, bk, nq, off):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, has_seg, bq, bk, nq, off):
+    if has_seg:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        qseg_ref = kseg_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -268,6 +359,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, ki, bq, bk, off)
+        if has_seg:
+            s = _seg_mask(s, qseg_ref, kseg_ref)
         p = jnp.exp(s - lse)                               # (bq, bk)
         dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -284,7 +377,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(res, g, *, scale, causal, bq, bk):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, qseg, kseg = res
     batch, heads, tq, d = q.shape
     h_kv, tk = k.shape[1], k.shape[2]
     rep = heads // h_kv
@@ -292,6 +385,7 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk):
     bk = _block(tk, bk)
     nq, nk = tq // bq, tk // bk
     off = tk - tq
+    has_seg = qseg is not None
 
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     lse_t = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANES))
@@ -300,45 +394,61 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk):
     stat_spec_q = pl.BlockSpec((1, 1, bq, _LANES),
                                lambda b, h, i, j: (b, h, i, 0))
 
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b, h, i, j: (b, h // rep, j, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b, h, i, j: (b, h // rep, j, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
+        stat_spec_q,
+        stat_spec_q,
+    ]
+    dq_operands = [q, k, v, g, lse_t, delta_t]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j)),
+        ]
+        dq_operands += [qseg[:, None, :], kseg[:, None, :]]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=off),
+                          has_seg=has_seg, bq=bq, bk=bk, nk=nk, off=off),
         grid=(batch, heads, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b, h, i, j: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b, h, i, j: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
-            stat_spec_q,
-            stat_spec_q,
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, g, lse_t, delta_t)
+    )(*dq_operands)
 
     # dk/dv at *query*-head granularity in f32 (per-group partials), group-
     # summed outside the kernel; transient only -- forward K/V are never
     # materialized per query head.
     stat_spec_kq = pl.BlockSpec((1, 1, bq, _LANES),
                                 lambda b, h, j, i: (b, h, i, 0))
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b, h, j, i: (b, h // rep, j, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b, h, j, i: (b, h // rep, j, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),
+        stat_spec_kq,
+        stat_spec_kq,
+    ]
+    dkv_operands = [q, k, v, g, lse_t, delta_t]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bk), lambda b, h, j, i: (b, 0, j)),
+        ]
+        dkv_operands += [qseg[:, None, :], kseg[:, None, :]]
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, off=off),
+                          has_seg=has_seg, bq=bq, bk=bk, nq=nq, off=off),
         grid=(batch, heads, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b, h, j, i: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b, h, j, i: (b, h // rep, j, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0)),
-            stat_spec_kq,
-            stat_spec_kq,
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0)),
@@ -352,7 +462,7 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, g, lse_t, delta_t)
+    )(*dkv_operands)
     if rep > 1:
         dk_h = dk_h.reshape(batch, h_kv, rep, tk, d).sum(axis=2)
         dv_h = dv_h.reshape(batch, h_kv, rep, tk, d).sum(axis=2)
@@ -365,13 +475,15 @@ def _flash_bwd(res, g, *, scale, causal, bq, bk):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, scale, causal, bq, bk):
-    o, _ = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk)
+    o, _ = _flash_fwd(q, k, v, None, None, scale=scale, causal=causal,
+                      bq=bq, bk=bk)
     return o
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk):
-    o, lse = _flash_fwd(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk)
-    return o, (q, k, v, o, lse)
+    o, lse = _flash_fwd(q, k, v, None, None, scale=scale, causal=causal,
+                        bq=bq, bk=bk)
+    return o, (q, k, v, o, lse, None, None)
 
 
 def _flash_vjp_bwd(scale, causal, bq, bk, res, g):
@@ -381,8 +493,37 @@ def _flash_vjp_bwd(scale, causal, bq, bk, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# Segment-id variant: ids are integer primal operands (traced arrays), so
+# they ride the custom_vjp as primals with float0 cotangents.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_seg(q, k, v, qseg, kseg, scale, causal, bq, bk):
+    o, _ = _flash_fwd(q, k, v, qseg, kseg, scale=scale, causal=causal,
+                      bq=bq, bk=bk)
+    return o
+
+
+def _flash_seg_vjp_fwd(q, k, v, qseg, kseg, scale, causal, bq, bk):
+    o, lse = _flash_fwd(q, k, v, qseg, kseg, scale=scale, causal=causal,
+                        bq=bq, bk=bk)
+    return o, (q, k, v, o, lse, qseg, kseg)
+
+
+def _flash_seg_vjp_bwd(scale, causal, bq, bk, res, g):
+    dq, dk, dv = _flash_bwd(res, g, scale=scale, causal=causal,
+                            bq=bq, bk=bk)
+    qseg, kseg = res[5], res[6]
+    # Integer primals take float0 cotangents (jax custom_vjp contract).
+    zq = jnp.zeros(qseg.shape, jax.dtypes.float0)
+    zk = jnp.zeros(kseg.shape, jax.dtypes.float0)
+    return dq, dk, dv, zq, zk
+
+
+_flash_seg.defvjp(_flash_seg_vjp_fwd, _flash_seg_vjp_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
+                    segment_ids=None, kv_segment_ids=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_kv: int = DEFAULT_BLOCK_KV,
                     force_reference: bool = False):
@@ -391,6 +532,13 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``h_kv`` may divide ``h`` (grouped-query attention); kv heads are
     broadcast to query heads via the kernel block index map (no HBM copy).
     ``causal=True`` requires ``t <= s`` and masks bottom-right aligned.
+
+    ``segment_ids`` (``(b, t)`` int) restricts each query to keys with an
+    EQUAL id -- packed-sequence training and padding isolation (give pad
+    tokens their own id; their rows degenerate to a uniform softmax, mask
+    them in the loss).  ``kv_segment_ids`` (``(b, s)``) defaults to
+    ``segment_ids`` when the key sequence has the same length; it is
+    required for cross-length attention.  Composes with ``causal``.
 
     Dispatch: Pallas kernels when running on TPU (or ``HVD_TPU_FLASH=1``,
     which uses the interpreter off-TPU -- slow, for tests), XLA reference
@@ -410,13 +558,46 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     tq, tk = q.shape[2], k.shape[2]
-    usable_blocks = (_block(tq, block_q) >= _MIN_BLOCK
-                     and _block(tk, block_kv) >= _MIN_BLOCK)
+    if segment_ids is not None:
+        if kv_segment_ids is None:
+            if tq != tk:
+                raise ValueError(
+                    "kv_segment_ids is required when tq != tk "
+                    f"({tq} != {tk})")
+            kv_segment_ids = segment_ids
+        if segment_ids.shape != (q.shape[0], tq):
+            raise ValueError(f"segment_ids must be (batch, {tq}), got "
+                             f"{segment_ids.shape}")
+        if kv_segment_ids.shape != (q.shape[0], tk):
+            raise ValueError(f"kv_segment_ids must be (batch, {tk}), got "
+                             f"{kv_segment_ids.shape}")
+        segment_ids = segment_ids.astype(jnp.int32)
+        kv_segment_ids = kv_segment_ids.astype(jnp.int32)
+    elif kv_segment_ids is not None:
+        raise ValueError("kv_segment_ids given without segment_ids")
+    if segment_ids is None:
+        rbq, rbk = _block(tq, block_q), _block(tk, block_kv)
+        usable_blocks = rbq >= _MIN_BLOCK and rbk >= _MIN_BLOCK
+    else:
+        # Segment-id blocks put the sequence on the LANE dim, so Mosaic
+        # needs each block to divide by 128 or span the whole sequence;
+        # search for a conforming divisor (e.g. tq=1920 -> 384) rather
+        # than falling back to the O(t^2) reference.
+        rbq = _block_lane(tq, block_q)
+        rbk = _block_lane(tk, block_kv)
+        usable_blocks = rbq >= _MIN_BLOCK and rbk >= _MIN_BLOCK
+        block_q, block_kv = max(rbq, _MIN_BLOCK), max(rbk, _MIN_BLOCK)
     if force_reference or not usable_blocks or not _use_pallas():
         if q.shape[1] != k.shape[1]:
             rep = q.shape[1] // k.shape[1]
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
-        return attention_reference(q, k, v, causal=causal, scale=scale)
+        return attention_reference(q, k, v, causal=causal, scale=scale,
+                                   segment_ids=segment_ids,
+                                   kv_segment_ids=kv_segment_ids)
+    if segment_ids is not None:
+        return _flash_seg(q, k, v, segment_ids, kv_segment_ids,
+                          float(scale), bool(causal),
+                          int(block_q), int(block_kv))
     return _flash(q, k, v, float(scale), bool(causal),
                   int(block_q), int(block_kv))

@@ -129,3 +129,194 @@ def test_prime_seq_falls_back_to_reference():
     ref = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-6, rtol=1e-6)
+
+
+def _packed_segments(key, batch, t, max_segs=4):
+    """Random packed-sequence ids: sorted segments like a packing loader."""
+    lens = jax.random.randint(key, (batch, max_segs), 1, t)
+    ids = []
+    for b in range(batch):
+        row = np.zeros(t, np.int32)
+        pos, seg = 0, 0
+        for L in np.asarray(lens[b]):
+            if pos >= t:
+                break
+            row[pos:pos + int(L)] = seg
+            pos += int(L)
+            seg += 1
+        row[pos:] = seg  # tail = final segment
+        ids.append(row)
+    return jnp.asarray(np.stack(ids))
+
+
+def _dense_mask_reference(q, k, v, qseg, kseg, causal):
+    """Ground truth built from an explicit dense mask (independent of
+    attention_reference's own segment path)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    mask = qseg[:, None, :, None] == kseg[:, None, None, :]
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        mask = mask & jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+    logits = jnp.where(mask, logits, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd",
+                      jax.nn.softmax(logits, axis=-1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_ids_match_dense_mask(causal):
+    from horovod_tpu.ops.attention import _flash_seg
+    keys = jax.random.split(jax.random.PRNGKey(7), 4)
+    q = _rand((2, 2, 64, 32), keys[0])
+    k = _rand((2, 2, 64, 32), keys[1])
+    v = _rand((2, 2, 64, 32), keys[2])
+    seg = _packed_segments(keys[3], 2, 64)
+    ref = _dense_mask_reference(q, k, v, seg, seg, causal)
+    got = _flash_seg(q, k, v, seg, seg, q.shape[-1] ** -0.5, causal,
+                     32, 32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # attention_reference's own segment path agrees too.
+    ref2 = attention_reference(q, k, v, causal=causal, segment_ids=seg,
+                               kv_segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(ref2), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_ids_grads_match_reference(causal):
+    from horovod_tpu.ops.attention import _flash_seg
+    keys = jax.random.split(jax.random.PRNGKey(8), 4)
+    q = _rand((1, 2, 64, 16), keys[0])
+    k = _rand((1, 2, 64, 16), keys[1])
+    v = _rand((1, 2, 64, 16), keys[2])
+    seg = _packed_segments(keys[3], 1, 64)
+
+    def loss_flash(q, k, v):
+        o = _flash_seg(q, k, v, seg, seg, q.shape[-1] ** -0.5, causal,
+                       32, 32)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        o = _dense_mask_reference(q, k, v, seg, seg, causal)
+        return jnp.sum(jnp.sin(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)
+
+
+def test_segment_ids_public_api_and_validation():
+    keys = jax.random.split(jax.random.PRNGKey(9), 4)
+    q = _rand((2, 4, 64, 16), keys[0])
+    k = _rand((2, 2, 64, 16), keys[1])      # GQA: 2 kv heads
+    v = _rand((2, 2, 64, 16), keys[2])
+    seg = _packed_segments(keys[3], 2, 64)
+    # Reference fallback (CPU dispatch) handles GQA + segments.
+    out = flash_attention(q, k, v, causal=True, segment_ids=seg)
+    krep = jnp.repeat(k, 2, axis=1)
+    vrep = jnp.repeat(v, 2, axis=1)
+    ref = _dense_mask_reference(q, krep, vrep, seg, seg, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    with pytest.raises(ValueError, match="kv_segment_ids given without"):
+        flash_attention(q, k, v, kv_segment_ids=seg)
+    with pytest.raises(ValueError, match="segment_ids must be"):
+        flash_attention(q, k, v, segment_ids=seg[:, :32])
+    with pytest.raises(ValueError, match="kv_segment_ids is required"):
+        flash_attention(q, k[:, :, :32], v[:, :, :32],
+                        segment_ids=seg)
+
+
+def test_segment_ids_isolate_sequences():
+    """Two packed sequences attend independently: packing [A|B] must equal
+    attending A and B separately (the point of the feature)."""
+    from horovod_tpu.ops.attention import _flash_seg
+    keys = jax.random.split(jax.random.PRNGKey(10), 3)
+    qa = _rand((1, 2, 32, 16), keys[0])
+    qb = _rand((1, 2, 32, 16), keys[1])
+    v_all = _rand((1, 2, 64, 16), keys[2])
+    q_pack = jnp.concatenate([qa, qb], axis=2)
+    seg = jnp.concatenate([jnp.zeros((1, 32), jnp.int32),
+                           jnp.ones((1, 32), jnp.int32)], axis=1)
+    packed = _flash_seg(q_pack, q_pack, v_all, seg, seg,
+                        qa.shape[-1] ** -0.5, True, 32, 32)
+    sep_a = attention_reference(qa, qa, v_all[:, :, :32], causal=True)
+    sep_b = attention_reference(qb, qb, v_all[:, :, 32:], causal=True)
+    np.testing.assert_allclose(np.asarray(packed[:, :, :32]),
+                               np.asarray(sep_a), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(packed[:, :, 32:]),
+                               np.asarray(sep_b), atol=2e-5, rtol=2e-5)
+
+
+def test_segment_dead_rows_zero_output_and_grads():
+    """A query row whose segment matches NO key (pure padding) must give
+    zero output and inject ZERO gradients -- the f32 lse for such a row
+    would otherwise absorb log(l) into -1e30 and the backward would see
+    p = 1 per key (a ~tk-fold gradient explosion; review regression)."""
+    from horovod_tpu.ops.attention import _flash_seg
+    keys = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = _rand((1, 1, 16, 8), keys[0])
+    k = _rand((1, 1, 16, 8), keys[1])
+    v = _rand((1, 1, 16, 8), keys[2])
+    # Last 4 query rows carry segment 5, present in NO key row.
+    qseg = jnp.asarray([[0] * 12 + [5] * 4], jnp.int32)
+    kseg = jnp.zeros((1, 16), jnp.int32)
+
+    out = _flash_seg(q, k, v, qseg, kseg, q.shape[-1] ** -0.5, False,
+                     8, 8)
+    np.testing.assert_allclose(np.asarray(out[0, 0, 12:]), 0.0)
+    ref = attention_reference(q, k, v, segment_ids=qseg,
+                              kv_segment_ids=kseg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        o = _flash_seg(q, k, v, qseg, kseg, q.shape[-1] ** -0.5, False,
+                       8, 8)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        o = attention_reference(q, k, v, segment_ids=qseg,
+                                kv_segment_ids=kseg)
+        return jnp.sum(jnp.sin(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    # Dead rows contribute nothing to dq...
+    np.testing.assert_allclose(np.asarray(gf[0][0, 0, 12:]), 0.0)
+    # ...and the live rows' gradients match the reference everywhere.
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)
+
+
+def test_reference_defaults_kv_segment_ids():
+    keys = jax.random.split(jax.random.PRNGKey(12), 3)
+    q = _rand((1, 1, 32, 8), keys[0])
+    k = _rand((1, 1, 32, 8), keys[1])
+    v = _rand((1, 1, 32, 8), keys[2])
+    seg = jnp.asarray([[0] * 16 + [1] * 16], jnp.int32)
+    a = attention_reference(q, k, v, segment_ids=seg)
+    b = attention_reference(q, k, v, segment_ids=seg, kv_segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="kv_segment_ids is required"):
+        attention_reference(q, k[:, :, :16], v[:, :, :16],
+                            segment_ids=seg)
+
+
+def test_segment_lane_block_search():
+    """Sequences like 1920 (no 512-aligned divisor that is a multiple of
+    128 <= 512... actually 384) must keep the Pallas path by searching
+    for a lane-aligned block, not fall back to the O(t^2) reference."""
+    from horovod_tpu.ops.attention import _block_lane
+    assert _block_lane(1920, 512) == 384
+    assert _block_lane(1664, 512) == 128
+    assert _block_lane(4864, 512) == 256
+    assert _block_lane(1024, 512) == 512
+    assert _block_lane(64, 512) == 64       # whole-seq block
+    assert _block_lane(20, 512) == 0        # not an 8-multiple: fallback
+    assert _block_lane(1031, 512) == 0      # prime: fallback
