@@ -108,7 +108,7 @@ class CausalSelfAttention(nn.Module):
     lora_rank: int = 0
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         dense = partial(Dense, dtype=self.dtype, lora_rank=self.lora_rank)
         b, t, _ = x.shape
         q = dense(self.num_heads * self.head_dim, name="wq")(x)
@@ -121,7 +121,7 @@ class CausalSelfAttention(nn.Module):
                       self.head_dim).transpose(0, 2, 1, 3)
         q = rotary_embedding(q, positions, self.rope_theta)
         k = rotary_embedding(k, positions, self.rope_theta)
-        o = flash_attention(q, k, v, causal=True)
+        o = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
         return dense(x.shape[-1], name="wo")(o)
 
@@ -149,12 +149,13 @@ class DecoderBlock(nn.Module):
     lora_rank: int = 0
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         h = RMSNorm(dtype=self.dtype, name="attn_norm")(x)
         x = x + CausalSelfAttention(
             self.num_heads, self.num_kv_heads, self.head_dim,
             dtype=self.dtype, rope_theta=self.rope_theta,
-            lora_rank=self.lora_rank, name="attn")(h, positions)
+            lora_rank=self.lora_rank, name="attn")(h, positions,
+                                                   segment_ids)
         h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         x = x + SwiGLU(self.ffn_hidden, dtype=self.dtype,
                        lora_rank=self.lora_rank, name="mlp")(h)
@@ -207,11 +208,24 @@ class LlamaLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, *, segment_ids=None):
         cfg = self.config
         if positions is None:
-            positions = jnp.broadcast_to(
-                jnp.arange(tokens.shape[1]), tokens.shape)
+            if segment_ids is not None:
+                # Packed sequences: RoPE positions restart at each
+                # segment boundary (position = offset WITHIN the packed
+                # sequence, not within the buffer).
+                idx = jnp.broadcast_to(jnp.arange(tokens.shape[1]),
+                                       tokens.shape)
+                first = jnp.concatenate(
+                    [jnp.ones_like(segment_ids[:, :1], bool),
+                     segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1)
+                seg_start = jax.lax.cummax(
+                    jnp.where(first, idx, 0), axis=1)
+                positions = idx - seg_start
+            else:
+                positions = jnp.broadcast_to(
+                    jnp.arange(tokens.shape[1]), tokens.shape)
         emb = self.param("tok_embed", nn.initializers.normal(stddev=0.02),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
         x = emb[tokens].astype(self.dtype)
@@ -221,7 +235,7 @@ class LlamaLM(nn.Module):
                           cfg.ffn_hidden, dtype=self.dtype,
                           rope_theta=cfg.rope_theta,
                           lora_rank=self.lora_rank,
-                          name=f"layer_{i}")(x, positions)
+                          name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
         # Tied-embedding readout in f32 for stable softmax.
         return x.astype(jnp.float32) @ emb.T
@@ -256,7 +270,7 @@ class EncoderBlock(nn.Module):
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, segment_ids=None):
         b, t, d = x.shape
         head_dim = d // self.num_heads
         dense = partial(Dense, dtype=self.dtype, use_bias=True)
@@ -269,7 +283,8 @@ class EncoderBlock(nn.Module):
         k = dense(d, name="wk")(h).reshape(b, t, self.num_heads, head_dim)
         v = dense(d, name="wv")(h).reshape(b, t, self.num_heads, head_dim)
         o = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                            v.transpose(0, 2, 1, 3), causal=False)
+                            v.transpose(0, 2, 1, 3), causal=False,
+                            segment_ids=segment_ids)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + dense(d, name="wo")(o)
         h = ln(name="mlp_norm")(x)
@@ -290,7 +305,11 @@ class Bert(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens, token_types=None):
+    def __call__(self, tokens, token_types=None, *, pack_segment_ids=None):
+        # NB ``token_types`` IS what the BERT paper calls "segment ids"
+        # (the sentence-A/B embedding); ``pack_segment_ids`` is the
+        # attention-isolation input (packing / padding), keyword-only so
+        # the two can never be confused positionally.
         cfg = self.config
         b, t = tokens.shape
         if token_types is None:
@@ -307,7 +326,8 @@ class Bert(nn.Module):
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg.num_heads, cfg.ffn_hidden,
-                          dtype=self.dtype, name=f"layer_{i}")(x)
+                          dtype=self.dtype, name=f"layer_{i}")(
+                              x, pack_segment_ids)
         x = nn.LayerNorm(dtype=self.dtype, epsilon=1e-12,
                          param_dtype=jnp.float32, name="final_norm")(x)
         # MLM head: transform + tied-embedding readout (f32 softmax input).

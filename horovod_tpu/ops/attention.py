@@ -116,9 +116,9 @@ def attention_reference(q, k, v, *, causal: bool = False,
     ``segment_ids``/``kv_segment_ids`` (``(batch, tq)`` / ``(batch, tk)``
     int): a query attends only keys with an EQUAL segment id -- the
     packed-sequence convention (and padding isolation: give pad tokens a
-    segment of their own).  A row whose segment matches no key degenerates
-    to a uniform softmax (garbage output on pad rows; mask them in the
-    loss), identical between this reference and the Pallas kernels.
+    segment of their own).  A DEAD row (segment matches no key, i.e.
+    pure padding) produces ZERO output and zero gradients, identical
+    between this reference and the Pallas kernels.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -535,8 +535,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
     ``segment_ids`` (``(b, t)`` int) restricts each query to keys with an
     EQUAL id -- packed-sequence training and padding isolation (give pad
-    tokens their own id; their rows degenerate to a uniform softmax, mask
-    them in the loss).  ``kv_segment_ids`` (``(b, s)``) defaults to
+    tokens their own id; their DEAD rows produce zero output and zero
+    gradients).  ``kv_segment_ids`` (``(b, s)``) defaults to
     ``segment_ids`` when the key sequence has the same length; it is
     required for cross-length attention.  Composes with ``causal``.
 
@@ -586,7 +586,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
         rbq = _block_lane(tq, block_q)
         rbk = _block_lane(tk, block_kv)
         usable_blocks = rbq >= _MIN_BLOCK and rbk >= _MIN_BLOCK
-        block_q, block_kv = max(rbq, _MIN_BLOCK), max(rbk, _MIN_BLOCK)
+        block_q, block_kv = rbq, rbk
     if force_reference or not usable_blocks or not _use_pallas():
         if q.shape[1] != k.shape[1]:
             rep = q.shape[1] // k.shape[1]
