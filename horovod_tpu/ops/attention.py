@@ -163,6 +163,23 @@ def _seg_mask(s, qseg_ref, kseg_ref):
     return jnp.where(qs == ks, s, _NEG_INF)
 
 
+def _seg_live(live, qseg_ref, kseg_ref):
+    """Combine the causal block-liveness predicate with a dynamic
+    segment-range test: packed segment ids are sorted, so a q block and
+    a kv block with disjoint [min, max] id ranges share NO equal pair
+    and the whole block is skippable (the splash-attention pruning).
+    Skipping is numerically exact: a processed all-masked block only
+    ever contributes alpha-erased garbage (before any live block) or
+    p = 0 terms (after one), and the all-skipped dead-row case is
+    handled by the _finish zeroing.
+    """
+    qs = qseg_ref[0, 0]
+    ks = kseg_ref[0, 0]
+    overlap = ((jnp.min(qs) <= jnp.max(ks))
+               & (jnp.max(qs) >= jnp.min(ks)))
+    return overlap if live is True else live & overlap
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel.
 # ---------------------------------------------------------------------------
@@ -183,10 +200,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, has_seg,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal: block is live unless it lies entirely above the diagonal.
-    # (Segment boundaries are dynamic, so segment masking skips no
-    # blocks -- it only masks within them.)
+    # Causal: block is live unless it lies entirely above the diagonal;
+    # with segment ids, also unless the blocks' id ranges are disjoint
+    # (dynamic predicate -- packed ids are sorted, so this prunes every
+    # cross-sequence block).
     live = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
+    if has_seg:
+        live = _seg_live(live, qseg_ref, kseg_ref)
 
     @pl.when(live)
     def _step():
@@ -303,6 +323,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     live = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
+    if has_seg:
+        live = _seg_live(live, qseg_ref, kseg_ref)
 
     @pl.when(live)
     def _step():
@@ -346,6 +368,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     live = True if not causal else (qi * bq + bq - 1 + off >= ki * bk)
+    if has_seg:
+        live = _seg_live(live, qseg_ref, kseg_ref)
 
     @pl.when(live)
     def _step():
