@@ -35,12 +35,22 @@ _NEG_INF = -1e30
 
 
 def ring_attention(q, k, v, *, causal: bool = False,
-                   scale: Optional[float] = None, axis: str = SP_AXIS):
+                   scale: Optional[float] = None, axis: str = SP_AXIS,
+                   segment_ids=None):
     """Attention over a sequence sharded on the ``axis`` ring.
 
     Shapes (local shards): q (b, h, t_l, d), k/v (b, h, t_l, d), where the
     global sequence length is ``t_l * sp`` and rank r holds positions
     ``[r*t_l, (r+1)*t_l)``.  Returns the local output shard (b, h, t_l, d).
+
+    ``segment_ids`` (local shard, ``(b, t_l)`` int): packed-sequence /
+    padding masking with the same semantics as
+    :func:`horovod_tpu.ops.flash_attention` -- queries attend only
+    equal-id keys.  The kv id shard circulates the ring alongside K/V
+    (int traffic, negligible next to the kv blocks).  One id vector
+    serves both sides (self-attention), so a pad segment attends itself
+    -- truly dead rows cannot arise here; the zero-output guard below is
+    defensive, matching the flash kernel's dead-row semantics anyway.
 
     Numerics are f32 online-softmax regardless of input dtype (matching
     the Pallas flash kernel's accumulator discipline); output is cast back
@@ -58,7 +68,7 @@ def ring_attention(q, k, v, *, causal: bool = False,
 
     q_pos = my * t_l + jnp.arange(t_l)  # global positions of local queries
 
-    def merge_block(state, kb, vb, src):
+    def merge_block(state, kb, vb, kseg_b, src):
         """Online-softmax merge of the block that originated at rank src."""
         m, l, acc = state
         scores = jnp.einsum("bhtd,bhsd->bhts", qf, kb.astype(jnp.float32))
@@ -66,6 +76,10 @@ def ring_attention(q, k, v, *, causal: bool = False,
             k_pos = src * t_l + jnp.arange(t_l)
             mask = q_pos[:, None] >= k_pos[None, :]
             scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        if segment_ids is not None:
+            smask = (segment_ids[:, None, :, None]
+                     == kseg_b[:, None, None, :])
+            scores = jnp.where(smask, scores, _NEG_INF)
         block_m = jnp.max(scores, axis=-1)
         new_m = jnp.maximum(m, block_m)
         # Renormalise the running accumulator to the new max.
@@ -79,30 +93,38 @@ def ring_attention(q, k, v, *, causal: bool = False,
     m0 = jnp.full((b, h, t_l), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t_l), jnp.float32)
     acc0 = jnp.zeros((b, h, t_l, d), jnp.float32)
+    kseg0 = (segment_ids if segment_ids is not None
+             else jnp.zeros((b, t_l), jnp.int32))
     # Local block first (no comm), then sp-1 ring rotations: permute at the
     # top of each step so no dead final transfer is issued.
-    state = merge_block((m0, l0, acc0), k, v, my)
+    state = merge_block((m0, l0, acc0), k, v, kseg0, my)
 
     def step(carry, s):
-        kb, vb, state = carry
+        kb, vb, kseg_b, state = carry
         kb = jax.lax.ppermute(kb, axis, perm)
         vb = jax.lax.ppermute(vb, axis, perm)
-        state = merge_block(state, kb, vb, (my - s) % sp)
-        return (kb, vb, state), ()
+        kseg_b = jax.lax.ppermute(kseg_b, axis, perm)
+        state = merge_block(state, kb, vb, kseg_b, (my - s) % sp)
+        return (kb, vb, kseg_b, state), ()
 
     if sp > 1:
-        (kb, vb, state), _ = jax.lax.scan(
-            step, (k, v, state), jnp.arange(1, sp))
+        (kb, vb, kseg_b, state), _ = jax.lax.scan(
+            step, (k, v, kseg0, state), jnp.arange(1, sp))
     m, l, acc = state
-    # Fully-masked rows (can't happen for causal self-attention since a
-    # token always sees itself, but guard the division anyway).
+    # Fully-masked rows are unreachable here (one shared id vector:
+    # every token matches at least itself, and plain causal always sees
+    # the diagonal); the guard is purely defensive, kept aligned with
+    # flash_attention's dead-row zero-output semantics.
     safe_l = jnp.where(l == 0.0, 1.0, l)
-    return (acc / safe_l[..., None]).astype(out_dtype)
+    out = acc / safe_l[..., None]
+    if segment_ids is not None:
+        out = jnp.where((m <= _NEG_INF / 2)[..., None], 0.0, out)
+    return out.astype(out_dtype)
 
 
 def ulysses_attention(q, k, v, *, causal: bool = False,
                       scale: Optional[float] = None, axis: str = SP_AXIS,
-                      attn_fn=None):
+                      attn_fn=None, segment_ids=None):
     """Ulysses attention: all_to_all seq<->heads, local attention between.
 
     Local input shards: (b, h, t_l, d) with the *sequence* sharded.  After
@@ -110,6 +132,12 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
     a slice of heads -- so any single-device attention kernel applies;
     ``attn_fn(q, k, v, causal=..., scale=...)`` defaults to the fused
     Pallas flash attention.  A second all_to_all restores seq sharding.
+
+    ``segment_ids`` (local shard, ``(b, t_l)`` int): the full-sequence id
+    vector is reassembled with one tiny ``all_gather`` and handed to
+    ``attn_fn`` (which must accept ``segment_ids=``, as
+    :func:`flash_attention` does -- packing there also prunes whole
+    block pairs).
     """
     if attn_fn is None:
         from horovod_tpu.ops.attention import flash_attention
@@ -123,5 +151,10 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
                      concat_axis=2, tiled=True)
     to_heads = partial(jax.lax.all_to_all, axis_name=axis, split_axis=2,
                        concat_axis=1, tiled=True)
-    o = attn_fn(to_seq(q), to_seq(k), to_seq(v), causal=causal, scale=scale)
+    kwargs = {}
+    if segment_ids is not None:
+        kwargs["segment_ids"] = jax.lax.all_gather(
+            segment_ids, axis, axis=1, tiled=True)
+    o = attn_fn(to_seq(q), to_seq(k), to_seq(v), causal=causal,
+                scale=scale, **kwargs)
     return to_heads(o)
