@@ -40,6 +40,9 @@ def main():
     p.add_argument("--batch-size", type=int, default=0,
                    help="global batch (default: 2 per dp rank)")
     p.add_argument("--mode", choices=("ring", "ulysses"), default="ring")
+    p.add_argument("--packed", action="store_true",
+                   help="pack TWO sequences per row with segment-id "
+                        "attention isolation (ids ride the sp shards)")
     p.add_argument("--lr", type=float, default=1e-2)
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--compare-single-device", action="store_true")
@@ -77,7 +80,19 @@ def main():
 
     rng = np.random.RandomState(0)
     x = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
-    y = np.roll(x, -1, axis=1)  # next token (wraps at the end: toy data)
+    # --packed: each row is two independent half-length sequences; the
+    # segment ids stop attention from crossing the midpoint, and the
+    # next-token labels roll PER SEGMENT so no position is trained to
+    # predict a token its isolated attention cannot see.
+    half = seq // 2
+    if args.packed:
+        y = np.concatenate([np.roll(x[:, :half], -1, axis=1),
+                            np.roll(x[:, half:], -1, axis=1)], axis=1)
+    else:
+        y = np.roll(x, -1, axis=1)  # next token (wraps: toy data)
+    seg = np.concatenate([np.zeros((batch, half), np.int32),
+                          np.ones((batch, seq - half), np.int32)],
+                         axis=1)
 
     k0 = jax.random.PRNGKey(0)
     ks = jax.random.split(k0, 5)
@@ -96,10 +111,10 @@ def main():
         b, t, _ = e.shape
         return (e @ w).reshape(b, t, heads, dh).transpose(0, 2, 1, 3)
 
-    def local_loss(p, xb, yb, attention):
+    def local_loss(p, xb, yb, sb, attention):
         e = p["emb"][xb]                                  # (b, t_l, dm)
         q, k, v = (heads_split(e, p[w]) for w in ("wq", "wk", "wv"))
-        o = attention(q, k, v)                            # (b, h, t_l, dh)
+        o = attention(q, k, v, sb)                        # (b, h, t_l, dh)
         o = o.transpose(0, 2, 1, 3).reshape(e.shape) @ p["wo"]
         logits = o @ p["emb"].T
         return optax.softmax_cross_entropy_with_integer_labels(
@@ -108,10 +123,12 @@ def main():
     opt = DistributedOptimizer(optax.adam(args.lr), axes=("dp", "sp"))
     opt_state = opt.init(params)
 
-    def local_step(p, o_state, xb, yb):
+    def local_step(p, o_state, xb, yb, sb):
         loss, grads = jax.value_and_grad(local_loss)(
-            p, xb, yb, lambda q, k, v: attn(q, k, v, causal=True,
-                                            axis="sp"))
+            p, xb, yb, sb,
+            lambda q, k, v, sb: attn(
+                q, k, v, causal=True, axis="sp",
+                segment_ids=sb if args.packed else None))
         updates, o_state = opt.update(grads, o_state, p)
         p = optax.apply_updates(p, updates)
         from horovod_tpu.collectives import ops as cops
@@ -120,13 +137,15 @@ def main():
 
     step = jax.jit(jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
+        in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp"),
+                  P("dp", "sp")),
         out_specs=(P(), P(), P()), check_vma=False),
         donate_argnums=(0, 1))
 
     data_sharding = NamedSharding(mesh, P("dp", "sp"))
     xd = jax.device_put(jnp.asarray(x), data_sharding)
     yd = jax.device_put(jnp.asarray(y), data_sharding)
+    sd = jax.device_put(jnp.asarray(seg), data_sharding)
     params = hvd.replicate(params, mesh)
     opt_state = hvd.replicate(opt_state, mesh)
 
@@ -134,17 +153,20 @@ def main():
         ref_loss = float(local_loss(
             jax.device_put(jax.tree.map(np.asarray, params),
                            jax.devices()[0]),
-            jnp.asarray(x), jnp.asarray(y),
-            lambda q, k, v: attention_reference(q, k, v, causal=True)))
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(seg),
+            lambda q, k, v, sb: attention_reference(
+                q, k, v, causal=True,
+                segment_ids=sb if args.packed else None)))
 
     losses = []
     for i in range(args.steps):
-        params, opt_state, loss = step(params, opt_state, xd, yd)
+        params, opt_state, loss = step(params, opt_state, xd, yd, sd)
         losses.append(float(loss))
         if i % 10 == 0:
             print(f"step {i:4d}  loss {losses[-1]:.4f}")
     print(f"final loss {losses[-1]:.4f}  "
-          f"(mode={args.mode}, seq={seq}, sp={sp}, dp={dp})")
+          f"(mode={args.mode}, seq={seq}, sp={sp}, dp={dp}"
+          f"{', packed x2' if args.packed else ''})")
 
     if args.compare_single_device:
         diff = abs(losses[0] - ref_loss)

@@ -59,6 +59,17 @@ def test_long_context_example_ulysses_cpu():
 
 
 @pytest.mark.integration
+def test_long_context_example_packed_cpu():
+    """Packed x2 sequences with segment isolation through the sp mesh,
+    parity-checked against the single-device segment reference."""
+    out = _run([os.path.join(REPO, "examples", "long_context.py"),
+                "--cpu-devices", "8", "--seq-len", "256", "--steps", "8",
+                "--packed", "--compare-single-device"])
+    assert "PARITY OK" in out
+    assert "packed x2" in out
+
+
+@pytest.mark.integration
 def test_torch_resnet50_example_cpu():
     out = _run([os.path.join(REPO, "examples", "torch_resnet50.py"),
                 "--cpu-devices", "2", "--image-size", "64",
