@@ -351,3 +351,27 @@ def test_segment_pruning_grads_hit_pruned_blocks(causal):
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-5, rtol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_ids_cross_length_decode(causal):
+    """tq < tk (decode with a KV cache) + distinct q/kv segment ids: the
+    kernels' bottom-right-aligned causal offset must compose with the
+    segment mask."""
+    from horovod_tpu.ops.attention import _flash_seg
+    keys = jax.random.split(jax.random.PRNGKey(14), 3)
+    q = _rand((2, 2, 32, 16), keys[0])
+    k = _rand((2, 2, 64, 16), keys[1])
+    v = _rand((2, 2, 64, 16), keys[2])
+    kseg = jnp.asarray(np.concatenate(
+        [np.zeros((2, 40)), np.ones((2, 24))], axis=1).astype(np.int32))
+    qseg = jnp.ones((2, 32), jnp.int32)     # queries are the live tail
+    # attention_reference, not _dense_mask_reference: causal + segments
+    # makes early queries DEAD (no id-1 key inside their causal range),
+    # and only the real reference zeroes dead rows like the kernel.
+    ref = attention_reference(q, k, v, causal=causal, segment_ids=qseg,
+                              kv_segment_ids=kseg)
+    got = _flash_seg(q, k, v, qseg, kseg, q.shape[-1] ** -0.5, causal,
+                     32, 32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
