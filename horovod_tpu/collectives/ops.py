@@ -455,7 +455,8 @@ def alltoall(x,
 
 def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
               process_set=None, max_count: int,
-              return_overflow: bool = False):
+              return_overflow: bool = False,
+              strict: Optional[bool] = None):
     """Uneven alltoall (padded alltoallv; NCCLAlltoall with ``splits``).
 
     The reference exchanges ragged splits directly (its negotiation shares
@@ -481,6 +482,21 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
       return_overflow: also return the per-sender count of rows DROPPED by
         clamping.  Costs nothing extra: the original counts ride the same
         counts collective as the clamped ones.
+      strict: loud mode (default: the ``HOROVOD_ALLTOALLV_STRICT`` env
+        var).  Emits a ``jax.experimental.checkify.check`` that fails the
+        step when ANY row is dropped, reporting the per-sender dropped
+        counts -- the reference errors on inconsistent splits and never
+        silently drops rows; this is the TPU-compiled equivalent (the axon
+        backend has no host callbacks, so the error is functionalized).
+        The enclosing jit/shard_map step must be wrapped in
+        ``checkify.checkify(...)`` and the returned error thrown
+        (``err.throw()``); an unwrapped strict step fails at TRACE time
+        with checkify's "not functionalized" error, which is still loud,
+        never silent.  Uses the same already-computed overflow counts as
+        ``return_overflow`` -- zero extra communication.  The env var is
+        read at TRACE time: set it before the step is first traced --
+        executables already compiled with strict off stay off (jit cache
+        keys do not include the environment).
 
     Returns:
       ``(recv, recv_counts)``: ``recv[j]`` is ``[max_count, ...]`` holding
@@ -515,7 +531,7 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
         full = jnp.where(_member_mask(axes, members), full, 0)
         sel = np.asarray(members)
         out = alltoallv(x, full, axes=axes, max_count=max_count,
-                        return_overflow=return_overflow)
+                        return_overflow=return_overflow, strict=strict)
         return tuple(o[sel] for o in out)
     a = axes[0] if len(axes) == 1 else axes
     size = math.prod(lax.axis_size(ax) for ax in axes)
@@ -549,6 +565,18 @@ def alltoallv(x, send_counts, *, axes: Optional[AxisSpec] = None,
     pair = lax.all_to_all(jnp.stack([clamped, send_counts], axis=1), a,
                           split_axis=0, concat_axis=0, tiled=True)
     recv_counts = pair[:, 0]
+    if strict is None:
+        from ..core.config import _env_bool
+        strict = _env_bool("ALLTOALLV_STRICT")
+    if strict:
+        from jax.experimental import checkify
+        overflow = pair[:, 1] - pair[:, 0]
+        checkify.check(
+            jnp.logical_not(jnp.any(overflow > 0)),
+            "alltoallv dropped rows (HOROVOD_ALLTOALLV_STRICT): per-sender "
+            "dropped counts {ov} at max_count=" + str(int(max_count))
+            + " -- raise max_count or fix the split computation",
+            ov=overflow)
     if return_overflow:
         return recv, recv_counts, pair[:, 1] - pair[:, 0]
     return recv, recv_counts

@@ -165,9 +165,11 @@ def _seg_mask(s, qseg_ref, kseg_ref):
 
 def _seg_live(live, qseg_ref, kseg_ref):
     """Combine the causal block-liveness predicate with a dynamic
-    segment-range test: packed segment ids are sorted, so a q block and
-    a kv block with disjoint [min, max] id ranges share NO equal pair
-    and the whole block is skippable (the splash-attention pruning).
+    segment-range test: a q block and a kv block with disjoint
+    [min, max] id ranges share NO equal pair for ANY id layout, so the
+    whole block is skippable (the splash-attention pruning).  Sortedness
+    is NOT a correctness precondition -- sorted packed ids merely make
+    per-block ranges tight, maximising how often pruning fires.
     Skipping is numerically exact: a processed all-masked block only
     ever contributes alpha-erased garbage (before any live block) or
     p = 0 terms (after one), and the all-skipped dead-row case is

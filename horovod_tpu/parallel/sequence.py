@@ -93,8 +93,10 @@ def ring_attention(q, k, v, *, causal: bool = False,
     m0 = jnp.full((b, h, t_l), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t_l), jnp.float32)
     acc0 = jnp.zeros((b, h, t_l, d), jnp.float32)
-    kseg0 = (segment_ids if segment_ids is not None
-             else jnp.zeros((b, t_l), jnp.int32))
+    has_seg = segment_ids is not None
+    # The kv-id shard rides the ring only when packing is active; the
+    # default path carries no id tensor and issues no id ppermute.
+    kseg0 = segment_ids if has_seg else None
     # Local block first (no comm), then sp-1 ring rotations: permute at the
     # top of each step so no dead final transfer is issued.
     state = merge_block((m0, l0, acc0), k, v, kseg0, my)
@@ -103,7 +105,8 @@ def ring_attention(q, k, v, *, causal: bool = False,
         kb, vb, kseg_b, state = carry
         kb = jax.lax.ppermute(kb, axis, perm)
         vb = jax.lax.ppermute(vb, axis, perm)
-        kseg_b = jax.lax.ppermute(kseg_b, axis, perm)
+        if has_seg:
+            kseg_b = jax.lax.ppermute(kseg_b, axis, perm)
         state = merge_block(state, kb, vb, kseg_b, (my - s) % sp)
         return (kb, vb, kseg_b, state), ()
 

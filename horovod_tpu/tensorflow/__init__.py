@@ -191,7 +191,13 @@ def broadcast_global_variables(root_rank: int = 0, process_set=None):
     ``tf.py_function`` that runs the fused mesh broadcast and feeds one
     assign per variable (the reference registers a native
     ``HorovodBroadcast`` kernel; the py_function hop is this shim's
-    standard graph bridge, same as ``grouped_allreduce``).  Eager mode
+    standard graph bridge, same as ``grouped_allreduce``).  Limitation:
+    ``py_function`` captures process-local Python state, so the returned
+    op is NOT serializable into a GraphDef -- graphs that are frozen,
+    exported, or executed by a session in a different process will fail
+    to resolve it (the reference's native kernel survives those flows).
+    Run the op in the process that built it, as
+    ``BroadcastGlobalVariablesHook`` does.  Eager mode
     raises like the reference: eager variables never reach the
     ``global_variables()`` collection, so a silent no-op would leave
     every rank on its own init -- use ``broadcast_variables``.
@@ -229,7 +235,11 @@ class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
     ``tf.compat.v1.train.MonitoredTrainingSession`` or estimators: the
     broadcast op is (re)built in ``begin()`` against the current graph and
     run once in ``after_create_session``, i.e. after variable
-    initialization, exactly the reference's hook protocol.  ``device`` is
+    initialization, exactly the reference's hook protocol.  The op is a
+    ``py_function`` bridge (see :func:`broadcast_global_variables`): it
+    must run in the process that built it and cannot ride a frozen or
+    exported GraphDef -- in-process MonitoredSession/estimator use is the
+    supported shape.  ``device`` is
     accepted for signature parity (placement is the mesh's concern here).
     """
 

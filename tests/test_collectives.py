@@ -284,6 +284,85 @@ def test_alltoallv_overflow_is_detectable(hvd, n_devices):
                 [s * 1000 + r * 10 + p for p in range(want)], rtol=1e-6)
 
 
+def test_alltoallv_strict_mode_raises_on_drop(hvd, n_devices):
+    """HOROVOD_ALLTOALLV_STRICT / strict=True: any dropped row fails the
+    checkified step with the per-sender dropped counts; a lossless
+    exchange under the same strict step passes.  Default mode on the same
+    inputs keeps capacity-factor semantics (reports, never raises)."""
+    from jax.experimental import checkify
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    n = n_devices
+    max_count = 2
+
+    def build(splits_row):
+        splits = np.asarray([splits_row] * n, np.int32)
+        tot = int(splits[0].sum())
+        datas = np.arange(n * tot, dtype=np.float32).reshape(n, tot, 1)
+        return jnp.asarray(datas), jnp.asarray(splits)
+
+    def f(x, c):
+        recv, rc = cops.alltoallv(x[0], c[0], axes=axes,
+                                  max_count=max_count, strict=True)
+        return recv[None], rc[None]
+
+    fs = checkify.checkify(jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(axes), P(axes)),
+        out_specs=(P(axes),) * 2)))
+
+    # Lossless strict exchange: no error.
+    x, c = build([1] * n)
+    err, _ = fs(x, c)
+    err.throw()
+
+    # One split of 3 > max_count=2: strict raises with the counts.
+    x, c = build([3 if i == 0 else 1 for i in range(n)])
+    err, _ = fs(x, c)
+    with pytest.raises(Exception, match="dropped"):
+        err.throw()
+
+    # Same overflowing inputs, default mode: truncates and reports.
+    def g(x, c):
+        recv, rc, ov = cops.alltoallv(x[0], c[0], axes=axes,
+                                      max_count=max_count,
+                                      return_overflow=True)
+        return recv[None], rc[None], ov[None]
+
+    gs = jax.jit(jax.shard_map(
+        g, mesh=mesh, in_specs=(P(axes), P(axes)), out_specs=(P(axes),) * 3))
+    _, rc, ov = map(np.asarray, gs(x, c))
+    np.testing.assert_array_equal(rc[0], np.full(n, 2, np.int32))
+    np.testing.assert_array_equal(ov[0], np.full(n, 1, np.int32))
+
+
+def test_alltoallv_strict_env_default(hvd, n_devices, monkeypatch):
+    """strict=None reads HOROVOD_ALLTOALLV_STRICT at trace time: with the
+    env set and no checkify wrapper, tracing fails LOUDLY (checkify's
+    not-functionalized error) instead of silently dropping rows."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    monkeypatch.setenv("HOROVOD_ALLTOALLV_STRICT", "1")
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    n = n_devices
+    splits = np.asarray([[3] + [1] * (n - 1)] * n, np.int32)
+    tot = int(splits[0].sum())
+    datas = np.arange(n * tot, dtype=np.float32).reshape(n, tot, 1)
+
+    def f(x, c):
+        recv, rc = cops.alltoallv(x[0], c[0], axes=axes, max_count=2)
+        return recv[None], rc[None]
+
+    fs = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(axes), P(axes)), out_specs=(P(axes),) * 2))
+    with pytest.raises(Exception, match="(?i)checkify|functionaliz"):
+        fs(jnp.asarray(datas), jnp.asarray(splits))
+
+
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int32])
 def test_alltoallv_eager_dtype_sweep(hvd, n_devices, dtype):
     n = n_devices

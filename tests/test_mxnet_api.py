@@ -1,0 +1,211 @@
+"""Contract tests for the MXNet shim against a fake ``mxnet`` module.
+
+MXNet is EOL and uninstallable here (SURVEY.md section 3.4), but the shim
+must not rot silently: a minimal fake -- NDArray with asnumpy/__setitem__,
+``mx.nd.array``, ``mx.optimizer.Optimizer``, ``mx.gluon.Trainer`` -- is
+injected via sys.modules so every public shim function EXECUTES.  All
+ranks hold replicated data (single process owns all virtual devices), so
+Average == identity and Sum == value * size, the same convention as the
+torch/TF shim tests.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import horovod_tpu.mxnet as hvd_mx
+
+
+class FakeNDArray:
+    def __init__(self, data, ctx="cpu(0)", dtype=None):
+        self._a = np.array(data, dtype=dtype)
+        self.context = ctx
+
+    def asnumpy(self):
+        return self._a.copy()
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    def __setitem__(self, key, value):
+        if isinstance(value, FakeNDArray):
+            value = value._a
+        self._a[key] = np.asarray(value, self._a.dtype)
+
+
+class FakeParameter:
+    def __init__(self, value, grad_req="write"):
+        self._data = FakeNDArray(value)
+        self._grad = FakeNDArray(np.ones_like(np.asarray(value)))
+        self.grad_req = grad_req
+
+    def data(self):
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class FakeOptimizer:
+    """Stands in for ``mx.optimizer.Optimizer``: records update calls."""
+
+    def __init__(self):
+        self.rescale_grad = 1.0
+        self.updates = []
+
+    def update(self, index, weight, grad, state):
+        self.updates.append(("update", index))
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.updates.append(("ump", index))
+
+
+def _build_fake_mxnet():
+    mx = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.nd")
+    nd.array = FakeNDArray
+    optimizer = types.ModuleType("mxnet.optimizer")
+    optimizer.Optimizer = FakeOptimizer
+    gluon = types.ModuleType("mxnet.gluon")
+
+    class Trainer:
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     kvstore=None):
+            vals = params.values() if hasattr(params, "values") else params
+            self._params = list(vals)
+            self._scale = (optimizer_params or {}).get("rescale_grad", 1.0)
+
+    gluon.Trainer = Trainer
+    mx.nd, mx.optimizer, mx.gluon = nd, optimizer, gluon
+    return mx
+
+
+@pytest.fixture()
+def fake_mx(monkeypatch):
+    mx = _build_fake_mxnet()
+    monkeypatch.setitem(sys.modules, "mxnet", mx)
+    return mx
+
+
+def test_requires_mxnet_guidance(hvd, monkeypatch):
+    """Without the package, every tensor API raises with guidance."""
+    monkeypatch.delitem(sys.modules, "mxnet", raising=False)
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx.allreduce(FakeNDArray([1.0]))
+
+
+def test_allreduce_and_inplace(hvd, n_devices, fake_mx):
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = hvd_mx.allreduce(FakeNDArray(x), name="mx.ar")
+    assert isinstance(out, FakeNDArray) and out.context == "cpu(0)"
+    np.testing.assert_allclose(out.asnumpy(), x)
+
+    out = hvd_mx.allreduce(FakeNDArray(x), average=False, name="mx.ar_sum")
+    np.testing.assert_allclose(out.asnumpy(), x * n_devices)
+
+    t = FakeNDArray(x)
+    ret = hvd_mx.allreduce_(t, op=hvd_mx.Sum, name="mx.ar_")
+    assert ret is t
+    np.testing.assert_allclose(t.asnumpy(), x * n_devices)
+
+
+def test_grouped_ops(hvd, n_devices, fake_mx):
+    xs = [np.arange(4, dtype=np.float32),
+          np.arange(8, dtype=np.float32).reshape(n_devices, -1)]
+    outs = hvd_mx.grouped_allreduce([FakeNDArray(a) for a in xs],
+                                    name="mx.gar")
+    for o, a in zip(outs, xs):
+        np.testing.assert_allclose(o.asnumpy(), a)
+
+    outs = hvd_mx.grouped_allgather([FakeNDArray(a) for a in xs],
+                                    name="mx.gag")
+    for o, a in zip(outs, xs):
+        np.testing.assert_allclose(o.asnumpy(),
+                                   np.concatenate([a] * n_devices, axis=0))
+
+    rs_in = np.arange(n_devices * 2, dtype=np.float32).reshape(n_devices, 2)
+    outs = hvd_mx.grouped_reducescatter([FakeNDArray(rs_in)], name="mx.grs")
+    # Rank 0's shard of the average == row 0 (replicated inputs).
+    np.testing.assert_allclose(outs[0].asnumpy(), rs_in[:1])
+
+
+def test_allgather_broadcast_reducescatter(hvd, n_devices, fake_mx):
+    x = np.arange(3, dtype=np.float32)
+    out = hvd_mx.allgather(FakeNDArray(x), name="mx.ag")
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.concatenate([x] * n_devices))
+
+    out = hvd_mx.broadcast(FakeNDArray(x), root_rank=0, name="mx.bc")
+    np.testing.assert_allclose(out.asnumpy(), x)
+    t = FakeNDArray(x * 0)
+    ret = hvd_mx.broadcast_(t, 0, name="mx.bc_")
+    assert ret is t
+
+    rs_in = np.arange(n_devices * 2, dtype=np.float32).reshape(n_devices, 2)
+    out = hvd_mx.reducescatter(FakeNDArray(rs_in), op=hvd_mx.Sum,
+                               name="mx.rs")
+    np.testing.assert_allclose(out.asnumpy(), rs_in[:1] * n_devices)
+
+
+def test_alltoall_even_and_splits(hvd, n_devices, fake_mx):
+    x = np.arange(n_devices * 2, dtype=np.float32).reshape(n_devices, 2)
+    out = hvd_mx.alltoall(FakeNDArray(x), name="mx.a2a")
+    # Identical senders: rank 0 receives every sender's chunk 0.
+    np.testing.assert_allclose(out.asnumpy(), np.tile(x[:1], (n_devices, 1)))
+
+    splits = np.array([2] + [1] * (n_devices - 1), np.int32)
+    data = np.arange(int(splits.sum()), dtype=np.float32)[:, None]
+    recv, rsplits = hvd_mx.alltoall(FakeNDArray(data),
+                                    splits=FakeNDArray(splits),
+                                    name="mx.a2av")
+    assert rsplits.asnumpy().tolist() == [2] * n_devices
+    np.testing.assert_allclose(recv.asnumpy(),
+                               np.tile(data[:2], (n_devices, 1)))
+
+
+def test_broadcast_parameters_and_objects(hvd, fake_mx):
+    p = FakeParameter(np.arange(4.0))
+    raw = FakeNDArray(np.arange(3.0))
+    hvd_mx.broadcast_parameters({"w": p, "b": raw}, root_rank=0)
+    np.testing.assert_allclose(p.data().asnumpy(), np.arange(4.0))
+    with pytest.raises(ValueError, match="dict-like"):
+        hvd_mx.broadcast_parameters([p])
+
+    obj = {"step": 3, "arr": np.arange(2.0)}
+    got = hvd_mx.broadcast_object(obj, root_rank=0)
+    assert got["step"] == 3
+    gathered = hvd_mx.allgather_object({"r": 0}, name="mx.ago")
+    assert len(gathered) == hvd_mx.size()
+
+
+def test_distributed_optimizer(hvd, n_devices, fake_mx):
+    base = FakeOptimizer()
+    opt = hvd_mx.DistributedOptimizer(base, op=hvd_mx.Sum)
+    g = FakeNDArray(np.ones(4, np.float32))
+    opt.update(0, FakeNDArray(np.zeros(4)), g, None)
+    np.testing.assert_allclose(g.asnumpy(), np.full(4, n_devices))
+    # Grouped path: tuple index with matching grad list.
+    gs = [FakeNDArray(np.ones(2, np.float32)),
+          FakeNDArray(np.full(2, 2.0, np.float32))]
+    opt.update_multi_precision((1, 2), [None, None], gs, None)
+    np.testing.assert_allclose(gs[0].asnumpy(), np.full(2, n_devices))
+    np.testing.assert_allclose(gs[1].asnumpy(), np.full(2, 2.0 * n_devices))
+    assert opt.updates == [("update", 0), ("ump", (1, 2))]
+
+
+def test_distributed_trainer(hvd, n_devices, fake_mx):
+    params = {"w": FakeParameter(np.arange(4.0).astype(np.float32)),
+              "frozen": FakeParameter(np.zeros(2, np.float32),
+                                      grad_req="null")}
+    trainer = hvd_mx.DistributedTrainer(
+        params, "sgd", {"rescale_grad": 1.0})
+    assert trainer._scale == pytest.approx(1.0 / hvd_mx.size())
+    trainer._allreduce_grads()
+    # Trainable grad summed across ranks; frozen param untouched.
+    np.testing.assert_allclose(params["w"].list_grad()[0].asnumpy(),
+                               np.full(4, n_devices, np.float32))
+    np.testing.assert_allclose(params["frozen"].list_grad()[0].asnumpy(),
+                               np.ones(2, np.float32))
