@@ -1,0 +1,116 @@
+"""BN(+relu+residual) BACKWARD glue: measured XLA cost vs the HBM floor.
+
+Round-3's per-op account (docs/benchmarks.md) attributed ~45 ms of the
+60.7 ms ResNet-50 backward to HBM-bound BN/relu/residual backward chains
+and left one lever untried: a fused Pallas kernel reading each
+activation + cotangent once per pass.  Before writing that kernel, this
+probe establishes whether there is anything left to win: for each hot
+BN site it differential-times (``_harness.differential_bench``) the
+exact backward chain XLA compiles for
+
+    out = relu(batch_norm_train(x) * gamma + beta + shortcut)
+
+and compares against the two-pass exact-algorithm floor:
+
+    pass 1 (reductions): read x, dy, out          -> 3N bytes
+    pass 2 (apply):      read x, dy, out, write dx -> 4N bytes
+
+(7N total at the tensor's dtype; the per-channel scalars are noise).
+A measured/floor ratio near 1 REFUTES the kernel idea mechanically --
+XLA is already at the memory roof; a large ratio is the case for Pallas.
+
+Usage::
+
+    python examples/bn_bwd_probe.py [--batch 256] [--shapes 56x64 28x512]
+"""
+
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))
+_sys.path.insert(0, _dir(_abs(__file__)))
+
+import argparse
+import time  # noqa: F401  (harness import side effects)
+
+V5E_HBM = 819e9
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--shapes", nargs="+",
+                   default=["56x64", "56x256", "28x128", "28x512"],
+                   help="HxC sites (RN50 stage-2/3 hot shapes)")
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--spread", type=int, default=256,
+                   help="scan-length spread; raise for sub-0.3ms ops so "
+                        "the slope clears the tunnel's dispatch jitter")
+    p.add_argument("--dtype", default="bfloat16")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from _harness import differential_bench, nonlinear_tap
+
+    dt = jnp.dtype(args.dtype)
+    print(f"# devices: {jax.devices()}")
+    print("| shape | fwd ms | fwd+bwd ms | bwd ms | floor ms | "
+          "bwd/floor |")
+    print("|---|---|---|---|---|---|")
+
+    total_bwd = total_floor = 0.0
+    for spec in args.shapes:
+        side, ch = (int(v) for v in spec.split("x"))
+        shape = (args.batch, side, side, ch)
+        key = jax.random.PRNGKey(0)
+        x0 = jax.random.normal(key, shape, dt)
+        sc = jax.random.normal(jax.random.PRNGKey(1), shape, dt)
+        dy = jax.random.normal(jax.random.PRNGKey(2), shape, dt)
+        gamma = jnp.ones((ch,), jnp.float32)
+        beta = jnp.zeros((ch,), jnp.float32)
+
+        def block(x, shortcut, g, b):
+            x32 = x.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=(0, 1, 2))
+            var = jnp.var(x32, axis=(0, 1, 2))
+            xhat = (x32 - mean) / jnp.sqrt(var + 1e-5)
+            y = (xhat * g + b).astype(x.dtype) + shortcut
+            return jax.nn.relu(y)
+
+        def make_fwd():
+            def body(carry, _):
+                out = block(carry, sc, gamma, beta)
+                return nonlinear_tap(carry, out)
+            return body
+
+        def make_fwdbwd():
+            def body(carry, _):
+                out, vjp = jax.vjp(block, carry, sc, gamma, beta)
+                dx, dsc, dg, db = vjp(dy)
+                c, s1 = nonlinear_tap(carry, dx)
+                c, s2 = nonlinear_tap(c, dsc)
+                return c, s1 + s2
+            return body
+
+        f_s, f_ok = differential_bench(make_fwd, x0, args.iters,
+                                       k_spread=args.spread)
+        fb_s, fb_ok = differential_bench(make_fwdbwd, x0, args.iters,
+                                         k_spread=args.spread)
+        bwd = max(fb_s - f_s, 1e-9)
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        floor = 7 * nbytes / V5E_HBM
+        tag = "" if (f_ok and fb_ok) else " (low signal)"
+        print(f"| {shape} | {f_s*1e3:.3f} | {fb_s*1e3:.3f} "
+              f"| {bwd*1e3:.3f} | {floor*1e3:.3f} "
+              f"| {bwd/floor:.2f}x{tag} |", flush=True)
+        total_bwd += bwd
+        total_floor += floor
+    print(f"\ntotals: bwd {total_bwd*1e3:.2f} ms vs floor "
+          f"{total_floor*1e3:.2f} ms ({total_bwd/total_floor:.2f}x)")
+    return 0
+
+
+if __name__ == "__main__":
+    _sys.exit(main())

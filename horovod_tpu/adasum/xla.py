@@ -45,7 +45,7 @@ def _pair(a, b):
 
 
 def adasum_allreduce_hierarchical(x, dcn_axis: str = "dcn",
-                                  ici_axis: str = "ici"):
+                                  ici_axis: str = "ici", wire_codec=None):
     """Two-level Adasum on a ``(dcn, ici)`` mesh.
 
     TPU mapping of the reference's hybrid ``adasum_gpu_operations.cc``
@@ -59,10 +59,14 @@ def adasum_allreduce_hierarchical(x, dcn_axis: str = "dcn",
     ``adasum(ca, cb) = c adasum(a, b)`` -- so sum vs. mean only scales the
     result; the mean keeps data-parallel gradient magnitude independent of
     slice size).
+
+    ``wire_codec="fp8"`` quantizes the CROSS-SLICE (DCN) Adasum exchanges
+    only -- exactly where wire bytes hurt; the intra-slice psum_scatter /
+    all_gather accumulate on the wire and stay in the working dtype.
     """
     n_ici = lax.axis_size(ici_axis)
     if n_ici == 1:
-        return adasum_allreduce(x, axis=dcn_axis)
+        return adasum_allreduce(x, axis=dcn_axis, wire_codec=wire_codec)
     shape = x.shape
     flat = x.ravel()
     pad = (-flat.size) % n_ici
@@ -73,7 +77,7 @@ def adasum_allreduce_hierarchical(x, dcn_axis: str = "dcn",
                              tiled=True)
     from ..collectives.ops import _divide_in_dtype
     shard = _divide_in_dtype(shard, n_ici)  # keep the wire dtype (ints too)
-    mixed = adasum_allreduce(shard, axis=dcn_axis)
+    mixed = adasum_allreduce(shard, axis=dcn_axis, wire_codec=wire_codec)
     out = lax.all_gather(mixed, ici_axis, axis=0, tiled=True)
     if pad:
         out = out[:-pad]
@@ -98,7 +102,28 @@ def adasum_local_tree(vectors):
                  adasum_local_tree(vectors[half:]))
 
 
-def adasum_allreduce(x, axis: str = "hvd", members=None):
+def _codec_permute(piece, axis, perm, wire_codec):
+    """ppermute one VHDD piece, optionally over an fp8 wire.
+
+    With ``wire_codec="fp8"`` the piece is quantized e4m3 with a per-piece
+    max-abs scale; the f32 scale rides a second (scalar) ppermute and the
+    receiver dequantizes back to the working dtype.  All VHDD arithmetic
+    (dot products, mixing) stays in the working dtype/f32 -- fp8 touches
+    only the wire, quartering the exchange bytes of an f32 bucket
+    (halving fp16's).
+    """
+    if wire_codec is None:
+        return lax.ppermute(piece, axis, perm)
+    if wire_codec != "fp8":
+        raise ValueError(f"unknown adasum wire codec {wire_codec!r}")
+    from ..collectives.compression import fp8_dequantize, fp8_quantize
+    q, scale = fp8_quantize(piece)
+    recv_q = lax.ppermute(q, axis, perm)
+    recv_s = lax.ppermute(scale, axis, perm)
+    return fp8_dequantize(recv_q, recv_s, piece.dtype)
+
+
+def adasum_allreduce(x, axis: str = "hvd", members=None, wire_codec=None):
     """Adasum-allreduce ``x`` across the (power-of-two) flat mesh axis.
 
     Vector-halving distance-doubling (the reference's ``adasum.h``
@@ -121,6 +146,11 @@ def adasum_allreduce(x, axis: str = "hvd", members=None):
     receive zeros and their scalar partials are masked out of the group
     sums; their output is GARBAGE -- the caller masks it back to the
     original input (``ops.allreduce`` does).
+
+    ``wire_codec="fp8"``: every exchanged piece (reduce halves AND the
+    rebuild allgather pieces) travels e4m3 with a per-piece scale --
+    ``Compression.fp8`` for the Adasum BASELINE config.  See
+    :func:`_codec_permute`.
     """
     n = lax.axis_size(axis)
     if members is None:
@@ -156,7 +186,7 @@ def adasum_allreduce(x, axis: str = "hvd", members=None):
         # aligned on the same global index range by induction.
         mine = jnp.where(is_lo, first, second)
         give = jnp.where(is_lo, second, first)
-        recv = lax.ppermute(give, axis, perm)
+        recv = _codec_permute(give, axis, perm, wire_codec)
         a_piece = jnp.where(is_lo, mine, recv)  # lower group's vector
         b_piece = jnp.where(is_lo, recv, mine)
         a32 = a_piece.astype(jnp.float32)
@@ -181,7 +211,7 @@ def adasum_allreduce(x, axis: str = "hvd", members=None):
         bit = 1 << k
         perm = [(members[i], members[i ^ bit]) for i in range(m)]
         is_lo = (pos & bit) == 0
-        recv = lax.ppermute(y, axis, perm)
+        recv = _codec_permute(y, axis, perm, wire_codec)
         y = jnp.where(is_lo, jnp.concatenate([y, recv]),
                       jnp.concatenate([recv, y]))
     if pad:

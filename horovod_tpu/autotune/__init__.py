@@ -29,7 +29,8 @@ knobs where a real choice survives under XLA:
   reduce-scatter/DCN-allreduce/allgather
   (:func:`~horovod_tpu.collectives.ops.hierarchical_allreduce`);
 * **compression codec** (OPT-IN via ``HOROVOD_AUTOTUNE_COMPRESSION=1``,
-  because it changes wire numerics): configured default vs bf16 vs fp16.
+  because it changes wire numerics): configured default vs bf16 vs fp16
+  vs fp8 (e4m3 exchange-level codec, ``compression.py``).
 
 The response-cache toggle stays collapsed: an executable-cache hit is
 always strictly cheaper than a retrace, so there is nothing to search.
@@ -50,7 +51,7 @@ _CYCLES_MS = [0.5, 1.0, 5.0]
 MAX_SAMPLES = 12
 # Compression axis encoding (grid value -> codec); 0 keeps whatever the
 # optimizer was configured with.
-COMP_DEFAULT, COMP_BF16, COMP_FP16 = 0, 1, 2
+COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8 = 0, 1, 2, 3
 
 
 def _grid(thresholds, cycles, hiers,
@@ -94,7 +95,7 @@ class Autotuner:
         hiers = [0, 1] if _mesh_is_two_level() else \
             [1 if config.hierarchical_allreduce else 0]
         from ..core.config import _env_bool
-        comps = [COMP_DEFAULT, COMP_BF16, COMP_FP16] \
+        comps = [COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8] \
             if _env_bool("AUTOTUNE_COMPRESSION") else [COMP_DEFAULT]
         self.grid = _grid(sorted(self.candidates), sorted(cycles), hiers,
                           comps)
@@ -135,6 +136,8 @@ class Autotuner:
             return Compression.bf16
         if k == COMP_FP16:
             return Compression.fp16
+        if k == COMP_FP8:
+            return Compression.fp8
         return configured
 
     def trace_key(self) -> tuple:

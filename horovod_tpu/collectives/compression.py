@@ -7,14 +7,54 @@ exponent range as fp32 -- no loss scaling needed, and the MXU/ICI path is
 optimized for it), so ``bf16`` is provided alongside ``fp16``; both halve
 bytes-on-the-wire for fp32 gradients.
 
-The cast is emitted inside the traced step, so XLA fuses it with the
-fusion-buffer pack and the collective kernel -- the "compression" costs no
-extra HBM round trip.
+``fp8`` (e4m3 + per-bucket scale factors) quarters the wire bytes of fp32
+gradients.  Unlike the cast codecs it cannot ride a plain ``psum`` (XLA
+reduces in the wire dtype: 3 mantissa bits of ACCUMULATION error and
+overflow at ~448), so the collective layer swaps the exchange itself:
+``ops.fp8_allreduce`` (alltoall shards -> f32 local reduce -> async-capable
+all_gather) for Sum/Average, and per-exchange quantization of the VHDD
+``ppermute`` payloads for Adasum -- all arithmetic stays f32 on-chip, fp8
+touches only the wire.  Scales ride as one f32 scalar per shard
+(negligible).  Quantization noise is ~2^-4 relative per direction (e4m3
+rounding); parity tests bound it.
+
+The casts/quantizations are emitted inside the traced step, so XLA fuses
+them with the fusion-buffer pack and the collective kernel -- the
+"compression" costs no extra HBM round trip.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+E4M3_MAX = 448.0
+_SCALE_FLOOR = 1e-30
+
+
+def fp8_quantize(x, axis=None):
+    """Quantize to e4m3 with a max-abs scale (per tensor, or per row of
+    ``axis=1``-style leading dim when ``axis`` is given).
+
+    Returns ``(q, scale)``: ``x ~= q.astype(f32) * scale``.
+    """
+    x32 = x.astype(jnp.float32)
+    if axis is None:
+        absmax = jnp.max(jnp.abs(x32))
+    else:
+        red = tuple(i for i in range(x32.ndim) if i != axis)
+        absmax = jnp.max(jnp.abs(x32), axis=red, keepdims=False)
+    scale = jnp.maximum(absmax / E4M3_MAX, _SCALE_FLOOR)
+    if axis is None:
+        q = (x32 / scale).astype(jnp.float8_e4m3fn)
+    else:
+        shape = [1] * x32.ndim
+        shape[axis] = -1
+        q = (x32 / scale.reshape(shape)).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def fp8_dequantize(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
 class Compressor:
@@ -64,8 +104,35 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class FP8Compressor(Compressor):
+    """e4m3 wire with per-bucket scales -- an EXCHANGE-level codec.
+
+    ``compress``/``decompress`` are identities: fp8 cannot ride a plain
+    psum (see module docstring), so the collective layer recognises
+    ``wire_format == "fp8_e4m3"`` and swaps the exchange itself
+    (``ops.fp8_allreduce`` for Sum/Average; quantized VHDD permutes for
+    Adasum).  Surfaces that cannot swap the exchange raise rather than
+    silently sum in fp8.
+    """
+    wire_format = "fp8_e4m3"
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+def is_fp8(compression) -> bool:
+    return getattr(compression, "wire_format", "").startswith("fp8")
+
+
 class Compression:
-    """Namespace matching ``hvd.Compression.{none,fp16}`` plus TPU ``bf16``."""
+    """Namespace matching ``hvd.Compression.{none,fp16}`` plus TPU ``bf16``
+    and ``fp8`` (e4m3, per-bucket scales)."""
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    fp8 = FP8Compressor

@@ -338,6 +338,17 @@ def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
                      compression=compression.__name__)
 
     def per_rank(t):
+        from .compression import is_fp8
+        from .reduce_op import Adasum as _Adasum
+        if is_fp8(compression):
+            if op is _Adasum:
+                return _ops.allreduce(t, op, axes=(HVD_AXIS,),
+                                      prescale_factor=prescale_factor,
+                                      postscale_factor=postscale_factor,
+                                      wire_codec="fp8")
+            return _ops.fp8_allreduce(t, op, axes=(HVD_AXIS,),
+                                      prescale_factor=prescale_factor,
+                                      postscale_factor=postscale_factor)
         c, ctx = compression.compress(t)
         r = _ops.allreduce(c, op, axes=(HVD_AXIS,),
                            prescale_factor=prescale_factor,

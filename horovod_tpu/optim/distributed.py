@@ -48,11 +48,19 @@ def allreduce_gradients(grads,
     autotuned) and -- opt-in, it changes wire numerics
     (``HOROVOD_AUTOTUNE_COMPRESSION=1``) -- the compression codec.
     """
+    from ..collectives.compression import is_fp8
     from ..core.state import global_state
     st = global_state()
     tuner = st.autotuner
     if tuner is not None:
-        compression = tuner.compression_override(compression)
+        override = tuner.compression_override(compression)
+        if (is_fp8(override) and not is_fp8(compression)
+                and process_set is not None):
+            # The tuner's fp8 axis cannot serve subset reductions (the
+            # quantized exchange has no masked identity); keep the
+            # configured codec for this sample instead of failing it.
+            override = compression
+        compression = override
         explicit_hier = tuner.hierarchical_explicit()
     else:
         explicit_hier = bool(st.config and st.config.hierarchical_allreduce)
@@ -63,8 +71,25 @@ def allreduce_gradients(grads,
         return tuple(st.mesh.axis_names) if st.mesh is not None else ()
 
     def collective(buf):
-        c, ctx = compression.compress(buf)
         ax = resolved_axes()
+        if is_fp8(compression):
+            # Exchange-level codec: the collective itself changes (a psum
+            # cannot carry fp8 -- compression.py module docstring).
+            from ..collectives.reduce_op import Adasum
+            if op is Adasum:
+                return _ops.allreduce(
+                    buf, op, axes=axes, process_set=process_set,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor, wire_codec="fp8")
+            if process_set is not None:
+                raise NotImplementedError(
+                    "Compression.fp8 does not support process-set "
+                    "Sum/Average reductions (no masked identity for a "
+                    "quantized exchange); use fp16/bf16 there")
+            return _ops.fp8_allreduce(
+                buf, op, axes=axes, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+        c, ctx = compression.compress(buf)
         if (explicit_hier and process_set is None and len(ax) == 2
                 and op in (_ops.Sum, Average)):
             r = _ops.hierarchical_allreduce(

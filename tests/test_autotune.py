@@ -149,13 +149,15 @@ def test_autotuner_compression_axis_is_opt_in(monkeypatch):
 
     monkeypatch.setenv("HOROVOD_AUTOTUNE_COMPRESSION", "1")
     t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert {k for _t, _c, _h, k in t2.grid} == {0, 1, 2}
-    # Force a sample on the bf16 codec and check the override resolves.
-    for i, cfg in enumerate(t2.grid):
-        if cfg[3] == 1:
-            t2._idx = i
-            break
-    assert t2.compression_override(Compression.none) is Compression.bf16
+    assert {k for _t, _c, _h, k in t2.grid} == {0, 1, 2, 3}
+    # Force a sample on the bf16 / fp8 codecs and check the overrides
+    # resolve.
+    for want, codec in [(1, Compression.bf16), (3, Compression.fp8)]:
+        for i, cfg in enumerate(t2.grid):
+            if cfg[3] == want:
+                t2._idx = i
+                break
+        assert t2.compression_override(Compression.none) is codec
 
 
 def test_hierarchical_allreduce_matches_flat_psum(hvd):

@@ -72,6 +72,112 @@ def test_allreduce_fp16_compression(hvd, n_devices):
                                atol=1e-2)
 
 
+def test_allreduce_fp8_compression(hvd, n_devices):
+    """Compression.fp8 through the eager surface: e4m3 exchange codec
+    (alltoall + f32 local reduce + allgather), NOT a psum in fp8 -- the
+    reduction itself is exact f32, only the wire quantizes (two e4m3
+    roundings ~2^-4 relative each)."""
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(n_devices, 1000) * 3, jnp.float32)
+    y = hvd.allreduce(x, hvd.Average, compression=hv.Compression.fp8,
+                      name="fp8_avg")
+    assert y.dtype == jnp.float32 and y.shape == x.shape
+    expect = np.mean(np.asarray(x), axis=0)
+    err = np.abs(np.asarray(y[0]) - expect)
+    scale_bound = np.abs(np.asarray(x)).max() * 2 * 2 ** -7  # e4m3 quantum
+    assert err.max() <= scale_bound, (err.max(), scale_bound)
+
+    # Sum + pre/postscale route through the same exchange.
+    y = hvd.allreduce(x, hvd.Sum, compression=hv.Compression.fp8,
+                      prescale_factor=0.5, postscale_factor=2.0,
+                      name="fp8_sum")
+    np.testing.assert_allclose(np.asarray(y[0]),
+                               np.sum(np.asarray(x), axis=0), rtol=0.1,
+                               atol=scale_bound * n_devices)
+
+
+def test_fp8_allreduce_in_step(hvd, n_devices):
+    """ops.fp8_allreduce inside a traced step: odd sizes (pad path),
+    bf16 inputs, and the error bound vs the exact f32 psum."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    rng = np.random.RandomState(3)
+    for size, dtype in [(1000, jnp.float32), (n_devices * 4, jnp.bfloat16),
+                        (7, jnp.float32)]:
+        x = jnp.asarray(rng.randn(n_devices, size), dtype)
+
+        def f(t):
+            return cops.fp8_allreduce(t[0], cops.Average, axes=axes)[None]
+
+        fs = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axes),
+                                   out_specs=P(axes)))
+        y = np.asarray(fs(x), np.float32)
+        expect = np.mean(np.asarray(x, dtype=np.float32), axis=0)
+        bound = max(np.abs(np.asarray(x, np.float32)).max() * 2 * 2 ** -7,
+                    1e-3)
+        assert y[0].shape == expect.shape and np.abs(
+            y[0] - expect).max() <= bound
+
+    # Loud failures: ints and non-Sum/Average ops.
+    with pytest.raises(ValueError, match="floating"):
+        jax.jit(jax.shard_map(
+            lambda t: cops.fp8_allreduce(t[0], cops.Sum, axes=axes)[None],
+            mesh=mesh, in_specs=P(axes), out_specs=P(axes))
+        )(jnp.ones((n_devices, 8), jnp.int32))
+
+
+def test_adasum_fp8_wire(hvd, n_devices):
+    """Adasum with the fp8 wire codec: every VHDD exchange quantizes to
+    e4m3 + scale; the mixing math stays f32.  Result within fp8 rounding
+    of the uncompressed Adasum, through the full DistributedOptimizer
+    path (Compression.fp8 + op=Adasum)."""
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.collectives import ops as cops
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(n_devices, 513) * 2, jnp.float32)
+
+    def f(t, codec):
+        return cops.allreduce(t[0], cops.Adasum, axes=axes,
+                              wire_codec=codec)[None]
+
+    import functools
+    exact = jax.jit(jax.shard_map(functools.partial(f, codec=None),
+                                  mesh=mesh, in_specs=P(axes),
+                                  out_specs=P(axes)))(x)
+    fp8 = jax.jit(jax.shard_map(functools.partial(f, codec="fp8"),
+                                mesh=mesh, in_specs=P(axes),
+                                out_specs=P(axes)))(x)
+    a, b = np.asarray(exact[0]), np.asarray(fp8[0])
+    denom = max(np.abs(a).max(), 1e-6)
+    # A value crosses up to 2*log2(n) quantized exchanges; each e4m3
+    # rounding is <= 2^-4 relative, so allow a few quanta peak and
+    # require the AVERAGE error to be well under one quantum.
+    assert np.abs(a - b).max() / denom < 0.15, np.abs(a - b).max() / denom
+    rms = float(np.sqrt(np.mean((a - b) ** 2)))
+    assert rms / denom < 0.02, rms / denom
+
+    # The optimizer-level route: Compression.fp8 + Adasum selects the
+    # quantized VHDD (would raise if it fell into a plain psum).
+    from horovod_tpu.optim.distributed import allreduce_gradients
+    g = {"w": jnp.asarray(rng.randn(n_devices, 65), jnp.float32)}
+
+    def opt_f(t):
+        out = allreduce_gradients({"w": t["w"][0]}, cops.Adasum,
+                                  compression=hv.Compression.fp8,
+                                  axes=axes)
+        return {"w": out["w"][None]}
+
+    res = jax.jit(jax.shard_map(opt_f, mesh=mesh, in_specs=P(axes),
+                                out_specs=P(axes)))(g)
+    assert np.isfinite(np.asarray(res["w"])).all()
+
+
 def test_allgather(hvd, n_devices):
     x = rank_stacked(n_devices, (2, 3), jnp.float32)
     y = hvd.allgather(x)

@@ -80,6 +80,58 @@ _HLO_SAMPLE = """
 """
 
 
+_SCHEDULED_MODULE = """\
+HloModule jit_step, is_scheduled=true
+
+%fused_computation.1 (p0: f32[128,256], p1: f32[256,256]) -> f32[128,256] {
+  %p0 = f32[128,256]{1,0:T(8,128)} parameter(0)
+  %p1 = f32[256,256]{1,0:T(8,128)} parameter(1)
+  ROOT %d = f32[128,256]{1,0:T(8,128)} dot(%p0, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+ENTRY %main_spmd (param.0: f32[128,256], param.1: f32[256,256]) {
+  %param.0 = f32[128,256]{1,0:T(8,128)} parameter(0)
+  %param.1 = f32[256,256]{1,0:T(8,128)} parameter(1)
+  %collective-permute-start.1 = (f16[1024]{0:T(1024)(128)(2,1)}, f16[1024]{0:T(1024)(128)(2,1)}, u32[]{:S(2)}, u32[]{:S(2)}) collective-permute-start(%param.0), channel_id=1, source_target_pairs={{0,1},{1,0}}
+  %fusion.1 = f32[128,256]{1,0:T(8,128)} fusion(%param.0, %param.1), kind=kOutput, calls=%fused_computation.1
+  %collective-permute-done.1 = f16[1024]{0:T(1024)(128)(2,1)} collective-permute-done(%collective-permute-start.1)
+  %all-reduce = (f32[1000]{0:T(1024)}, f32[24]{0:T(128)}) all-reduce(%fusion.1, %param.1), channel_id=2, replica_groups={{0,1}}, to_apply=%add
+  ROOT %tuple = (f32[128,256]{1,0:T(8,128)}) tuple(%fusion.1)
+}
+"""
+
+
+def test_schedule_overlap_report_parses_scheduled_tpu_module():
+    """The round-4 topology-AOT parser: async start/done pairs matched by
+    name (TPU tuple shapes with nested tiling parens must not break it),
+    sync collectives classified with variadic tuple payloads, fusion
+    FLOPs costed through the called computation, and the eq-payload
+    conversion (permute result = link bytes)."""
+    rep = scaling.schedule_overlap_report(_SCHEDULED_MODULE, n_devices=2)
+    assert len(rep.async_collectives) == 1
+    op, payload, si, di = rep.async_collectives[0]
+    assert op == "collective-permute" and payload == 2048 and di - si == 2
+    assert len(rep.sync_collectives) == 1
+    sop, sbytes, _ = rep.sync_collectives[0]
+    assert sop == "all-reduce" and sbytes == 4096  # 4000 + 96 B variadic
+    # The dot (2*128*256*256 flops) lies inside the async window.
+    assert rep.async_window_seconds > 0
+    assert rep.total_compute_seconds >= rep.async_window_seconds
+    # Permute result bytes are LINK bytes: eq payload divides the ring
+    # factor 2(n-1)/n = 1 at n=2.
+    assert rep.async_eq_payload() == pytest.approx(2048)
+    # Scheduled efficiency: sync fully exposed, async hidden up to the
+    # window.
+    pts = scaling.predict_efficiency_scheduled(0.01, rep, scaling.V5E,
+                                               ns=(8,))
+    assert pts[0].eff_full_overlap >= pts[0].eff_no_overlap
+    # A 4x bandwidth derate can only lower the scheduled number.
+    pts4 = scaling.predict_efficiency_scheduled(0.01, rep, scaling.V5E,
+                                                ns=(8,),
+                                                bandwidth_derate=4.0)
+    assert pts4[0].eff_full_overlap <= pts[0].eff_full_overlap + 1e-12
+
+
 def test_optimized_stats_counts_and_bytes():
     st = scaling.optimized_collective_stats(_HLO_SAMPLE)
     assert st.counts == {"all-reduce": 2, "all-gather": 1,
