@@ -351,8 +351,10 @@ def _replay(meta: dict) -> None:
     from .compression import Compression
     from .reduce_op import ReduceOp
 
-    comps = {c.__name__: c for c in
-             (Compression.none, Compression.fp16, Compression.bf16)}
+    # Derived from the namespace, not hand-listed: publish serializes ANY
+    # compression.__name__, so a codec added to Compression must replay.
+    comps = {c.__name__: c for c in vars(Compression).values()
+             if isinstance(c, type)}
     kind = meta["kind"]
     name = meta.get("name")
     _replaying = True

@@ -230,9 +230,13 @@ class ScheduleReport:
         ring = 2.0 * (n - 1) / n
         eq = 0.0
         for op, b, _, _ in self.async_collectives:
-            if op == "all-gather":
-                eq += b / 2.0
-            else:                    # permute and friends: link bytes
+            if op in ("all-gather", "all-to-all"):
+                eq += b / 2.0      # result == full payload B; link B(n-1)/n
+            elif op == "all-reduce":
+                eq += b            # result bytes == full payload == B
+            elif op == "reduce-scatter":
+                eq += b * n / 2.0  # result is B/n shard; link = B(n-1)/n
+            else:                  # permute: result bytes ARE link bytes
                 eq += b / ring
         return eq
 

@@ -84,8 +84,15 @@ def test_allreduce_fp8_compression(hvd, n_devices):
     assert y.dtype == jnp.float32 and y.shape == x.shape
     expect = np.mean(np.asarray(x), axis=0)
     err = np.abs(np.asarray(y[0]) - expect)
-    scale_bound = np.abs(np.asarray(x)).max() * 2 * 2 ** -7  # e4m3 quantum
+    absmax = np.abs(np.asarray(x)).max()
+    # Analytic worst case: each e4m3 rounding errs up to a half-ulp at the
+    # top binade = absmax/28 (ulp 32 on the 448 grid); two quantized
+    # directions -> 2*absmax/28.  The tight check moves to the RMS, where
+    # rounding errors average out.
+    scale_bound = 2 * absmax / 28
     assert err.max() <= scale_bound, (err.max(), scale_bound)
+    rms = float(np.sqrt(np.mean(err ** 2)))
+    assert rms <= absmax * 2 * 2 ** -7, (rms, absmax)
 
     # Sum + pre/postscale route through the same exchange.
     y = hvd.allreduce(x, hvd.Sum, compression=hv.Compression.fp8,
@@ -116,7 +123,10 @@ def test_fp8_allreduce_in_step(hvd, n_devices):
                                    out_specs=P(axes)))
         y = np.asarray(fs(x), np.float32)
         expect = np.mean(np.asarray(x, dtype=np.float32), axis=0)
-        bound = max(np.abs(np.asarray(x, np.float32)).max() * 2 * 2 ** -7,
+        # Analytic worst case: two e4m3 roundings, each <= absmax/28 (the
+        # half-ulp of the 448 grid's top binade); bf16 inputs add their
+        # own cast noise, floored at 1e-3.
+        bound = max(np.abs(np.asarray(x, np.float32)).max() * 2 / 28,
                     1e-3)
         assert y[0].shape == expect.shape and np.abs(
             y[0] - expect).max() <= bound

@@ -52,6 +52,23 @@ def test_replay_abort_raises_with_message(hvd):
         joinop._replay({"kind": "abort", "message": "root has left"})
 
 
+def _all_codec_names():
+    from horovod_tpu.collectives.compression import Compression
+    return [c.__name__ for c in vars(Compression).values()
+            if isinstance(c, type)]
+
+
+@pytest.mark.parametrize("compression", _all_codec_names())
+def test_replay_knows_every_compression_codec(hvd, compression):
+    """Regression (round-4 advisor): a drained rank replaying an eager
+    allreduce published with Compression.fp8 hit a KeyError (the replay
+    map only knew none/fp16/bf16), crashing the drained rank and stalling
+    active ranks until HOROVOD_JOIN_TIMEOUT."""
+    joinop._replay({"kind": "allreduce", "name": None, "shape": (1, 4),
+                    "dtype": "float32", "op": "sum", "pre": 1.0,
+                    "post": 1.0, "compression": compression})
+
+
 class _FakeKV:
     """Dict-backed stand-in for the coordination-service client."""
 
