@@ -359,9 +359,13 @@ def run_topology_mode(args) -> int:
                 ok = False
                 print("FAIL: rn50 scheduled efficiency below 90%")
     print()
-    print(json.dumps({"metric": "scaling_schedule", "ok": ok,
-                      "topology": args.topology, "models": summary}),
-          flush=True)
+    result = {"metric": "scaling_schedule", "ok": ok,
+              "topology": args.topology, "models": summary}
+    print(json.dumps(result), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
     return 0 if ok else 1
 
 
@@ -377,6 +381,10 @@ def main() -> int:
                         "compiled schedule instead of virtual-CPU HLO")
     p.add_argument("--tolerance", type=float, default=0.02,
                    help="relative tolerance for the payload invariants")
+    p.add_argument("--out", default="",
+                   help="also write the summary JSON to this file "
+                        "(topology mode: the committed SCALING_r*.json "
+                        "artifact)")
     args = p.parse_args()
     if args.worker:
         run_worker(args.worker[0], int(args.worker[1]),

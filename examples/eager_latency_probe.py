@@ -41,13 +41,31 @@ def main():
         hvd.grouped_allreduce(xs, hvd.Sum)
     grouped = (time.perf_counter() - t0) / (n_iter // 3) * 1e3
 
+    # Ungrouped async loop: K allreduce_async_ + one synchronize drain.
+    # Round-5: deferred dispatch batches ALL K behind ONE presence round
+    # (was one round per op -- the reference's background loop amortizes
+    # the same way via its per-cycle negotiation).
+    from horovod_tpu.collectives import eager as _eager
+    K = 8
+    hs = [hvd.allreduce_async(x) for _ in range(K)]
+    deferred = _eager.deferred_count()
+    for h in hs:
+        hvd.synchronize(h)
+    t0 = time.perf_counter()
+    for _ in range(n_iter // 3):
+        hs = [hvd.allreduce_async(x) for _ in range(K)]
+        for h in hs:
+            hvd.synchronize(h)
+    async_loop = (time.perf_counter() - t0) / (n_iter // 3) * 1e3
+
     if rank == 0:
         from horovod_tpu.core.config import _env_bool
         mode = "join-disabled" if _env_bool("JOIN_DISABLE") \
             else "join-enabled"
         print(f"[{mode}] single allreduce: {single:.1f} ms/dispatch; "
-              f"grouped(8 tensors, 4 dtype buckets): {grouped:.1f} ms/group",
-              flush=True)
+              f"grouped(8 tensors, 4 dtype buckets): {grouped:.1f} ms/group; "
+              f"async-ungrouped({K} ops, {deferred} deferred): "
+              f"{async_loop:.1f} ms/batch", flush=True)
     hvd.shutdown()
 
 

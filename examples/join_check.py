@@ -61,6 +61,18 @@ def main():
         assert (outs[1][0] == 2).all(), outs[1]
         print(f"rank {rank}: grouped-during-join OK")
 
+        # Ungrouped async loop (round-5 deferred dispatch): THREE
+        # allreduce_async handles flush behind ONE presence round at the
+        # first synchronize; drained ranks read flush size 3 and replay
+        # all three with identity payloads before their next round.
+        hs = [hvd.allreduce_async(
+            np.full((s, 2), float(i + 1), np.float32), hvd.Sum,
+            name=f"join_async_{i}") for i in range(3)]
+        for i, h in enumerate(hs):
+            got = hvd.local_result(hvd.synchronize(h))[0]
+            assert np.allclose(got, i + 1.0), (i, got)
+        print(f"rank {rank}: async-ungrouped-during-join OK")
+
     last = hvd.join()
     print(f"rank {rank}: join OK last={last}")
     assert last == n - 1, (last, n)  # the rank with the most batches

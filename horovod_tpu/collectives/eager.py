@@ -188,6 +188,7 @@ def reset_fences() -> None:
     survivor carrying the old counts would wait at differently-named
     barriers forever."""
     from . import joinop as _join
+    reset_deferred()
     with _fence_lock:
         _fence_seq.clear()
     _join.reset()
@@ -243,6 +244,8 @@ _handle_lock = threading.Lock()
 _handle_counter = itertools.count(1)
 _handles: Dict[int, Any] = {}
 
+_PENDING = object()  # handle value: enqueued in _deferred, not yet dispatched
+
 
 def _alloc_handle(value) -> int:
     with _handle_lock:
@@ -253,17 +256,31 @@ def _alloc_handle(value) -> int:
 
 def synchronize(handle: int):
     """Block until the async op completes and return its result."""
+    flush_deferred()
     with _handle_lock:
         value = _handles.pop(handle)
+    if isinstance(value, BaseException):
+        raise value
     with _stall.watched(f"synchronize(handle={handle})"):
         return jax.block_until_ready(value)
 
 
 def poll(handle: int) -> bool:
-    """True when the async op has finished (result ready to fetch)."""
+    """True when the async op has finished (result ready to fetch).
+
+    Polling a still-deferred op dispatches the pending batch first (the
+    reference's PollHandle likewise guarantees progress -- a caller
+    spinning on poll() must not livelock on an op that was never
+    submitted to the cycle)."""
+    with _handle_lock:
+        pending = _handles.get(handle) is _PENDING
+    if pending:
+        flush_deferred()
     with _handle_lock:
         value = _handles.get(handle)
     if value is None:
+        return True
+    if isinstance(value, BaseException):
         return True
     try:
         return all(not a.is_deleted() and a.is_ready()
@@ -271,6 +288,109 @@ def poll(handle: int) -> bool:
     except AttributeError:  # pragma: no cover - older jax
         jax.block_until_ready(value)
         return True
+
+
+# ---------------------------------------------------------------------------
+# Deferred async dispatch (cycle batching for the presence protocol).
+#
+# Reference analogue: EnqueueTensorAllreduce puts the request on the
+# background loop's queue and RunLoopOnce negotiates EVERYTHING pending in
+# one controller round per cycle.  Here the control-plane cost is the join
+# presence round (~ms on localhost Gloo, measured in docs/benchmarks.md
+# "Eager control plane"), and the grouped/fused entry points already
+# amortize it via joinop.flush -- but a loop of ungrouped ``*_async`` ops
+# paid one round each.  Deferring the dispatch until a flush point
+# (synchronize/poll, any sync collective, hvd.join, or the capacity cap)
+# lets ONE presence round cover every op enqueued since the last flush,
+# exactly the reference's async contract: an async op is only guaranteed
+# to have run after its synchronize().
+#
+# Only ops the presence protocol applies to are deferred (multi-process,
+# global set, join enabled): everywhere else JAX dispatch is already
+# async and immediate dispatch is strictly better.  Flush points are
+# program-order-deterministic (SPMD processes enqueue identical op
+# sequences), so every process cuts identical batches -- a requirement,
+# since the batch size is published to drained ranks via the flush-size
+# protocol.
+# ---------------------------------------------------------------------------
+
+_deferred_lock = threading.Lock()
+_deferred: List[tuple] = []          # (handle, thunk) in issue order
+_MAX_DEFERRED = 512                  # capacity flush (deterministic: count)
+_flush_lock = threading.RLock()      # serializes flushes across threads
+_flushing = False                    # True only while _flush_lock is held
+
+
+def _defer(thunk) -> int:
+    h = _alloc_handle(_PENDING)
+    with _deferred_lock:
+        _deferred.append((h, thunk))
+        full = len(_deferred) >= _MAX_DEFERRED
+    if full:
+        flush_deferred()
+    return h
+
+
+def deferred_count() -> int:
+    with _deferred_lock:
+        return len(_deferred)
+
+
+def reset_deferred() -> None:
+    """Drop undispatched async ops (``hvd.shutdown()``): an async op is
+    only guaranteed dispatched after synchronize/poll, and flushing here
+    could hang against peers that already shut down."""
+    with _deferred_lock:
+        dropped = list(_deferred)
+        _deferred.clear()
+    with _handle_lock:
+        for h, _ in dropped:
+            _handles.pop(h, None)
+
+
+def flush_deferred() -> None:
+    """Dispatch every deferred async op behind ONE presence round.
+
+    Serialized under an RLock: a REENTRANT call (a thunk's own dispatch
+    re-entering via ``_join_sync``/``joinop.flush``) sees ``_flushing``
+    and returns; a CONCURRENT thread's ``synchronize``/``poll`` blocks
+    here until the in-flight flush lands its results -- returning early
+    would let it pop the raw ``_PENDING`` sentinel as the op's value.
+    """
+    global _flushing
+    with _flush_lock:
+        if _flushing:
+            return
+        with _deferred_lock:
+            pending = list(_deferred)
+            _deferred.clear()
+        if not pending:
+            return
+        from . import joinop as _join
+        ps = _ps.get_process_set(None)
+        _flushing = True
+        try:
+            with _join.flush(ps, len(pending)):
+                err = None
+                for h, thunk in pending:
+                    if err is None:
+                        try:
+                            value = thunk()
+                        except BaseException as e:  # noqa: BLE001
+                            err = e
+                            value = e
+                    else:
+                        # Ops after a failure never dispatch (the flush
+                        # context publishes an abort for their slots);
+                        # their synchronize() re-raises the same error.
+                        value = err
+                    with _handle_lock:
+                        if h in _handles:
+                            _handles[h] = value
+                if err is not None:
+                    raise err
+        finally:
+            _flushing = False
 
 
 # ---------------------------------------------------------------------------
@@ -287,6 +407,11 @@ def _join_sync(ps, kind: str, x, name: Optional[str], extra: dict = None):
     ranks to replay.
     """
     from . import joinop as _join
+    if not _flushing:
+        # A sync collective is a flush point: pending deferred async ops
+        # must dispatch first (program order; same point on every SPMD
+        # process) so their presence round precedes this op's.
+        flush_deferred()
     ps = _ps.get_process_set(ps)
     mask = _join.sync(ps)
     if mask is None:
@@ -365,6 +490,16 @@ def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
 def allreduce_async(x, op: ReduceOp = Average, *, name=None, process_set=None,
                     prescale_factor=1.0, postscale_factor=1.0,
                     compression=Compression.none) -> int:
+    from . import joinop as _join
+    ps_ = _ps.get_process_set(process_set)
+    if not _flushing and _join._applies(ps_):
+        # Snapshot host inputs: the caller may mutate the buffer between
+        # enqueue and flush (jax arrays are immutable; no copy needed).
+        x_snap = x if isinstance(x, jax.Array) else np.array(x, copy=True)
+        return _defer(lambda: allreduce(
+            x_snap, op, name=name, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, compression=compression))
     out = allreduce(x, op, name=name, process_set=process_set,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor, compression=compression)
@@ -903,6 +1038,7 @@ def join() -> int:
     convention when ranks are indistinguishable.
     """
     from . import joinop as _join
+    flush_deferred()
     ps = _ps.get_process_set(None)
     mesh = ps.flat_mesh()
     if not _is_multiprocess(mesh) or _join.client() is None:

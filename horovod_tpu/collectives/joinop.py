@@ -226,6 +226,8 @@ def flush(ps, n_ops: int):
     points, whose op count is known up front.
     """
     global _flush_state
+    from . import eager
+    eager.flush_deferred()  # pending async ops dispatch before this batch
     ps_ = _ps.get_process_set(ps)
     if _flush_state is not None or n_ops <= 1 or not _applies(ps_):
         yield

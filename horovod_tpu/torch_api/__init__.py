@@ -122,11 +122,15 @@ def allreduce_async(tensor: torch.Tensor, average: Optional[bool] = None,
                     postscale_factor: float = 1.0) -> int:
     op = _resolve_op(average, op)
     stacks, compression = _wire_stage([_to_stack(tensor)], compression)
-    out = _eager.allreduce(stacks[0], op, name=name,
-                           process_set=process_set, compression=compression,
-                           prescale_factor=prescale_factor,
-                           postscale_factor=postscale_factor)
-    return _handles.alloc(out, tensor, inplace=False)
+    # allreduce_async defers in multi-process join mode (one presence
+    # round covers every op enqueued before the next synchronize) and
+    # dispatches immediately elsewhere.
+    h = _eager.allreduce_async(stacks[0], op, name=name,
+                               process_set=process_set,
+                               compression=compression,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor)
+    return _handles.adopt(h, tensor, inplace=False)
 
 
 def allreduce_async_(tensor: torch.Tensor, **kwargs) -> int:
@@ -390,13 +394,25 @@ class _HandleTable:
         self._entries[h] = (assemble, None, False, None)
         return h
 
+    def adopt(self, h: int, like: torch.Tensor, inplace: bool = False,
+              assemble=None) -> int:
+        """Register torch-side bookkeeping for an EXISTING eager handle
+        (one whose dispatch may be deferred -- see eager.allreduce_async);
+        synchronize() resolves it through the eager table."""
+        self._entries[h] = (None, like, inplace, assemble)
+        return h
+
     def mark_inplace(self, h: int) -> None:
         out, like, _, assemble = self._entries[h]
         self._entries[h] = (out, like, True, assemble)
 
     def synchronize(self, h: int) -> "torch.Tensor | List[torch.Tensor]":
-        out, like, inplace, assemble = self._entries.pop(h)
+        out, like, inplace, assemble = self._entries[h]
+        # Resolve the eager side BEFORE dropping the torch entry: a
+        # deferred-flush error raises here, and the caller's retry must
+        # see the original error, not a KeyError on a popped entry.
         result = _eager.synchronize(h)
+        del self._entries[h]
         if like is None and callable(out):  # custom (sparse) handle
             return out()
         if assemble is not None:

@@ -1,0 +1,63 @@
+"""Deviceless topology-AOT worker (spawned by test_scaling.py).
+
+Compiles a tiny shard_map program (one matmul + one psum + one ppermute)
+against a real TPU topology via ``jax.experimental.topologies`` -- no TPU
+attached -- and prints one JSON line describing the compiled SCHEDULE.
+This is the CI gate for the round-4 evidence mechanism: if the toolchain
+stops emitting scheduled modules, async collective-permute pairs, or
+sync all-reduces, this worker's output changes and the test fails,
+instead of docs/benchmarks.md silently rotting.
+
+Must run in its own process: the TPU compiler takes a host-wide libtpu
+lock, and the test process itself is pinned to the CPU backend.
+"""
+
+import json
+import sys
+from os.path import abspath, dirname
+
+sys.path.insert(0, dirname(dirname(abspath(__file__))))
+
+
+def main(topology: str) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.utils import scaling
+
+    td = topologies.get_topology_desc(platform="tpu",
+                                      topology_name=topology)
+    devs = list(td.devices)
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs), ("d",))
+
+    def f(x, w):
+        y = x @ w
+        g = lax.psum(y, "d")
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        z = lax.ppermute(y, "d", perm)
+        return g + z
+
+    fs = jax.jit(jax.shard_map(f, mesh=mesh,
+                               in_specs=(P("d"), P()), out_specs=P("d")))
+    x = jax.ShapeDtypeStruct((n * 128, 256), jnp.float32)
+    w = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    text = fs.lower(x, w).compile().as_text()
+    rep = scaling.schedule_overlap_report(text, n_devices=n)
+    print(json.dumps({
+        "is_scheduled": "is_scheduled=true" in text,
+        "n": n,
+        "sync_ops": sorted({o for o, _, _ in rep.sync_collectives}),
+        "async_ops": sorted({o for o, _, _, _ in rep.async_collectives}),
+        "n_async": len(rep.async_collectives),
+        "async_eq_payload": rep.async_eq_payload(),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "v5e:2x4"))

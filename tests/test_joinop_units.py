@@ -69,6 +69,74 @@ def test_replay_knows_every_compression_codec(hvd, compression):
                     "post": 1.0, "compression": compression})
 
 
+def test_deferred_async_flush_order_and_results(hvd):
+    """Deferred async dispatch (round-5): ops enqueue in issue order,
+    flush at synchronize() runs ALL of them (one presence round in
+    multi-process mode; a passthrough here), later handles resolve
+    without re-flushing."""
+    from horovod_tpu.collectives import eager
+
+    calls = []
+
+    def mk(i):
+        def thunk():
+            calls.append(i)
+            return np.full((2,), i, np.float32)
+        return thunk
+
+    h1, h2, h3 = eager._defer(mk(1)), eager._defer(mk(2)), eager._defer(mk(3))
+    assert eager.deferred_count() == 3
+    out2 = eager.synchronize(h2)          # flushes the whole batch
+    assert calls == [1, 2, 3]
+    assert eager.deferred_count() == 0
+    np.testing.assert_array_equal(out2, np.full((2,), 2, np.float32))
+    np.testing.assert_array_equal(eager.synchronize(h1),
+                                  np.full((2,), 1, np.float32))
+    assert eager.poll(h3) is True
+    np.testing.assert_array_equal(eager.synchronize(h3),
+                                  np.full((2,), 3, np.float32))
+
+
+def test_deferred_async_error_reaches_every_handle(hvd):
+    """A failing deferred op raises at the flush trigger AND from every
+    undispatched handle's synchronize (their slots were never issued)."""
+    from horovod_tpu.collectives import eager
+
+    def boom():
+        raise ValueError("deferred boom")
+
+    h1 = eager._defer(boom)
+    h2 = eager._defer(lambda: np.ones((2,)))
+    with pytest.raises(ValueError, match="deferred boom"):
+        eager.synchronize(h2)             # trigger: flush raises
+    with pytest.raises(ValueError, match="deferred boom"):
+        eager.synchronize(h1)
+    with pytest.raises(ValueError, match="deferred boom"):
+        eager.synchronize(h2)             # its slot never dispatched
+
+
+def test_deferred_dropped_on_shutdown(hvd):
+    from horovod_tpu.collectives import eager
+
+    eager._defer(lambda: np.ones((1,)))
+    assert eager.deferred_count() == 1
+    eager.reset_fences()                  # shutdown path
+    assert eager.deferred_count() == 0
+
+
+def test_allreduce_async_immediate_in_single_process(hvd):
+    """Without the presence protocol (single process) *_async dispatches
+    immediately -- nothing sits in the deferred queue."""
+    from horovod_tpu.collectives import eager
+    import horovod_tpu as hv
+
+    x = hv.replicated_stack(np.ones((4,), np.float32))
+    h = hv.allreduce_async(x, hv.Sum)
+    assert eager.deferred_count() == 0
+    out = hv.synchronize(h)
+    np.testing.assert_allclose(eager.one_row(out), np.full((4,), hv.size()))
+
+
 class _FakeKV:
     """Dict-backed stand-in for the coordination-service client."""
 

@@ -132,6 +132,30 @@ def test_schedule_overlap_report_parses_scheduled_tpu_module():
     assert pts4[0].eff_full_overlap <= pts[0].eff_full_overlap + 1e-12
 
 
+def test_topology_aot_schedule_smoke():
+    """CI gate for the round-4 evidence mechanism (deviceless AOT against
+    the real TPU compiler): a tiny shard_map program compiled for v5e:2x4
+    must come back as a SCHEDULED module with the capability matrix
+    docs/benchmarks.md relies on -- collective-permute async
+    (start/done pair), all-reduce synchronous.  Toolchain drift that
+    changes any of this fails here instead of silently invalidating the
+    scaling projections.  Runs in a subprocess (host-wide libtpu lock;
+    this process is pinned to CPU)."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "_topology_worker.py"),
+         "v5e:2x4"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["is_scheduled"] is True
+    assert out["n"] == 8
+    assert out["async_ops"] == ["collective-permute"] and out["n_async"] >= 1
+    assert out["sync_ops"] == ["all-reduce"]
+    assert out["async_eq_payload"] > 0
+
+
 def test_optimized_stats_counts_and_bytes():
     st = scaling.optimized_collective_stats(_HLO_SAMPLE)
     assert st.counts == {"all-reduce": 2, "all-gather": 1,
