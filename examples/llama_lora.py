@@ -4,7 +4,12 @@ BASELINE.json config: "Llama-3 8B LoRA fine-tune (large bf16 allreduce,
 tensor-fusion stress)".  Only the rank-r adapters train (frozen base via
 ``optax.multi_transform``), but the gradient pytree still spans every
 projection -- exactly the many-small-tensors pattern the fusion buffer
-exists for.  ``--8b`` selects the real Llama-3 8B architecture.
+exists for.  ``--8b`` selects the real Llama-3 8B architecture with the
+frozen base quantized to int8 (one f32 scale per output channel): LoRA
+needs no base gradients or master weights, so ~8 GB of int8 base + bf16
+activations (remat) + full-precision adapters/optimizer fits a single
+16 GB v5e chip.  The adapter gradients (hundreds of small tensors across
+every projection) still ride the fused allreduce.
 
 Run::
 
@@ -44,45 +49,73 @@ def main():
     import optax
     import horovod_tpu as hvd
     from horovod_tpu.models import (LLAMA3_8B, LLAMA_1B, LLAMA_TINY,
-                                    LlamaLM, lora_mask)
+                                    LlamaLM, lora_mask, merge_frozen,
+                                    split_frozen)
 
     hvd.init()
     cfg = LLAMA3_8B if args.full else (
         LLAMA_1B if args.mid else LLAMA_TINY)
     dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" \
         else jnp.float32
+    # The 8B runs with an int8 frozen base (+ remat): the only layout
+    # that fits 16 GB HBM.  Smaller configs keep the f32 base so the
+    # full-tree fusion path stays exercised.
+    base_dtype = "int8" if args.full else None
     model = LlamaLM(cfg, dtype=dtype, lora_rank=args.rank,
-                    remat=args.remat)
+                    remat=args.remat or args.full, base_dtype=base_dtype)
     batch = args.batch_size or 2 * hvd.size()
     seq = min(args.seq_len, cfg.max_seq_len)
 
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
-    params = model.init(jax.random.PRNGKey(0), tokens[:1])
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), tokens[:1])
     mask = lora_mask(params)
     if hvd.rank() == 0:
         n = sum(x.size for x in jax.tree.leaves(params))
         n_lora = sum(x.size for x, m in zip(
             jax.tree.leaves(params), jax.tree.leaves(mask)) if m)
         print(f"devices={hvd.size()} params={n/1e6:.1f}M "
-              f"trainable(LoRA)={n_lora/1e3:.1f}K batch={batch} seq={seq}")
+              f"trainable(LoRA)={n_lora/1e3:.1f}K batch={batch} seq={seq} "
+              f"base={base_dtype or 'f32'}")
 
-    # bf16 wire compression + frozen base: the allreduce still carries the
-    # full adapter set (hundreds of small tensors), stressing fusion.
-    inner = optax.multi_transform(
-        {"lora": optax.adamw(args.lr), "frozen": optax.set_to_zero()},
-        jax.tree.map(lambda m: "lora" if m else "frozen", mask))
-    opt = hvd.DistributedOptimizer(inner, compression=hvd.Compression.bf16)
-    params = hvd.replicate(params)
-    opt_state = opt.init(params)
+    data = hvd.shard_batch(tokens)
 
-    def loss_fn(p, toks):
-        logits = model.apply(p, toks)
+    def xent(logits, toks):
         return optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], toks[:, 1:]).mean()
 
-    step = hvd.make_train_step(loss_fn, opt)
-    data = hvd.shard_batch(tokens)
+    if base_dtype == "int8":
+        # Grads/optimizer/allreduce span ONLY the adapters; the int8 base
+        # rides as a replicated, non-donated, never-differentiated arg.
+        trainable, frozen = split_frozen(params, mask)
+        opt = hvd.DistributedOptimizer(optax.adamw(args.lr),
+                                       compression=hvd.Compression.bf16)
+        trainable = hvd.replicate(trainable)
+        frozen = hvd.replicate(frozen)
+        opt_state = opt.init(trainable)
+
+        def loss_fn(tp, fz, toks):
+            return xent(model.apply(merge_frozen(tp, fz), toks), toks)
+
+        full_step = hvd.make_train_step(loss_fn, opt, with_frozen=True)
+        step = lambda p, o, d: full_step(p, o, d, frozen)  # noqa: E731
+        params, opt_state = trainable, opt_state
+    else:
+        # bf16 wire compression + frozen base: the allreduce still
+        # carries the full adapter set (hundreds of small tensors),
+        # stressing fusion.
+        inner = optax.multi_transform(
+            {"lora": optax.adamw(args.lr), "frozen": optax.set_to_zero()},
+            jax.tree.map(lambda m: "lora" if m else "frozen", mask))
+        opt = hvd.DistributedOptimizer(inner,
+                                       compression=hvd.Compression.bf16)
+        params = hvd.replicate(params)
+        opt_state = opt.init(params)
+
+        def loss_fn(p, toks):
+            return xent(model.apply(p, toks), toks)
+
+        step = hvd.make_train_step(loss_fn, opt)
 
     timed_training(step, params, opt_state, data, args.steps, hvd.rank(),
                    items_per_step=batch)

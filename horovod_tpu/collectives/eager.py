@@ -255,8 +255,27 @@ def _alloc_handle(value) -> int:
 
 
 def synchronize(handle: int):
-    """Block until the async op completes and return its result."""
-    flush_deferred()
+    """Block until the async op completes and return its result.
+
+    A deferred op whose flush failed raises its error here, ONCE -- the
+    entry is consumed either way (retrying a consumed handle is a
+    KeyError, matching an unknown handle).
+    """
+    try:
+        flush_deferred()
+    except BaseException:
+        # The flush error was written into every affected handle; deliver
+        # THIS handle's outcome (its op may have dispatched fine before a
+        # later op failed).  A handle the failed flush never touched
+        # propagates the flush error itself.
+        with _handle_lock:
+            value = _handles.pop(handle, _PENDING)
+        if value is _PENDING:
+            raise
+        if isinstance(value, BaseException):
+            raise value
+        with _stall.watched(f"synchronize(handle={handle})"):
+            return jax.block_until_ready(value)
     with _handle_lock:
         value = _handles.pop(handle)
     if isinstance(value, BaseException):
@@ -271,11 +290,15 @@ def poll(handle: int) -> bool:
     Polling a still-deferred op dispatches the pending batch first (the
     reference's PollHandle likewise guarantees progress -- a caller
     spinning on poll() must not livelock on an op that was never
-    submitted to the cycle)."""
+    submitted to the cycle).  A flush failure reports True: the error is
+    stored in the handle and raises at synchronize()."""
     with _handle_lock:
         pending = _handles.get(handle) is _PENDING
     if pending:
-        flush_deferred()
+        try:
+            flush_deferred()
+        except BaseException:  # noqa: BLE001 - delivered via synchronize
+            return True
     with _handle_lock:
         value = _handles.get(handle)
     if value is None:
@@ -318,7 +341,14 @@ _deferred_lock = threading.Lock()
 _deferred: List[tuple] = []          # (handle, thunk) in issue order
 _MAX_DEFERRED = 512                  # capacity flush (deterministic: count)
 _flush_lock = threading.RLock()      # serializes flushes across threads
-_flushing = False                    # True only while _flush_lock is held
+_flush_tls = threading.local()       # .active: THIS thread is mid-flush
+
+
+def _in_flush() -> bool:
+    """True on the thread currently executing flush_deferred's dispatch
+    loop.  Must be thread-local: a CONCURRENT thread's collective is not
+    reentrant -- it must block on the flush lock, not skip the flush."""
+    return getattr(_flush_tls, "active", False)
 
 
 def _defer(thunk) -> int:
@@ -352,14 +382,15 @@ def flush_deferred() -> None:
     """Dispatch every deferred async op behind ONE presence round.
 
     Serialized under an RLock: a REENTRANT call (a thunk's own dispatch
-    re-entering via ``_join_sync``/``joinop.flush``) sees ``_flushing``
-    and returns; a CONCURRENT thread's ``synchronize``/``poll`` blocks
-    here until the in-flight flush lands its results -- returning early
-    would let it pop the raw ``_PENDING`` sentinel as the op's value.
+    re-entering via ``_join_sync``/``joinop.flush`` on the flushing
+    thread) sees the thread-local flag and returns; a CONCURRENT thread's
+    ``synchronize``/``poll``/collective blocks here until the in-flight
+    flush lands its results -- returning early would let it pop the raw
+    ``_PENDING`` sentinel as the op's value, or corrupt the in-flight
+    joinop flush accounting.
     """
-    global _flushing
     with _flush_lock:
-        if _flushing:
+        if _in_flush():
             return
         with _deferred_lock:
             pending = list(_deferred)
@@ -367,9 +398,9 @@ def flush_deferred() -> None:
         if not pending:
             return
         from . import joinop as _join
-        ps = _ps.get_process_set(None)
-        _flushing = True
+        _flush_tls.active = True
         try:
+            ps = _ps.get_process_set(None)
             with _join.flush(ps, len(pending)):
                 err = None
                 for h, thunk in pending:
@@ -389,8 +420,18 @@ def flush_deferred() -> None:
                             _handles[h] = value
                 if err is not None:
                     raise err
+        except BaseException as e:
+            # Context-entry failures (presence-round timeout, process-set
+            # lookup during shutdown) reach here before the loop ran:
+            # stamp the error into every handle still at the sentinel so
+            # no synchronize() can return _PENDING as a "result".
+            with _handle_lock:
+                for h, _ in pending:
+                    if _handles.get(h) is _PENDING:
+                        _handles[h] = e
+            raise
         finally:
-            _flushing = False
+            _flush_tls.active = False
 
 
 # ---------------------------------------------------------------------------
@@ -407,7 +448,7 @@ def _join_sync(ps, kind: str, x, name: Optional[str], extra: dict = None):
     ranks to replay.
     """
     from . import joinop as _join
-    if not _flushing:
+    if not _in_flush():
         # A sync collective is a flush point: pending deferred async ops
         # must dispatch first (program order; same point on every SPMD
         # process) so their presence round precedes this op's.
@@ -492,7 +533,7 @@ def allreduce_async(x, op: ReduceOp = Average, *, name=None, process_set=None,
                     compression=Compression.none) -> int:
     from . import joinop as _join
     ps_ = _ps.get_process_set(process_set)
-    if not _flushing and _join._applies(ps_):
+    if not _in_flush() and _join._applies(ps_):
         # Snapshot host inputs: the caller may mutate the buffer between
         # enqueue and flush (jax arrays are immutable; no copy needed).
         x_snap = x if isinstance(x, jax.Array) else np.array(x, copy=True)

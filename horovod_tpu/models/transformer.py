@@ -35,12 +35,44 @@ Dtype = Any
 # ---------------------------------------------------------------------------
 
 
+def quantize_int8(w, axis: int = 0):
+    """Per-channel symmetric int8 quantization of a 2D kernel.
+
+    ``axis`` is the reduction axis (scales live on the OTHER axis, one per
+    output channel for ``axis=0``).  Returns ``{"q": int8, "scale": f32}``
+    with ``w ~= q * scale``.
+    """
+    w32 = jnp.asarray(w, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(w32), axis=axis) / 127.0, 1e-12)
+    q = jnp.round(w32 / jnp.expand_dims(scale, axis)).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def _q8_init(inner):
+    """Param init producing an int8-quantized kernel pytree (flax params
+    can be arbitrary pytrees): sample the f32 init, quantize per output
+    channel.  Random-init benchmarking only -- trained checkpoints convert
+    via :func:`quantize_frozen_base`."""
+    def init(key, shape):
+        return quantize_int8(inner(key, shape, jnp.float32))
+    return init
+
+
 class Dense(nn.Module):
     """Linear layer with optional fused LoRA adapter.
 
     Base kernel is float32 (master weights), compute in ``dtype``.  With
     ``lora_rank > 0`` adds ``x @ A @ B * (alpha/r)``; A is Gaussian, B is
     zero-init so the adapter starts as identity (standard LoRA init).
+
+    ``base_dtype="int8"`` stores the FROZEN base kernel as int8 with one
+    f32 scale per output channel (a single pytree param ``kernel_q8``):
+    ``y = (x @ q) * scale`` -- XLA fuses the int8->bf16 convert into the
+    matmul operand load, so the bf16 kernel is never materialized in HBM.
+    This quarters base-weight HBM vs f32 master weights, which is what
+    lets Llama-3 8B LoRA fit a single 16 GB chip: LoRA training needs no
+    base grads or master weights, so the base can live at int8 while the
+    adapters keep full precision.
     """
 
     features: int
@@ -49,13 +81,22 @@ class Dense(nn.Module):
     lora_rank: int = 0
     lora_alpha: float = 16.0
     kernel_init: Any = nn.initializers.lecun_normal()
+    base_dtype: Optional[str] = None  # None (f32 master) or "int8"
 
     @nn.compact
     def __call__(self, x):
         in_features = x.shape[-1]
-        kernel = self.param("kernel", self.kernel_init,
-                            (in_features, self.features), jnp.float32)
-        y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        if self.base_dtype == "int8":
+            p = self.param("kernel_q8", _q8_init(self.kernel_init),
+                           (in_features, self.features))
+            y = ((x.astype(self.dtype) @ p["q"].astype(self.dtype))
+                 * p["scale"].astype(self.dtype))
+        elif self.base_dtype is None:
+            kernel = self.param("kernel", self.kernel_init,
+                                (in_features, self.features), jnp.float32)
+            y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        else:
+            raise ValueError(f"unsupported base_dtype {self.base_dtype!r}")
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,
                               (self.features,), jnp.float32)
@@ -106,10 +147,12 @@ class CausalSelfAttention(nn.Module):
     dtype: Dtype = jnp.bfloat16
     rope_theta: float = 500000.0
     lora_rank: int = 0
+    base_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
-        dense = partial(Dense, dtype=self.dtype, lora_rank=self.lora_rank)
+        dense = partial(Dense, dtype=self.dtype, lora_rank=self.lora_rank,
+                        base_dtype=self.base_dtype)
         b, t, _ = x.shape
         q = dense(self.num_heads * self.head_dim, name="wq")(x)
         k = dense(self.num_kv_heads * self.head_dim, name="wk")(x)
@@ -130,10 +173,12 @@ class SwiGLU(nn.Module):
     hidden: int
     dtype: Dtype = jnp.bfloat16
     lora_rank: int = 0
+    base_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
-        dense = partial(Dense, dtype=self.dtype, lora_rank=self.lora_rank)
+        dense = partial(Dense, dtype=self.dtype, lora_rank=self.lora_rank,
+                        base_dtype=self.base_dtype)
         gate = dense(self.hidden, name="w_gate")(x)
         up = dense(self.hidden, name="w_up")(x)
         return dense(x.shape[-1], name="w_down")(nn.silu(gate) * up)
@@ -147,6 +192,7 @@ class DecoderBlock(nn.Module):
     dtype: Dtype = jnp.bfloat16
     rope_theta: float = 500000.0
     lora_rank: int = 0
+    base_dtype: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
@@ -154,11 +200,12 @@ class DecoderBlock(nn.Module):
         x = x + CausalSelfAttention(
             self.num_heads, self.num_kv_heads, self.head_dim,
             dtype=self.dtype, rope_theta=self.rope_theta,
-            lora_rank=self.lora_rank, name="attn")(h, positions,
-                                                   segment_ids)
+            lora_rank=self.lora_rank, base_dtype=self.base_dtype,
+            name="attn")(h, positions, segment_ids)
         h = RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         x = x + SwiGLU(self.ffn_hidden, dtype=self.dtype,
-                       lora_rank=self.lora_rank, name="mlp")(h)
+                       lora_rank=self.lora_rank, base_dtype=self.base_dtype,
+                       name="mlp")(h)
         return x
 
 
@@ -206,6 +253,7 @@ class LlamaLM(nn.Module):
     dtype: Dtype = jnp.bfloat16
     lora_rank: int = 0
     remat: bool = False
+    base_dtype: Optional[str] = None  # "int8": frozen base at int8+scales
 
     @nn.compact
     def __call__(self, tokens, positions=None, *, segment_ids=None):
@@ -226,19 +274,39 @@ class LlamaLM(nn.Module):
             else:
                 positions = jnp.broadcast_to(
                     jnp.arange(tokens.shape[1]), tokens.shape)
-        emb = self.param("tok_embed", nn.initializers.normal(stddev=0.02),
-                         (cfg.vocab_size, cfg.d_model), jnp.float32)
-        x = emb[tokens].astype(self.dtype)
+        if self.base_dtype == "int8":
+            # Tied embedding at int8 (one f32 scale per d_model channel):
+            # the gather dequantizes per row; the readout folds the scale
+            # into x so the [V, D] int8 table is the only big operand.
+            p = self.param("tok_embed_q8",
+                           _q8_init(nn.initializers.normal(stddev=0.02)),
+                           (cfg.vocab_size, cfg.d_model))
+            x = (p["q"][tokens].astype(self.dtype)
+                 * p["scale"].astype(self.dtype))
+            # Fold the channel scales into h; the big [V, D] operand stays
+            # int8 in HBM (converted per-tile inside the matmul).  The
+            # matmul runs in compute dtype (f32 accumulation on the MXU),
+            # cast up for the softmax.
+            readout = lambda h: (  # noqa: E731
+                (h * p["scale"]).astype(self.dtype)
+                @ p["q"].astype(self.dtype).T).astype(jnp.float32)
+        else:
+            emb = self.param("tok_embed",
+                             nn.initializers.normal(stddev=0.02),
+                             (cfg.vocab_size, cfg.d_model), jnp.float32)
+            x = emb[tokens].astype(self.dtype)
+            readout = lambda h: h @ emb.T  # noqa: E731
         block_cls = nn.remat(DecoderBlock) if self.remat else DecoderBlock
         for i in range(cfg.num_layers):
             x = block_cls(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
                           cfg.ffn_hidden, dtype=self.dtype,
                           rope_theta=cfg.rope_theta,
                           lora_rank=self.lora_rank,
+                          base_dtype=self.base_dtype,
                           name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
         # Tied-embedding readout in f32 for stable softmax.
-        return x.astype(jnp.float32) @ emb.T
+        return readout(x.astype(jnp.float32))
 
 
 # ---------------------------------------------------------------------------
@@ -364,6 +432,60 @@ def lora_mask(params) -> Any:
 
     return jax.tree_util.tree_map_with_path(
         lambda p, _: is_lora(p), params)
+
+
+def split_frozen(params, mask=None):
+    """Split a params pytree into ``(trainable, frozen)`` by LoRA mask.
+
+    The trainable tree carries ONLY the adapter leaves, so gradients, the
+    fused allreduce, and optimizer state never touch the (possibly
+    multi-GB) frozen base -- pass both trees to a step built with
+    ``make_train_step(..., with_frozen=True)`` and recombine inside the
+    loss with :func:`merge_frozen`.
+    """
+    from flax import traverse_util
+
+    mask = lora_mask(params) if mask is None else mask
+    flat_p = traverse_util.flatten_dict(params)
+    flat_m = traverse_util.flatten_dict(mask)
+    train = {k: v for k, v in flat_p.items() if flat_m[k]}
+    frozen = {k: v for k, v in flat_p.items() if not flat_m[k]}
+    return (traverse_util.unflatten_dict(train),
+            traverse_util.unflatten_dict(frozen))
+
+
+def merge_frozen(trainable, frozen):
+    """Inverse of :func:`split_frozen` (valid inside jit: dict surgery
+    only)."""
+    from flax import traverse_util
+
+    flat = dict(traverse_util.flatten_dict(frozen))
+    flat.update(traverse_util.flatten_dict(trainable))
+    return traverse_util.unflatten_dict(flat)
+
+
+def quantize_frozen_base(params):
+    """Convert a trained f32-base LoRA params tree to the ``base_dtype=
+    "int8"`` layout: every non-LoRA Dense ``kernel`` becomes ``kernel_q8 =
+    {"q": int8, "scale": f32/channel}``, ``tok_embed`` becomes
+    ``tok_embed_q8``.  Biases, norm scales, and the LoRA adapters stay
+    full precision.  The result loads into a model built with
+    ``base_dtype="int8"``."""
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for k, v in tree.items():
+            if k == "kernel":
+                out["kernel_q8"] = quantize_int8(v)
+            elif k == "tok_embed":
+                out["tok_embed_q8"] = quantize_int8(v)
+            else:
+                out[k] = walk(v)
+        return out
+
+    return walk(params)
 
 
 def merge_lora(params, alpha: float = 16.0):

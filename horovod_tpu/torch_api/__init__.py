@@ -408,11 +408,15 @@ class _HandleTable:
 
     def synchronize(self, h: int) -> "torch.Tensor | List[torch.Tensor]":
         out, like, inplace, assemble = self._entries[h]
-        # Resolve the eager side BEFORE dropping the torch entry: a
-        # deferred-flush error raises here, and the caller's retry must
-        # see the original error, not a KeyError on a popped entry.
-        result = _eager.synchronize(h)
-        del self._entries[h]
+        # _eager.synchronize consumes the eager entry on success AND on a
+        # handle-bound (deferred-flush) error; drop the torch entry in
+        # lockstep so the tables never desynchronize -- a retry of a
+        # consumed handle is a KeyError on both sides, and the original
+        # error raised exactly once.
+        try:
+            result = _eager.synchronize(h)
+        finally:
+            self._entries.pop(h, None)
         if like is None and callable(out):  # custom (sparse) handle
             return out()
         if assemble is not None:

@@ -105,6 +105,7 @@ def make_train_step(
     donate: bool = True,
     loss_has_aux: bool = False,
     aux_mode: str = "stacked",
+    with_frozen: bool = False,
 ) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
 
@@ -120,18 +121,27 @@ def make_train_step(
     for mutated model state such as BatchNorm running statistics (the
     cross-device averaging mirrors the reference's SyncBatchNorm stats
     exchange, ``horovod/torch/sync_batch_norm.py``).
+
+    With ``with_frozen``, ``loss_fn(params, frozen, local_batch)`` and the
+    step takes a fourth argument: ``step(params, opt_state, batch,
+    frozen)``.  The frozen tree is replicated, NOT donated, and never
+    differentiated -- gradients, the fused allreduce, and optimizer state
+    span only ``params``.  This is the LoRA/adapter layout (e.g. an int8
+    frozen Llama base with trainable adapters, ``models.split_frozen``).
     """
     if aux_mode not in ("stacked", "averaged"):
         raise ValueError(f"unknown aux_mode {aux_mode!r}")
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
 
-    def local_step(params, opt_state, batch):
+    def local_step(params, opt_state, batch, *frozen):
+        lf = (lambda p, b: loss_fn(p, frozen[0], b)) if with_frozen \
+            else loss_fn
         if loss_has_aux:
             (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
+                lf, has_aux=True)(params, batch)
         else:
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss, grads = jax.value_and_grad(lf)(params, batch)
             aux = None
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -146,9 +156,10 @@ def make_train_step(
 
     aux_spec = () if not loss_has_aux else \
         ((P(),) if aux_mode == "averaged" else (P(axes),))
+    frozen_spec = (P(),) if with_frozen else ()
     shard = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(), P(axes)),
+        in_specs=(P(), P(), P(axes)) + frozen_spec,
         out_specs=(P(), P(), P()) + aux_spec,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
@@ -166,19 +177,19 @@ def make_train_step(
     compiled = {}
     grad_nbytes = [0]
 
-    def tuned_step(params, opt_state, batch):
+    def tuned_step(params, opt_state, batch, *rest):
         key = tuner.trace_key()  # every trace-time knob of this sample
         fn = compiled.get(key)
         if fn is None:
             fn = jax.jit(shard, donate_argnums=donate_argnums)
             compiled[key] = fn
         if tuner.done:
-            return fn(params, opt_state, batch)
+            return fn(params, opt_state, batch, *rest)
         if not grad_nbytes[0]:
             grad_nbytes[0] = sum(
                 x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
         t0 = _time.perf_counter()
-        out = fn(params, opt_state, batch)
+        out = fn(params, opt_state, batch, *rest)
         jax.block_until_ready(out[2])
         tuner.record_step(_time.perf_counter() - t0, grad_nbytes[0])
         return out
