@@ -261,23 +261,23 @@ def synchronize(handle: int):
     entry is consumed either way (retrying a consumed handle is a
     KeyError, matching an unknown handle).
     """
+    flush_error = None
     try:
         flush_deferred()
-    except BaseException:
+    except Exception as e:  # KeyboardInterrupt/SystemExit propagate
         # The flush error was written into every affected handle; deliver
         # THIS handle's outcome (its op may have dispatched fine before a
         # later op failed).  A handle the failed flush never touched
         # propagates the flush error itself.
+        flush_error = e
+    if flush_error is None:
+        with _handle_lock:
+            value = _handles.pop(handle)   # KeyError: unknown/consumed
+    else:
         with _handle_lock:
             value = _handles.pop(handle, _PENDING)
         if value is _PENDING:
-            raise
-        if isinstance(value, BaseException):
-            raise value
-        with _stall.watched(f"synchronize(handle={handle})"):
-            return jax.block_until_ready(value)
-    with _handle_lock:
-        value = _handles.pop(handle)
+            raise flush_error
     if isinstance(value, BaseException):
         raise value
     with _stall.watched(f"synchronize(handle={handle})"):
@@ -297,7 +297,7 @@ def poll(handle: int) -> bool:
     if pending:
         try:
             flush_deferred()
-        except BaseException:  # noqa: BLE001 - delivered via synchronize
+        except Exception:  # delivered via synchronize; interrupts raise
             return True
     with _handle_lock:
         value = _handles.get(handle)

@@ -141,11 +141,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def synchronize(self) -> None:
         """Drain outstanding allreduce handles (grads updated in place)."""
         for p, (kind, h) in list(self._pending.items()):
-            if kind == "native":
-                _batching.batcher().wait(h)
-            else:
-                _handles.synchronize(h)
-            del self._pending[p]
+            try:
+                if kind == "native":
+                    _batching.batcher().wait(h)
+                else:
+                    _handles.synchronize(h)
+            finally:
+                # Handles are consumed on error too (a deferred-flush
+                # failure raises once per handle); keeping the entry
+                # would make every later step() retry a dead handle and
+                # raise KeyError over the real error.
+                del self._pending[p]
 
     class _DisableSync:
         def __init__(self, opt):
