@@ -90,8 +90,9 @@ def main():
             optax.adamw(args.lr),
             compression=getattr(hvd.Compression, codec))
         # Donation consumes the params buffers (the benchmarked config);
-        # later codecs start from a fresh device copy.
-        p = jax.tree.map(jnp.copy, params) if len(codecs) > 1 else params
+        # copy only while another codec still needs the pristine tree.
+        p = jax.tree.map(jnp.copy, params) \
+            if codec is not codecs[-1] else params
         opt_state = opt.init(p)
         step = hvd.make_train_step(loss_fn, opt)
         timed_training(step, p, opt_state, data, args.steps,

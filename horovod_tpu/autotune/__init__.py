@@ -110,6 +110,12 @@ class Autotuner:
         self._step = 0
         self._accum_s = 0.0
         self._accum_bytes = 0
+        # Discard the first recorded step of every sample: a config
+        # switch retraces, and on the tunnelled chip that first step
+        # carries minutes of XLA compile -- folding it into the score
+        # would bury the signal (the reference's ParameterManager
+        # likewise scores warm cycles only).
+        self._skip_next = True
         self._warm_start()
         self._idx = self._next_index()
 
@@ -158,6 +164,9 @@ class Autotuner:
         """Report one training step's wall time and gradient bytes."""
         if self._best is not None:
             return
+        if self._skip_next:
+            self._skip_next = False  # compile/retrace step: not scored
+            return
         self._accum_s += seconds
         self._accum_bytes += nbytes
         self._step += 1
@@ -170,6 +179,7 @@ class Autotuner:
         self._accum_s = 0.0
         self._accum_bytes = 0
         self._idx = self._next_index()
+        self._skip_next = True
         self._apply_to_batcher()
 
     def _next_index(self) -> int:

@@ -164,33 +164,44 @@ def make_train_step(
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
+    return _maybe_tuned(shard, donate_argnums, loss_index=2)
+
+
+def _maybe_tuned(shard, donate_argnums, loss_index: int):
+    """jit the sharded step; under HOROVOD_AUTOTUNE=1 wrap it in the
+    ParameterManager score loop.
+
+    The fusion threshold is read at trace time, so each candidate needs
+    its own trace -- one compiled step per trace key, observed step time
+    fed back to the tuner (the reference's score loop, minus the
+    background thread).  The timing fence is a VALUE FETCH of the loss,
+    not ``block_until_ready``: on the tunnelled TPU the latter can return
+    before execution completes (measured; see bench.py) -- the fetch adds
+    a constant per-step latency that cancels in the per-config ranking.
+    """
     from .core.state import global_state
     tuner = global_state().autotuner
     if tuner is None:
         return jax.jit(shard, donate_argnums=donate_argnums)
 
-    # Autotune mode (HOROVOD_AUTOTUNE=1): the fusion threshold is read at
-    # trace time, so each candidate needs its own trace -- keep one
-    # compiled step per candidate and feed observed step time back to the
-    # tuner (ParameterManager's score loop, minus the background thread).
     import time as _time
     compiled = {}
     grad_nbytes = [0]
 
-    def tuned_step(params, opt_state, batch, *rest):
+    def tuned_step(params, *rest):
         key = tuner.trace_key()  # every trace-time knob of this sample
         fn = compiled.get(key)
         if fn is None:
             fn = jax.jit(shard, donate_argnums=donate_argnums)
             compiled[key] = fn
         if tuner.done:
-            return fn(params, opt_state, batch, *rest)
+            return fn(params, *rest)
         if not grad_nbytes[0]:
             grad_nbytes[0] = sum(
                 x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
         t0 = _time.perf_counter()
-        out = fn(params, opt_state, batch, *rest)
-        jax.block_until_ready(out[2])
+        out = fn(params, *rest)
+        float(jnp.asarray(out[loss_index]).ravel()[0])  # honest fence
         tuner.record_step(_time.perf_counter() - t0, grad_nbytes[0])
         return out
 
@@ -245,7 +256,8 @@ def make_flax_train_step(
                           out_specs=(P(), P(), P(), P()),
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
-    return jax.jit(shard, donate_argnums=donate_argnums)
+    # Autotune applies here too (HOROVOD_AUTOTUNE=1): loss is element 3.
+    return _maybe_tuned(shard, donate_argnums, loss_index=3)
 
 
 def _softmax_xent(logits, y):
