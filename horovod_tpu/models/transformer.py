@@ -230,8 +230,9 @@ class LlamaConfig:
 # Llama-3 8B architecture (public config: 32 layers, 32 heads / 8 KV heads,
 # d_model 4096, FFN 14336, vocab 128256, rope theta 5e5).
 LLAMA3_8B = LlamaConfig()
-# ~0.9B single-chip variant (the 8B needs >16 GB for f32 master weights
-# alone); same shape family, used for the single-chip LoRA benchmark.
+# ~0.9B single-chip variant; same shape family, used for the
+# comfortable single-chip LoRA benchmark.  (The full 8B also runs on a
+# 16 GB chip via base_dtype="int8" -- see docs/benchmarks.md.)
 LLAMA_1B = LlamaConfig(vocab_size=32000, num_layers=16, num_heads=16,
                        num_kv_heads=8, head_dim=128, d_model=2048,
                        ffn_hidden=5632, max_seq_len=4096)

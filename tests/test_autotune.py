@@ -241,6 +241,51 @@ def test_autotune_e2e_explores_hierarchical_axis(tmp_path, hvd):
         hv_mod.init()
 
 
+def test_autotune_e2e_flax_step(hvd):
+    """Round-5: the tuned wrapper also drives make_flax_train_step (the
+    RN50/CNN path used by the on-chip autotune demo) -- the tuner
+    consumes steps, explores, and locks; training still converges."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import flax.linen as nn
+    import horovod_tpu as hv_mod
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.training import make_flax_train_step
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return nn.Dense(4)(x)
+
+    st = global_state()
+    st.autotuner = Autotuner(Config(autotune=True), steps_per_sample=1,
+                             max_samples=4)
+    try:
+        model = Tiny()
+        x = jnp.ones((16, 6), jnp.float32)
+        y = jnp.zeros((16,), jnp.int32)
+        params = hv_mod.replicate(
+            model.init(jax.random.PRNGKey(0), x[:2])["params"])
+        opt = hv_mod.DistributedOptimizer(optax.sgd(0.1))
+        opt_state = hv_mod.replicate(opt.init(params))
+        step = make_flax_train_step(
+            lambda v, xx, train: model.apply(v, xx), opt)
+        batch = hv_mod.shard_batch((x, y))
+        losses, guard = [], 0
+        bs = {}
+        while not st.autotuner.done and guard < 40:
+            params, bs, opt_state, loss = step(params, bs, opt_state,
+                                               batch)
+            losses.append(float(loss))
+            guard += 1
+        assert st.autotuner.done
+        assert len(st.autotuner._samples) >= 4
+        assert losses[-1] < losses[0]
+    finally:
+        st.autotuner = None
+
+
 def test_autotuner_old_log_format_warm_starts(tmp_path):
     """Pre-round-3 3-column logs still warm-start (mapped to the
     hier=0/comp=default plane)."""

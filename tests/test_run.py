@@ -156,6 +156,9 @@ def test_join_drains_stragglers(np_):
     assert f"rank 0: join OK last={last}" in out.stdout
     assert f"rank {last}: allgatherv-during-join OK" in out.stdout
     assert f"rank {last}: grouped-during-join OK" in out.stdout
+    # Round-5 deferred async batch (3 ops, one presence round) issued
+    # while the other rank(s) are drained.
+    assert f"rank {last}: async-ungrouped-during-join OK" in out.stdout
     assert f"rank {last}: join2 OK last={last}" in out.stdout
 
 
