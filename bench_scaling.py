@@ -179,6 +179,44 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         # noise next to MiB-scale buckets).
         wire_itemsize = 1 if model.endswith("-fp8") else 2
         payload = sum(l.size * wire_itemsize for l in grad_leaves) + 4
+    elif model == "llama-lora":
+        # BASELINE config 4 STRUCTURE check (tiny shape; the 8B payload
+        # is pure arithmetic once the structure is proven): int8 frozen
+        # base + with_frozen step -- the wire must carry ONLY the LoRA
+        # adapters + loss.  A regression that leaks base grads (or the
+        # frozen tree) onto the wire breaks the payload equality below.
+        from horovod_tpu.models import (LLAMA_TINY, LlamaLM, merge_frozen,
+                                        split_frozen)
+        m = LlamaLM(LLAMA_TINY, dtype=jnp.float32, lora_rank=4,
+                    base_dtype="int8")
+        seq = 32
+        pcb = per_chip_batch or 1
+        toks = jax.ShapeDtypeStruct((pcb * n, seq), jnp.int32)
+        params = jax.eval_shape(
+            lambda k: m.init(k, jnp.zeros((1, seq), jnp.int32)),
+            jax.random.PRNGKey(0))
+        trainable, frozen = split_frozen(params)
+        # Compression.none: the virtual-CPU backend upcasts bf16
+        # reductions to f32, which would break the byte-exact equality
+        # this case exists for (the structure proof needs no codec; the
+        # production 8B config's bf16 wire just halves these bytes).
+        opt = hvd.DistributedOptimizer(optax.adamw(1e-3))
+        opt_state = jax.eval_shape(opt.init, trainable)
+
+        def loss_fn(tp, fz, t):
+            logits = m.apply(merge_frozen(tp, fz), t)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], t[:, 1:]).mean()
+
+        step = make_train_step(loss_fn, opt, with_frozen=True)
+        args = (abstract(trainable, rep), abstract(opt_state, rep),
+                jax.ShapeDtypeStruct(toks.shape, toks.dtype, sharding=bat),
+                abstract(frozen, rep))
+        grad_leaves = jax.tree.leaves(trainable)
+        buckets = len(plan_buckets(grad_leaves).buffers)
+        expected_emitted = buckets + 1  # adapter buckets + loss mean
+        # f32 adapters on the wire; the frozen tree must contribute 0.
+        payload = sum(l.size * l.dtype.itemsize for l in grad_leaves) + 4
     else:
         raise SystemExit(f"unknown model {model!r}")
     return step, args, {
