@@ -102,11 +102,20 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=sharding), tree)
 
-    if model in _CNN_CASES:
+    cnn_base = model[:-4] if model.endswith("-fp8") else model
+    if cnn_base in _CNN_CASES:
         from horovod_tpu import models as zoo
-        # fp32 params = the bench configuration's wire dtype (no
-        # compression on the CNN configs).
-        ctor, kwargs, side = _CNN_CASES[model]
+        # fp32 params = the bench configuration's wire dtype; the -fp8
+        # variant swaps the gradient exchange to the e4m3 codec
+        # (alltoall shards -> f32 local reduce -> all_gather), quartering
+        # the wire.  Measured (round 5, docs/benchmarks.md): on this
+        # toolchain the exchange's ops compile SYNCHRONOUS -- the win is
+        # wire volume, not overlap.  XLA may also lower a gather leg to
+        # an f32 all-reduce of the dequantized shards, inflating the eq
+        # payload ~20% over the pure-fp8 model below: run the topology
+        # gate for this variant with --tolerance 0.25.
+        fp8 = model.endswith("-fp8")
+        ctor, kwargs, side = _CNN_CASES[cnn_base]
         m = getattr(zoo, ctor)(num_classes=1000, dtype=jnp.float32,
                                **kwargs)
         pcb = per_chip_batch or 2
@@ -118,7 +127,10 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
             jax.random.PRNGKey(0))
         params = variables["params"]
         stats = variables.get("batch_stats", {})
-        opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(0.1, momentum=0.9),
+            compression=hvd.Compression.fp8 if fp8
+            else hvd.Compression.none)
         opt_state = jax.eval_shape(opt.init, params)
         step = make_flax_train_step(m.apply, opt)
         args = (abstract(params, rep), abstract(stats, rep),
@@ -130,8 +142,11 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         # Emitted all-reduces: one per gradient fusion bucket, one per
         # mutated BN-stat leaf, one for the loss mean.
         buckets = len(plan_buckets(grad_leaves).buffers)
-        expected_emitted = buckets + stats_leaves + 1
-        payload = sum(l.size * l.dtype.itemsize for l in grad_leaves) + \
+        expected_emitted = None if fp8 else buckets + stats_leaves + 1
+        grad_bytes = sum(l.size * l.dtype.itemsize for l in grad_leaves)
+        if fp8:
+            grad_bytes //= 4  # e4m3 wire (+ one f32 scale per bucket)
+        payload = grad_bytes + \
             sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(stats)) \
             + 4
     elif model in ("bert-large", "bert-base", "bert-tiny",
@@ -254,7 +269,7 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
         hvd.init(mesh=build_mesh(devs))
         # Compile at the bench per-chip batch so schedule weights match
         # the measured step (payloads themselves are batch-invariant).
-        pcb = {"rn50": 8, "bert-large": 32,
+        pcb = {"rn50": 8, "rn50-fp8": 8, "bert-large": 32,
                "bert-large-fp8": 32}.get(model, 0)
         step, args, expected = _build_case(model, n, per_chip_batch=pcb)
     else:
@@ -274,6 +289,7 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
             "sync": [(o, b) for o, b, _ in rep.sync_collectives],
             "async": [(o, b) for o, b, _, _ in rep.async_collectives],
             "sync_bytes": rep.sync_bytes,
+            "sync_eq_payload": rep.sync_eq_payload(),
             "async_bytes": rep.async_bytes,
             "async_eq_payload": rep.async_eq_payload(),
             "async_window_seconds": rep.async_window_seconds,
@@ -357,7 +373,8 @@ def run_topology_mode(args) -> int:
               f"inside windows: {sch['async_window_seconds']*1e3:.2f} ms")
         # Gate T1: the schedule accounts for the planner's payload
         # (equivalent-allreduce units on both sides).
-        eq_total = sch["sync_bytes"] + sch["async_eq_payload"]
+        eq_total = sch.get("sync_eq_payload",
+                           sch["sync_bytes"]) + sch["async_eq_payload"]
         drift = abs(eq_total - predicted) / predicted
         if drift > 2 * args.tolerance:
             ok = False

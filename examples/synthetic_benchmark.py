@@ -59,6 +59,9 @@ def main() -> int:
     ap.add_argument("--num-warmup", type=int, default=3)
     ap.add_argument("--fp32", action="store_true",
                     help="float32 compute instead of bfloat16")
+    ap.add_argument("--compression", default="none",
+                    choices=["none", "fp16", "bf16", "fp8"],
+                    help="gradient wire codec for the fused allreduce")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device XLA:CPU mesh (testing)")
     args = ap.parse_args()
@@ -89,7 +92,9 @@ def main() -> int:
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
 
-    opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9),
+        compression=getattr(hvd.Compression, args.compression))
     params = hvd.replicate(params)
     batch_stats = hvd.replicate(batch_stats)
     opt_state = hvd.replicate(opt.init(params))

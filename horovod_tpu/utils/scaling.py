@@ -216,22 +216,22 @@ class ScheduleReport:
     def async_bytes(self) -> int:
         return sum(b for _, b, _, _ in self.async_collectives)
 
-    def async_eq_payload(self) -> float:
-        """Async traffic as EQUIVALENT allreduce payload (the B in
-        2B(n-1)/n), so it can be projected to other mesh sizes with the
-        same ring law the sync accounting uses.  Per-op result-bytes
-        semantics differ: a ``collective-permute`` result is LINK bytes
-        (one hop), an ``all-gather`` result is the full gathered payload
-        (B_ag link bytes = B(n-1)/n, i.e. HALF an allreduce of the same
-        B).  Requires ``n_devices``."""
-        n = self.n_devices
+    @staticmethod
+    def _eq_payload(ops, n: int) -> float:
+        """Result bytes -> EQUIVALENT allreduce payload (the B in
+        2B(n-1)/n), so traffic can be projected to other mesh sizes with
+        the same ring law.  Per-op result-bytes semantics differ: a
+        ``collective-permute`` result is LINK bytes (one hop); an
+        ``all-gather``/``all-to-all`` result is the full payload B
+        (link B(n-1)/n = HALF an allreduce of the same B); a
+        ``reduce-scatter`` result is the B/n shard."""
         if n <= 1:
-            return float(self.async_bytes)
+            return float(sum(b for _, b in ops))
         ring = 2.0 * (n - 1) / n
         eq = 0.0
-        for op, b, _, _ in self.async_collectives:
+        for op, b in ops:
             if op in ("all-gather", "all-to-all"):
-                eq += b / 2.0      # result == full payload B; link B(n-1)/n
+                eq += b / 2.0
             elif op == "all-reduce":
                 eq += b            # result bytes == full payload == B
             elif op == "reduce-scatter":
@@ -239,6 +239,22 @@ class ScheduleReport:
             else:                  # permute: result bytes ARE link bytes
                 eq += b / ring
         return eq
+
+    def async_eq_payload(self) -> float:
+        """Async traffic as equivalent allreduce payload.  Requires
+        ``n_devices``."""
+        return self._eq_payload(
+            [(op, b) for op, b, _, _ in self.async_collectives],
+            self.n_devices)
+
+    def sync_eq_payload(self) -> float:
+        """Sync traffic as equivalent allreduce payload.  Identical to
+        ``sync_bytes`` when every sync collective is an all-reduce (the
+        usual case); differs once sync all-to-all / all-gather appear
+        (e.g. the fp8 exchange codec on a plain-DP config)."""
+        return self._eq_payload(
+            [(op, b) for op, b, _ in self.sync_collectives],
+            self.n_devices)
 
 
 def _entry_instructions(compiled_text: str):
@@ -441,7 +457,7 @@ def predict_efficiency_scheduled(step_seconds: float, report: ScheduleReport,
     """
     out = []
     for n in ns:
-        t_sync = allreduce_seconds(float(report.sync_bytes), n, chip)
+        t_sync = allreduce_seconds(report.sync_eq_payload(), n, chip)
         t_async = bandwidth_derate * allreduce_seconds(
             report.async_eq_payload(), n, chip)
         exposed = t_sync + max(0.0, t_async - report.async_window_seconds)
