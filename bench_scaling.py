@@ -46,9 +46,13 @@ MEASURED_STEP_SECONDS = {
     # reproduced round 5: fp16 354.2 same-process as the fp8 row below).
     "bert-large": 32 / 354.0,
     # MEASURED round 5 (one process, back-to-back with fp16's 354.2:
-    # bert_pretrain --compression fp16,fp8): the e4m3 exchange codec
-    # costs 0.14% single-chip -- the quantize/dequantize fuses into the
-    # VHDD permutes.  Replaces the round-4 _STEP_ALIASES borrow.
+    # bert_pretrain --compression fp16,fp8).  NB at n=1 the VHDD
+    # exchange degenerates, so this is the codec config's COMPUTE step
+    # time; the n>1 quantize/dequant cost was probed separately
+    # (1.15 ms / 80M elements isolated => <=8.5 ms/step upper bound
+    # for this payload's exchanges, overlapping like the exchanges --
+    # honest bracket in docs/benchmarks.md) and is NOT in this number.
+    # Replaces the round-4 _STEP_ALIASES borrow.
     "bert-large-fp8": 32 / 353.7,
     # The reference's OWN headline scaling table is Inception V3 /
     # ResNet-101 / VGG-16 at 128 GPUs (~90/90/68% of linear, SURVEY.md

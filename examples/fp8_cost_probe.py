@@ -1,0 +1,31 @@
+"""Differential-time fp8 quantize+dequantize of a BERT-bucket-sized
+payload on the chip: the REAL on-chip cost the fp8 wire codec adds at
+n>1 (at n=1 the VHDD exchange degenerates and no quantization runs)."""
+import sys
+from os.path import abspath as _abs, dirname as _dir
+sys.path.insert(0, _dir(_dir(_abs(__file__))))
+sys.path.insert(0, _dir(_abs(__file__)))
+
+import jax
+import jax.numpy as jnp
+from _harness import differential_bench, nonlinear_tap
+from horovod_tpu.collectives.compression import fp8_dequantize, fp8_quantize
+
+N = 80_000_000  # 305 MiB f32; scale results by ELEMENT count (a rank's
+# VHDD exchanges total ~588M elements/step for the BERT payload)
+x0 = jax.random.normal(jax.random.PRNGKey(0), (N,), jnp.float32)
+
+def make_body():
+    def body(carry, _):
+        q, s = fp8_quantize(carry)
+        y = fp8_dequantize(q, s, jnp.float32)
+        return nonlinear_tap(carry, y)
+    return body
+
+s, ok = differential_bench(make_body, x0, 4, k_spread=32)
+hbm = 819e9
+# quantize reads 4N writes N; dequant reads N writes 4N => ~10N bytes
+floor = 10 * N / hbm
+print(f"quant+dequant of {N*4/2**20:.0f} MiB f32: {s*1e3:.2f} ms "
+      f"(HBM floor {floor*1e3:.2f} ms, {s/floor:.2f}x)"
+      f"{'' if ok else ' (low signal)'}")
