@@ -40,10 +40,16 @@ WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
 # vs_baseline is a clean same-config regression ratio.
 BASELINE = 2542.27
 BASELINE_CONFIG = "batch256_s2d_bf16"
+# HOROVOD_ZERO=1 (or HVD_TPU_ZERO=1) benches the ZeRO-1 sharded-optimizer
+# path instead: bare SGD + zero_init state, reduce-scatter grads,
+# allgathered params.  Different config string -> vs_baseline emits null
+# (not comparable to the replicated baseline).
+ZERO = any(os.environ.get(v, "").strip().lower() in ("1", "true", "yes", "on")
+           for v in ("HVD_TPU_ZERO", "HOROVOD_ZERO"))
 
 
 def _config() -> str:
-    return f"batch{BATCH}_s2d_bf16"
+    return f"batch{BATCH}_s2d_bf16" + ("_zero1" if ZERO else "")
 FLOPS_PER_IMAGE = 12.3e9  # RN50 fwd+bwd estimate
 V5E_BF16_PEAK = 197e12
 
@@ -82,11 +88,28 @@ def main():
     variables = model.init(key, x[:2].astype(jnp.float32), train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
-    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
     params = hvd.replicate(params)
     batch_stats = hvd.replicate(batch_stats)
-    opt_state = hvd.replicate(opt.init(params))
-    step = make_flax_train_step(model.apply, opt)
+    zero_stats = None
+    if ZERO:
+        opt = optax.sgd(0.1, momentum=0.9)
+        opt_state = hvd.zero_init(opt, params)
+        step = make_flax_train_step(model.apply, opt, zero_stage=1)
+        zero_stats = hvd.zero_report(opt, params, n)
+        print("# zero1: "
+              f"RS {zero_stats['reducescatter_bytes_per_chip']/2**20:.1f} + "
+              f"AG {zero_stats['allgather_bytes_per_chip']/2**20:.1f} MiB/"
+              "step/chip exchanged (replicated allreduce: "
+              f"{zero_stats['replicated_allreduce_bytes_per_chip']/2**20:.1f}"
+              " MiB); opt-state HBM "
+              f"{zero_stats['opt_state_bytes_per_chip_zero1']/2**20:.1f} "
+              "MiB/chip vs "
+              f"{zero_stats['opt_state_bytes_per_chip_replicated']/2**20:.1f}"
+              " MiB replicated", file=sys.stderr)
+    else:
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+        opt_state = hvd.replicate(opt.init(params))
+        step = make_flax_train_step(model.apply, opt)
     batch = hvd.shard_batch((x, y))
 
     # Warmup (compile + cache + one warm window).  float() is a
@@ -126,14 +149,17 @@ def main():
     # vs_baseline is a same-config regression ratio; an env-overridden
     # config (BENCH_BATCH=...) would make it config drift, so emit null.
     same_config = _config() == BASELINE_CONFIG
-    print(json.dumps({
+    result = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/s/chip",
         "vs_baseline": round(ips / BASELINE, 4) if same_config else None,
         "config": _config(),
         "baseline_config": BASELINE_CONFIG,
-    }), flush=True)
+    }
+    if zero_stats is not None:
+        result["zero"] = zero_stats
+    print(json.dumps(result), flush=True)
     os._exit(0)  # skip slow atexit teardown; result is already printed
 
 

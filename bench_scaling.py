@@ -198,6 +198,47 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         # noise next to MiB-scale buckets).
         wire_itemsize = 1 if model.endswith("-fp8") else 2
         payload = sum(l.size * wire_itemsize for l in grad_leaves) + 4
+    elif model == "rn50-zero1":
+        # ZeRO-1 bench config (``--models rn50-zero1``; bench.py's
+        # counterpart is ``HOROVOD_ZERO=1``): bare SGD+momentum, gradients
+        # reduce-scattered over the per-dtype arenas, each chip updates
+        # its 1/n slice, params return via allgather.  Uncompressed
+        # RS+AG moves one ring allreduce of wire, so the equivalent-
+        # allreduce payload must match the replicated rn50 row while the
+        # momentum HBM is 1/n per chip.
+        from horovod_tpu import models as zoo
+        from horovod_tpu.optim import zero as zmod
+        m = zoo.ResNet50(num_classes=1000, dtype=jnp.float32)
+        side = 64
+        pcb = per_chip_batch or 2
+        x = jax.ShapeDtypeStruct((pcb * n, side, side, 3), jnp.float32)
+        y = jax.ShapeDtypeStruct((pcb * n,), jnp.int32)
+        variables = jax.eval_shape(
+            lambda k: m.init(k, jnp.zeros((1, side, side, 3),
+                                          jnp.float32), train=True),
+            jax.random.PRNGKey(0))
+        params = variables["params"]
+        stats = variables.get("batch_stats", {})
+        opt = optax.sgd(0.1, momentum=0.9)
+        grad_leaves = jax.tree.leaves(params)
+        spec = zmod.plan_arena(grad_leaves, n)
+        shards = [jax.ShapeDtypeStruct((b.shard,), b.dtype)
+                  for b in spec.buffers]
+        inner = jax.eval_shape(opt.init, shards)
+        zero_state = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((n,) + l.shape, l.dtype,
+                                           sharding=bat), inner)
+        step = make_flax_train_step(m.apply, opt, zero_stage=1)
+        args = (abstract(params, rep), abstract(stats, rep), zero_state,
+                (jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=bat),
+                 jax.ShapeDtypeStruct(y.shape, y.dtype, sharding=bat)))
+        buckets = len(spec.buffers)   # one RS + one AG per dtype arena
+        expected_emitted = None       # RS+AG exchange, not all-reduces
+        arena_bytes = sum(b.padded * jnp.dtype(b.dtype).itemsize
+                          for b in spec.buffers)
+        payload = arena_bytes + \
+            sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(stats)) \
+            + 4
     elif model == "llama-lora":
         # BASELINE config 4 STRUCTURE check (tiny shape; the 8B payload
         # is pure arithmetic once the structure is proven): int8 frozen

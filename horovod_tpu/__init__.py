@@ -16,6 +16,7 @@ Public API (mirrors ``import horovod.torch as hvd`` surface)::
     step = hvd.make_train_step(loss_fn, opt)
 """
 
+from .core import compat as _compat  # noqa: F401  (jax version shims)
 from .core.basics import (  # noqa: F401
     init, shutdown, is_initialized, mesh, reduce_axes,
     size, rank, local_size, local_rank, cross_size, cross_rank,
@@ -46,6 +47,9 @@ from .collectives.eager import (  # noqa: F401
 )
 from .optim.distributed import (  # noqa: F401
     DistributedOptimizer, DistributedAdasumOptimizer, allreduce_gradients,
+)
+from .optim.zero import (  # noqa: F401  (ZeRO-1 sharded optimizer state)
+    zero_init, zero_sharding, shard_zero_state, zero_report,
 )
 from .optim.functions import (  # noqa: F401
     allgather_object, broadcast_parameters, broadcast_optimizer_state,

@@ -30,7 +30,12 @@ knobs where a real choice survives under XLA:
   (:func:`~horovod_tpu.collectives.ops.hierarchical_allreduce`);
 * **compression codec** (OPT-IN via ``HOROVOD_AUTOTUNE_COMPRESSION=1``,
   because it changes wire numerics): configured default vs bf16 vs fp16
-  vs fp8 (e4m3 exchange-level codec, ``compression.py``).
+  vs fp8 (e4m3 exchange-level codec, ``compression.py``);
+* **ZeRO exchange** (OPT-IN via ``HOROVOD_AUTOTUNE_ZERO=1`` on a
+  ``HOROVOD_ZERO=1`` run): reduce-scatter + allgather vs allreduce
+  gradient exchange over the sharded arena (``optim/zero.py``) -- the
+  state layout is fixed at step build time, so the axis only opens when
+  the run is zero-configured.
 
 The response-cache toggle stays collapsed: an executable-cache hit is
 always strictly cheaper than a retrace, so there is nothing to search.
@@ -54,10 +59,10 @@ MAX_SAMPLES = 12
 COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8 = 0, 1, 2, 3
 
 
-def _grid(thresholds, cycles, hiers,
-          comps) -> List[Tuple[int, float, int, int]]:
-    return [(t, c, h, k) for t in thresholds for c in cycles
-            for h in hiers for k in comps]
+def _grid(thresholds, cycles, hiers, comps,
+          zeros) -> List[Tuple[int, float, int, int, int]]:
+    return [(t, c, h, k, z) for t in thresholds for c in cycles
+            for h in hiers for k in comps for z in zeros]
 
 
 def _mesh_is_two_level() -> bool:
@@ -97,14 +102,23 @@ class Autotuner:
         from ..core.config import _env_bool
         comps = [COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8] \
             if _env_bool("AUTOTUNE_COMPRESSION") else [COMP_DEFAULT]
+        # ZeRO exchange axis (opt-in, HOROVOD_AUTOTUNE_ZERO=1): only a
+        # zero-configured run can switch -- the sharded state layout is
+        # fixed at step build time, so the searchable pair is the
+        # reduce-scatter+allgather exchange (1) vs the allreduce exchange
+        # (0) over the same arena (optim/zero.py::_use_reducescatter).
+        configured_zero = 1 if getattr(config, "zero_stage", 0) else 0
+        self.tunes_zero = bool(_env_bool("AUTOTUNE_ZERO") and
+                               configured_zero)
+        zeros = [0, 1] if self.tunes_zero else [configured_zero]
         self.grid = _grid(sorted(self.candidates), sorted(cycles), hiers,
-                          comps)
+                          comps, zeros)
         self.steps_per_sample = steps_per_sample
         self.max_samples = min(max_samples, len(self.grid))
         self.log_path = config.autotune_log
         self._opt = BayesianOptimizer(
-            [(float(t), c, float(h), float(k))
-             for t, c, h, k in self.grid])
+            [(float(t), c, float(h), float(k), float(z))
+             for t, c, h, k, z in self.grid])
         self._samples: List[tuple] = []
         self._best: Optional[Tuple[int, float]] = None
         self._step = 0
@@ -120,7 +134,7 @@ class Autotuner:
         self._idx = self._next_index()
 
     # -- current knobs ----------------------------------------------------
-    def _current(self) -> Tuple[int, float, int, int]:
+    def _current(self) -> Tuple[int, float, int, int, int]:
         return self._best or self.grid[self._idx]
 
     def fusion_threshold(self) -> int:
@@ -146,14 +160,19 @@ class Autotuner:
             return Compression.fp8
         return configured
 
+    def zero_stage(self) -> int:
+        """The ZeRO exchange value of the current sample (0 = allreduce
+        exchange, 1 = reduce-scatter + allgather; optim/zero.py)."""
+        return int(self._current()[4])
+
     def trace_key(self) -> tuple:
         """The TRACE-TIME knobs of the current sample (the compiled step
         cache in ``training.make_train_step`` keys on this).  Cycle time
         is deliberately excluded: it is a RUNTIME knob applied through
         ``_apply_to_batcher``, and keying on it would recompile an
         identical trace for every cycle-axis sample."""
-        thr, _cyc, hier, comp = self._current()
-        return (thr, hier, comp)
+        thr, _cyc, hier, comp, zero = self._current()
+        return (thr, hier, comp, zero)
 
     @property
     def done(self) -> bool:
@@ -242,13 +261,19 @@ class Autotuner:
                         parts = line.strip().split(",")
                         if len(parts) == 3:     # pre-round-3 log format
                             cfg = (int(float(parts[0])), float(parts[1]),
-                                   0, COMP_DEFAULT)
+                                   0, COMP_DEFAULT, 0)
                             score = float(parts[2])
-                        elif len(parts) >= 5:
+                        elif len(parts) == 5:   # rounds 3-5: no zero axis
                             cfg = (int(float(parts[0])), float(parts[1]),
                                    int(float(parts[2])),
-                                   int(float(parts[3])))
+                                   int(float(parts[3])), 0)
                             score = float(parts[4])
+                        elif len(parts) >= 6:
+                            cfg = (int(float(parts[0])), float(parts[1]),
+                                   int(float(parts[2])),
+                                   int(float(parts[3])),
+                                   int(float(parts[4])))
+                            score = float(parts[5])
                         else:
                             continue
                         if cfg in self.grid:
@@ -268,8 +293,8 @@ class Autotuner:
             return
         with open(self.log_path, "w") as f:
             f.write("fusion_threshold_bytes,cycle_time_ms,hierarchical,"
-                    "compression,score_bytes_per_s\n")
-            for thr, cyc, hier, comp, score in self._samples:
-                f.write(f"{thr},{cyc},{hier},{comp},{score}\n")
+                    "compression,zero,score_bytes_per_s\n")
+            for thr, cyc, hier, comp, zero, score in self._samples:
+                f.write(f"{thr},{cyc},{hier},{comp},{zero},{score}\n")
             f.write(f"# best,{self._best[0]},{self._best[1]},"
-                    f"{self._best[2]},{self._best[3]}\n")
+                    f"{self._best[2]},{self._best[3]},{self._best[4]}\n")

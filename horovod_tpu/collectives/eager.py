@@ -245,6 +245,7 @@ _handle_counter = itertools.count(1)
 _handles: Dict[int, Any] = {}
 
 _PENDING = object()  # handle value: enqueued in _deferred, not yet dispatched
+_ABSENT = object()   # pop default: distinguishes "no such handle" from pending
 
 
 def _alloc_handle(value) -> int:
@@ -275,7 +276,12 @@ def synchronize(handle: int):
             value = _handles.pop(handle)   # KeyError: unknown/consumed
     else:
         with _handle_lock:
-            value = _handles.pop(handle, _PENDING)
+            value = _handles.pop(handle, _ABSENT)
+        if value is _ABSENT:
+            # Unknown/already-consumed handles stay a KeyError even when
+            # the flush failed: the flush error belongs to the ops it
+            # aborted, not to a caller retrying a spent handle.
+            raise KeyError(handle)
         if value is _PENDING:
             raise flush_error
     if isinstance(value, BaseException):
@@ -378,6 +384,21 @@ def reset_deferred() -> None:
             _handles.pop(h, None)
 
 
+def _deferred_error(handle: int, cause: BaseException,
+                    reason: str) -> RuntimeError:
+    """Fresh per-handle error for a failed flush.
+
+    Every affected handle gets its OWN exception object (chained to the
+    shared cause) -- raising one shared instance from several
+    ``synchronize()`` calls would accrete conflicting tracebacks and make
+    each raise look like a re-raise of the previous one.
+    """
+    err = RuntimeError(
+        f"deferred async op (handle {handle}) {reason}: {cause!r}")
+    err.__cause__ = cause
+    return err
+
+
 def flush_deferred() -> None:
     """Dispatch every deferred async op behind ONE presence round.
 
@@ -409,12 +430,16 @@ def flush_deferred() -> None:
                             value = thunk()
                         except BaseException as e:  # noqa: BLE001
                             err = e
-                            value = e
+                            value = _deferred_error(h, e,
+                                                    "failed during flush")
                     else:
                         # Ops after a failure never dispatch (the flush
                         # context publishes an abort for their slots);
-                        # their synchronize() re-raises the same error.
-                        value = err
+                        # their synchronize() raises a fresh error chained
+                        # to the op that sank the batch.
+                        value = _deferred_error(
+                            h, err, "aborted: an earlier op in the "
+                            "flushed batch failed")
                     with _handle_lock:
                         if h in _handles:
                             _handles[h] = value
@@ -428,7 +453,8 @@ def flush_deferred() -> None:
             with _handle_lock:
                 for h, _ in pending:
                     if _handles.get(h) is _PENDING:
-                        _handles[h] = e
+                        _handles[h] = _deferred_error(
+                            h, e, "aborted: flush failed before dispatch")
             raise
         finally:
             _flush_tls.active = False

@@ -1,0 +1,46 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current ``jax.shard_map`` API (top-level, with
+the ``check_vma`` flag).  Older toolchains (jax <= 0.4.x) ship the same
+functionality as ``jax.experimental.shard_map.shard_map`` with the flag
+spelled ``check_rep``.  Installing the adapter once at package import
+keeps every call site on the modern spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - no known jax lacks both
+        return
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # psum of a Python constant over a named axis is evaluated
+        # statically, yielding the axis size as a concrete int.
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = axis_size
+
+
+_install_shard_map()
+_install_axis_size()

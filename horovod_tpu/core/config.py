@@ -79,6 +79,10 @@ class Config:
     autotune: bool = False
     autotune_log: Optional[str] = None
 
+    # ZeRO-1 sharded optimizer state (HOROVOD_ZERO=1): default zero_stage
+    # for steps built without an explicit argument (optim/zero.py).
+    zero_stage: int = 0
+
     # Stall/heartbeat inspector for the launcher/elastic plane.
     stall_check_disable: bool = False
     stall_check_time: float = 60.0
@@ -207,6 +211,7 @@ def load_config() -> Config:
         timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES"),
         autotune=_env_bool("AUTOTUNE"),
         autotune_log=_env("AUTOTUNE_LOG"),
+        zero_stage=_env_int("ZERO", 0),
         stall_check_disable=_env_bool("STALL_CHECK_DISABLE"),
         # Upstream spells these *_TIME_SECONDS; accept both spellings.
         stall_check_time=_env_float(

@@ -161,6 +161,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         def update(grads, state, params=None, **extra):
             return optimizer.update(_reduce(grads), state, params, **extra)
 
+        # zero_stage=1 replaces this allreduce with a reduce-scatter; the
+        # zero path detects the wrap through this marker and rejects it.
+        update._hvd_allreduce = True
         return optax.GradientTransformation(init, update)
 
     n = backward_passes_per_step
@@ -190,6 +193,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
         return jax.lax.cond(is_sync, do_sync, skip, None)
 
+    update._hvd_allreduce = True
     return optax.GradientTransformation(init, update)
 
 

@@ -139,19 +139,28 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     # -- sync -------------------------------------------------------------
     def synchronize(self) -> None:
-        """Drain outstanding allreduce handles (grads updated in place)."""
+        """Drain outstanding allreduce handles (grads updated in place).
+
+        Drains EVERY pending handle even when one fails: aborting at the
+        first error would leave later params' handles pending forever
+        (their flush already consumed them), so every later ``step()``
+        would retry dead handles and raise KeyError over the real error.
+        The first error is re-raised once the table is empty.
+        """
+        first_error = None
         for p, (kind, h) in list(self._pending.items()):
             try:
                 if kind == "native":
                     _batching.batcher().wait(h)
                 else:
                     _handles.synchronize(h)
+            except Exception as e:
+                if first_error is None:
+                    first_error = e
             finally:
-                # Handles are consumed on error too (a deferred-flush
-                # failure raises once per handle); keeping the entry
-                # would make every later step() retry a dead handle and
-                # raise KeyError over the real error.
                 del self._pending[p]
+        if first_error is not None:
+            raise first_error
 
     class _DisableSync:
         def __init__(self, opt):

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .collectives import ops as _ops
 from .collectives.reduce_op import Average
 from .core import basics as _basics
+from .optim import zero as _zero
 
 
 def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
@@ -98,6 +99,17 @@ def sync_batch_norm(axes=None, **kwargs):
                         **kwargs)
 
 
+def _resolve_zero_stage(zero_stage: Optional[int]) -> int:
+    """``None`` defers to the configured default (``HOROVOD_ZERO``)."""
+    if zero_stage is None:
+        from .core.state import global_state
+        cfg = global_state().config
+        zero_stage = cfg.zero_stage if cfg is not None else 0
+    if zero_stage not in (0, 1):
+        raise ValueError(f"zero_stage must be 0 or 1, got {zero_stage!r}")
+    return zero_stage
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Any], jnp.ndarray],
     optimizer: optax.GradientTransformation,
@@ -106,6 +118,8 @@ def make_train_step(
     loss_has_aux: bool = False,
     aux_mode: str = "stacked",
     with_frozen: bool = False,
+    zero_stage: Optional[int] = None,
+    zero_compression=None,
 ) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
 
@@ -128,9 +142,21 @@ def make_train_step(
     differentiated -- gradients, the fused allreduce, and optimizer state
     span only ``params``.  This is the LoRA/adapter layout (e.g. an int8
     frozen Llama base with trainable adapters, ``models.split_frozen``).
+
+    With ``zero_stage=1`` (default from ``HOROVOD_ZERO``) the optimizer
+    state is sharded across the mesh (ZeRO-1,
+    :mod:`horovod_tpu.optim.zero`): gradients are reduce-scattered, each
+    chip updates its 1/n arena slice, and updated params ride an
+    allgather optionally compressed via ``zero_compression``
+    (``hvd.Compression.{fp16,bf16,fp8}``).  Pass the BARE optax optimizer
+    (no :func:`~horovod_tpu.DistributedOptimizer` wrap) and build
+    ``opt_state`` with :func:`horovod_tpu.zero_init`.
     """
     if aux_mode not in ("stacked", "averaged"):
         raise ValueError(f"unknown aux_mode {aux_mode!r}")
+    zero_stage = _resolve_zero_stage(zero_stage)
+    if zero_stage:
+        _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
 
@@ -143,8 +169,13 @@ def make_train_step(
         else:
             loss, grads = jax.value_and_grad(lf)(params, batch)
             aux = None
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if zero_stage:
+            params, opt_state = _zero.zero_apply(
+                optimizer, grads, opt_state, params, axes=axes,
+                compression=zero_compression)
+        else:
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         loss = _ops.allreduce(loss, Average, axes=axes)
         if loss_has_aux:
             if aux_mode == "averaged":
@@ -157,10 +188,11 @@ def make_train_step(
     aux_spec = () if not loss_has_aux else \
         ((P(),) if aux_mode == "averaged" else (P(axes),))
     frozen_spec = (P(),) if with_frozen else ()
+    opt_spec = P(axes) if zero_stage else P()
     shard = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(), P(axes)) + frozen_spec,
-        out_specs=(P(), P(), P()) + aux_spec,
+        in_specs=(P(), opt_spec, P(axes)) + frozen_spec,
+        out_specs=(P(), opt_spec, P()) + aux_spec,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
@@ -214,6 +246,8 @@ def make_flax_train_step(
     loss_fn: Optional[Callable] = None,
     mesh: Optional[Mesh] = None,
     donate: bool = True,
+    zero_stage: Optional[int] = None,
+    zero_compression=None,
 ):
     """Data-parallel train step for flax modules with mutable batch stats.
 
@@ -223,7 +257,14 @@ def make_flax_train_step(
     exchange); gradients flow through ``optimizer`` (wrap with
     :func:`DistributedOptimizer`).  ``loss_fn(logits, y)`` defaults to
     softmax cross-entropy with integer labels.
+
+    ``zero_stage=1`` shards the optimizer state as in
+    :func:`make_train_step` (bare optax optimizer +
+    :func:`horovod_tpu.zero_init` state); batch stats stay replicated.
     """
+    zero_stage = _resolve_zero_stage(zero_stage)
+    if zero_stage:
+        _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
     if loss_fn is None:
@@ -244,16 +285,22 @@ def make_flax_train_step(
             return loss_fn(logits, y), {}
 
         (loss, new_stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if zero_stage:
+            params, opt_state = _zero.zero_apply(
+                optimizer, grads, opt_state, params, axes=axes,
+                compression=zero_compression)
+        else:
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         new_stats = jax.tree.map(
             lambda v: _ops.allreduce(v, Average, axes=axes), new_stats)
         loss = _ops.allreduce(loss, Average, axes=axes)
         return params, new_stats, opt_state, loss
 
+    opt_spec = P(axes) if zero_stage else P()
     shard = jax.shard_map(local_step, mesh=mesh,
-                          in_specs=(P(), P(), P(), P(axes)),
-                          out_specs=(P(), P(), P(), P()),
+                          in_specs=(P(), P(), opt_spec, P(axes)),
+                          out_specs=(P(), P(), opt_spec, P()),
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
     # Autotune applies here too (HOROVOD_AUTOTUNE=1): loss is element 3.

@@ -59,6 +59,24 @@ def backend_initialized() -> bool:
         return False
 
 
+def multiprocess_cpu_supported() -> bool:
+    """Can this jax run MULTI-PROCESS computations on the CPU backend?
+
+    The ``run -np N --cpu`` localhost mode jits programs over a mesh that
+    spans several processes' CPU devices; jaxlib only implements the
+    cross-host CPU transfers this needs from the 0.5 line on (older
+    runtimes raise ``Multiprocess computations aren't implemented on the
+    CPU backend``).  Single-process virtual-device meshes
+    (``force_host_device_count``) work everywhere and are not gated by
+    this.
+    """
+    try:
+        import jax
+        return tuple(int(p) for p in jax.__version__.split(".")[:2]) >= (0, 5)
+    except Exception:
+        return False
+
+
 def force_host_device_count(n: int, cpu: bool = True,
                             exact: bool = False) -> None:
     """Arrange for an ``n``-device virtual CPU backend.

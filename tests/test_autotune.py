@@ -107,7 +107,7 @@ def test_autotuner_skips_cycle_axis_without_torch_shim(monkeypatch):
                         raising=False)
     monkeypatch.delitem(sys.modules, "horovod_tpu.torch", raising=False)
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
-    cycles = {c for _, c, _h, _k in t.grid}
+    cycles = {c for _, c, _h, _k, _z in t.grid}
     assert cycles == {Config().cycle_time}
 
 
@@ -116,7 +116,7 @@ def test_autotuner_tunes_cycle_axis_with_torch_shim(monkeypatch):
     monkeypatch.setitem(sys.modules, "horovod_tpu.torch_api",
                         sys.modules[__name__])  # any module object works
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert len({c for _, c, _h, _k in t.grid}) > 1
+    assert len({c for _, c, _h, _k, _z in t.grid}) > 1
 
 
 def test_autotuner_hierarchical_axis_requires_two_level_mesh(hvd):
@@ -127,14 +127,14 @@ def test_autotuner_hierarchical_axis_requires_two_level_mesh(hvd):
     from horovod_tpu.parallel.mesh import build_mesh
 
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert {h for _t, _c, h, _k in t.grid} == {0}
+    assert {h for _t, _c, h, _k, _z in t.grid} == {0}
 
     hv_mod.shutdown()
     mesh = build_mesh(jax.devices()[:8], hierarchical=True, dcn_size=2)
     hv_mod.init(mesh=mesh)
     try:
         t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
-        assert {h for _t, _c, h, _k in t2.grid} == {0, 1}
+        assert {h for _t, _c, h, _k, _z in t2.grid} == {0, 1}
     finally:
         hv_mod.shutdown()
         hv_mod.init()
@@ -144,12 +144,12 @@ def test_autotuner_compression_axis_is_opt_in(monkeypatch):
     from horovod_tpu.collectives.compression import Compression
 
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert {k for _t, _c, _h, k in t.grid} == {0}
+    assert {k for _t, _c, _h, k, _z in t.grid} == {0}
     assert t.compression_override(Compression.none) is Compression.none
 
     monkeypatch.setenv("HOROVOD_AUTOTUNE_COMPRESSION", "1")
     t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert {k for _t, _c, _h, k in t2.grid} == {0, 1, 2, 3}
+    assert {k for _t, _c, _h, k, _z in t2.grid} == {0, 1, 2, 3}
     # Force a sample on the bf16 / fp8 codecs and check the overrides
     # resolve.
     for want, codec in [(1, Compression.bf16), (3, Compression.fp8)]:
@@ -158,6 +158,41 @@ def test_autotuner_compression_axis_is_opt_in(monkeypatch):
                 t2._idx = i
                 break
         assert t2.compression_override(Compression.none) is codec
+
+
+def test_autotuner_zero_axis_is_opt_in(monkeypatch):
+    """The ZeRO exchange axis only opens on a zero-configured run with
+    HOROVOD_AUTOTUNE_ZERO=1; otherwise it is pinned to the configured
+    stage (the state layout is fixed at step-build time -- only the
+    exchange over the sharded arena is searchable)."""
+    t = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert not t.tunes_zero
+    assert {z for _t, _c, _h, _k, z in t.grid} == {0}
+
+    # Env alone is not enough: a replicated run has no zero exchange.
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_ZERO", "1")
+    t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert not t2.tunes_zero
+    assert {z for _t, _c, _h, _k, z in t2.grid} == {0}
+
+    # Zero-configured run without the env: pinned to 1.
+    monkeypatch.delenv("HOROVOD_AUTOTUNE_ZERO")
+    t3 = Autotuner(Config(autotune=True, zero_stage=1), steps_per_sample=1)
+    assert not t3.tunes_zero
+    assert {z for _t, _c, _h, _k, z in t3.grid} == {1}
+
+    # Both: the axis opens and the accessor tracks the current sample.
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_ZERO", "1")
+    t4 = Autotuner(Config(autotune=True, zero_stage=1), steps_per_sample=1)
+    assert t4.tunes_zero
+    assert {z for _t, _c, _h, _k, z in t4.grid} == {0, 1}
+    for want in (0, 1):
+        for i, cfg in enumerate(t4.grid):
+            if cfg[4] == want:
+                t4._idx = i
+                break
+        assert t4.zero_stage() == want
+        assert t4.trace_key()[3] == want
 
 
 def test_hierarchical_allreduce_matches_flat_psum(hvd):
@@ -232,7 +267,7 @@ def test_autotune_e2e_explores_hierarchical_axis(tmp_path, hvd):
             losses.append(float(loss))
             guard += 1
         assert st.autotuner.done
-        sampled_h = {h for _t, _c, h, _k, _s in st.autotuner._samples}
+        sampled_h = {h for _t, _c, h, _k, _z, _s in st.autotuner._samples}
         assert sampled_h == {0, 1}  # both algorithms really ran
         assert losses[-1] < losses[0]
     finally:
@@ -295,5 +330,5 @@ def test_autotuner_old_log_format_warm_starts(tmp_path):
     log.write_text("fusion_threshold_bytes,cycle_time_ms,score\n"
                    f"{thr},{Config().cycle_time},123.0\n")
     t = Autotuner(cfg, steps_per_sample=1)
-    assert (thr, Config().cycle_time, 0, 0, 123.0) in [
+    assert (thr, Config().cycle_time, 0, 0, 0, 123.0) in [
         tuple(s) for s in t._samples]

@@ -1,0 +1,87 @@
+"""Perf-regression tripwire over the committed bench artifacts.
+
+Fast guard (no mesh, no model): every ``BENCH_*.json`` entry carrying a
+``parsed.vs_baseline`` must stay at or above :data:`THRESHOLD` of the
+recorded baseline, unless ``CHANGES.md`` carries a ``REGRESSION_OK`` note
+acknowledging the regression on purpose.  Cross-config entries publish
+``vs_baseline: null`` (bench.py's ``same_config`` gate) and are exempt --
+a zero1 or different-batch run is not comparable to the baseline config.
+"""
+
+import glob
+import json
+import os
+
+THRESHOLD = 0.98
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scan_bench_results(bench_dir, changes_text):
+    """Return [(path, vs_baseline), ...] for entries below THRESHOLD
+    not covered by a REGRESSION_OK note."""
+    waived = "REGRESSION_OK" in changes_text
+    violations = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                violations.append((path, "unparseable"))
+                continue
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            vb = parsed.get("vs_baseline")
+            if vb is None:
+                continue  # different config: not comparable
+            if vb < THRESHOLD and not waived:
+                violations.append((path, vb))
+    return violations
+
+
+def _changes_text():
+    p = os.path.join(REPO, "CHANGES.md")
+    return open(p).read() if os.path.exists(p) else ""
+
+
+def test_committed_bench_results_hold_baseline():
+    bad = scan_bench_results(REPO, _changes_text())
+    assert not bad, (
+        f"bench entries regressed below {THRESHOLD} of baseline without a "
+        f"REGRESSION_OK note in CHANGES.md: {bad}")
+
+
+def _write(tmp_path, name, vs_baseline):
+    doc = {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "",
+           "parsed": {"metric": "img_per_s_per_chip", "value": 2500.0,
+                      "unit": "img/s", "vs_baseline": vs_baseline,
+                      "config": "batch256_s2d_bf16",
+                      "baseline_config": "batch256_s2d_bf16"}}
+    (tmp_path / name).write_text(json.dumps(doc))
+
+
+def test_guard_trips_on_synthetic_regression(tmp_path):
+    _write(tmp_path, "BENCH_r97.json", 1.001)
+    _write(tmp_path, "BENCH_r98.json", 0.93)
+    bad = scan_bench_results(str(tmp_path), "round notes, nothing waived")
+    assert bad == [(str(tmp_path / "BENCH_r98.json"), 0.93)]
+
+
+def test_guard_respects_regression_ok_note(tmp_path):
+    _write(tmp_path, "BENCH_r98.json", 0.93)
+    assert scan_bench_results(
+        str(tmp_path), "rN: slower but correct -- REGRESSION_OK") == []
+
+
+def test_guard_ignores_cross_config_entries(tmp_path):
+    # vs_baseline null: a different config (e.g. the zero1 bench) is not
+    # comparable to the baseline config and must not trip the guard.
+    _write(tmp_path, "BENCH_r99.json", None)
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_guard_flags_unparseable_artifacts(tmp_path):
+    (tmp_path / "BENCH_bad.json").write_text("{not json")
+    bad = scan_bench_results(str(tmp_path), "")
+    assert bad == [(str(tmp_path / "BENCH_bad.json"), "unparseable")]

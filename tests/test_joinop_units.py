@@ -99,8 +99,10 @@ def test_deferred_async_flush_order_and_results(hvd):
 
 def test_deferred_async_error_reaches_every_handle(hvd):
     """A failing deferred op raises from EVERY affected handle's
-    synchronize exactly once (entries consumed; a retry is a KeyError,
-    same as an unknown handle)."""
+    synchronize exactly once, each handle delivering its OWN fresh
+    RuntimeError chained to the original failure (entries consumed; a
+    retry is a KeyError, same as an unknown handle -- even when the
+    triggering flush failed)."""
     from horovod_tpu.collectives import eager
 
     def boom():
@@ -108,12 +110,35 @@ def test_deferred_async_error_reaches_every_handle(hvd):
 
     h1 = eager._defer(boom)
     h2 = eager._defer(lambda: np.ones((2,)))
-    with pytest.raises(ValueError, match="deferred boom"):
+    with pytest.raises(RuntimeError, match="aborted") as e2:
         eager.synchronize(h2)             # trigger: its slot never issued
-    with pytest.raises(ValueError, match="deferred boom"):
+    with pytest.raises(RuntimeError, match="failed during flush") as e1:
         eager.synchronize(h1)
+    # Distinct wrapper objects, one shared cause.
+    assert e1.value is not e2.value
+    assert isinstance(e1.value.__cause__, ValueError)
+    assert e1.value.__cause__ is e2.value.__cause__
+    assert "deferred boom" in str(e1.value.__cause__)
     with pytest.raises(KeyError):
         eager.synchronize(h2)             # consumed above
+
+
+def test_synchronize_unknown_handle_keyerror_despite_flush_failure(hvd):
+    """Round-6 fix: synchronize() of an unknown/consumed handle must
+    raise KeyError even when the flush it triggered failed -- the flush
+    error belongs to the deferred ops, not to a spent handle, and the
+    old code's pop-default (the _PENDING sentinel) masked the KeyError
+    behind the unrelated flush failure."""
+    from horovod_tpu.collectives import eager
+
+    def boom():
+        raise ValueError("deferred boom 2")
+
+    h = eager._defer(boom)
+    with pytest.raises(KeyError):
+        eager.synchronize(h + 1000)       # unknown handle, failing flush
+    with pytest.raises(RuntimeError, match="failed during flush"):
+        eager.synchronize(h)              # real handle still delivers
 
 
 def test_deferred_dropped_on_shutdown(hvd):

@@ -219,6 +219,28 @@ def test_zero_grad_with_pending_raises(hvd_t):
     opt.zero_grad()
 
 
+def test_synchronize_drains_all_handles_on_error(hvd_t):
+    """Round-6 fix: a failing handle must not abort the drain -- later
+    params' handles would stay pending forever (their flush already
+    consumed them) and every subsequent step() would KeyError over the
+    real failure.  synchronize() drains everything and re-raises the
+    first error once the table is empty."""
+    m = torch.nn.Linear(4, 2)
+    opt = thvd.DistributedOptimizer(
+        torch.optim.SGD(m.parameters(), lr=0.1),
+        named_parameters=m.named_parameters())
+    F.cross_entropy(m(torch.randn(4, 4)), torch.randint(0, 2, (4,))).backward()
+    assert opt._pending
+    # Corrupt the FIRST pending entry with a handle that raises; the
+    # healthy handles behind it must still be drained.
+    params = list(opt._pending)
+    opt._pending[params[0]] = ("eager", 10**9)   # unknown handle: KeyError
+    with pytest.raises(KeyError):
+        opt.synchronize()
+    assert not opt._pending                      # fully drained
+    opt.zero_grad()                              # no "pending" assertion
+
+
 def test_broadcast_parameters_state_dict(hvd_t):
     m = torch.nn.Linear(3, 3)
     before = {k: v.clone() for k, v in m.state_dict().items()}

@@ -1,0 +1,107 @@
+"""Multi-process zero1-vs-replicated parity worker.
+
+Launched by ``python -m horovod_tpu.run -np {2,4} --cpu`` from
+``tests/test_zero.py``: every process drives the same 5 steps through the
+replicated DistributedOptimizer step and the zero_stage=1 step (uneven,
+padded leaf sizes + a bf16 leaf + the LoRA ``with_frozen`` layout) and
+rank 0 prints ``ZERO PARITY OK`` when the parameters agree.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+
+_BASE = {
+    "w": np.random.RandomState(0).randn(4, 5).astype(np.float32),
+    "b": np.random.RandomState(1).randn(7).astype(np.float32),
+    "half": np.random.RandomState(2).randn(13).astype(np.float32),
+}
+
+
+def fresh():
+    return {"w": jnp.asarray(_BASE["w"]), "b": jnp.asarray(_BASE["b"]),
+            "half": jnp.asarray(_BASE["half"], jnp.bfloat16)}
+
+
+def host(x):
+    """Replicated global array -> this process's local copy."""
+    return np.asarray(jax.device_get(x.addressable_data(0)), np.float32)
+
+
+def loss_fn(p, batch):
+    x, y = batch
+    pred = ((x @ p["w"]).sum(-1) + p["b"].sum()
+            + p["half"].astype(jnp.float32).sum())
+    return jnp.mean((pred - y) ** 2)
+
+
+def frozen_loss_fn(p, fz, batch):
+    x, y = batch
+    return loss_fn(p, batch) + jnp.mean((x @ fz["base"]) * 0.1)
+
+
+def local_batch(step, world, rank, rows_per=4):
+    """Deterministic global batch; each process contributes its rows."""
+    rng = np.random.RandomState(1000 + step)
+    x = rng.randn(world * rows_per, 4).astype(np.float32)
+    y = rng.randn(world * rows_per).astype(np.float32)
+    sl = slice(rank * rows_per, (rank + 1) * rows_per)
+    return hvd.shard_batch_from_local((x[sl], y[sl]))
+
+
+def check_close(tag, a_tree, b_tree):
+    for k in a_tree:
+        a, b = host(a_tree[k]), host(b_tree[k])
+        atol = 5e-2 if a_tree[k].dtype == jnp.bfloat16 else 5e-5
+        np.testing.assert_allclose(a, b, atol=atol,
+                                   err_msg=f"{tag}:{k}")
+
+
+def main():
+    hvd.init()
+    world, rank = hvd.size(), hvd.rank()
+    opt = optax.adam(1e-2)
+
+    # --- plain layout ---
+    rep_step = hvd.make_train_step(loss_fn, hvd.DistributedOptimizer(opt))
+    rep_params, rep_state = fresh(), opt.init(fresh())
+    z_step = hvd.make_train_step(loss_fn, opt, zero_stage=1)
+    z_params = fresh()
+    z_state = hvd.zero_init(opt, z_params)
+    for i in range(5):
+        batch = local_batch(i, world, rank)
+        rep_params, rep_state, rl = rep_step(rep_params, rep_state, batch)
+        batch = local_batch(i, world, rank)
+        z_params, z_state, zl = z_step(z_params, z_state, batch)
+        np.testing.assert_allclose(float(rl), float(zl), rtol=1e-5)
+    check_close("plain", rep_params, z_params)
+
+    # --- LoRA with_frozen layout ---
+    frozen = {"base": jnp.asarray(
+        np.random.RandomState(7).randn(4).astype(np.float32))}
+    rep_step = hvd.make_train_step(frozen_loss_fn,
+                                   hvd.DistributedOptimizer(opt),
+                                   with_frozen=True)
+    rep_params, rep_state = fresh(), opt.init(fresh())
+    z_step = hvd.make_train_step(frozen_loss_fn, opt, with_frozen=True,
+                                 zero_stage=1)
+    z_params = fresh()
+    z_state = hvd.zero_init(opt, z_params)
+    for i in range(5):
+        batch = local_batch(100 + i, world, rank)
+        rep_params, rep_state, _ = rep_step(rep_params, rep_state, batch,
+                                            frozen)
+        batch = local_batch(100 + i, world, rank)
+        z_params, z_state, _ = z_step(z_params, z_state, batch, frozen)
+    check_close("frozen", rep_params, z_params)
+
+    if rank == 0:
+        print(f"ZERO PARITY OK (world={world})", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
