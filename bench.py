@@ -46,10 +46,31 @@ BASELINE_CONFIG = "batch256_s2d_bf16"
 # (not comparable to the replicated baseline).
 ZERO = any(os.environ.get(v, "").strip().lower() in ("1", "true", "yes", "on")
            for v in ("HVD_TPU_ZERO", "HOROVOD_ZERO"))
+# BENCH_SCANLOOP=1 (or HOROVOD_STEPS_PER_EXEC>1) benches the steps-per-
+# execution scan runner (make_flax_train_loop): k steps per dispatch, one
+# device->host fence per window element, reported alongside the host-
+# dispatch-gap fraction (timeline.DispatchGapMonitor).  Different config
+# string -> vs_baseline null.
+def _env_on(*names):
+    return any(os.environ.get(v, "").strip().lower()
+               in ("1", "true", "yes", "on") for v in names)
+
+
+SCAN_K = int(os.environ.get("HVD_TPU_STEPS_PER_EXEC",
+                            os.environ.get("HOROVOD_STEPS_PER_EXEC", "0"))
+             or 0)
+SCANLOOP = _env_on("BENCH_SCANLOOP") or SCAN_K > 1
+if SCANLOOP and SCAN_K < 1:
+    SCAN_K = 4
+# BENCH_TINY=1 swaps RN50 for a one-stage 8-filter ResNet on 32x32 inputs:
+# a plumbing smoke config (CPU-runnable), never comparable to the baseline.
+TINY = _env_on("BENCH_TINY")
 
 
 def _config() -> str:
-    return f"batch{BATCH}_s2d_bf16" + ("_zero1" if ZERO else "")
+    base = f"tinycnn_batch{BATCH}" if TINY else f"batch{BATCH}_s2d_bf16"
+    return (base + ("_zero1" if ZERO else "")
+            + (f"_scanloop{SCAN_K}" if SCANLOOP else ""))
 FLOPS_PER_IMAGE = 12.3e9  # RN50 fwd+bwd estimate
 V5E_BF16_PEAK = 197e12
 
@@ -79,12 +100,19 @@ def main():
     n = hvd.size()
     print(f"# devices: {n} x {jax.devices()[0].device_kind}", file=sys.stderr)
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
-                     space_to_depth=True)
     global_batch = BATCH * n
     key = jax.random.PRNGKey(0)
-    x = jax.random.normal(key, (global_batch, 224, 224, 3), jnp.bfloat16)
-    y = jax.random.randint(key, (global_batch,), 0, 1000, jnp.int32)
+    if TINY:
+        from horovod_tpu.models.resnet import BasicBlock, ResNet
+        model = ResNet(stage_sizes=[1], block_cls=BasicBlock, num_filters=8,
+                       num_classes=100, dtype=jnp.bfloat16)
+        x = jax.random.normal(key, (global_batch, 32, 32, 3), jnp.bfloat16)
+        y = jax.random.randint(key, (global_batch,), 0, 100, jnp.int32)
+    else:
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                         space_to_depth=True)
+        x = jax.random.normal(key, (global_batch, 224, 224, 3), jnp.bfloat16)
+        y = jax.random.randint(key, (global_batch,), 0, 1000, jnp.int32)
     variables = model.init(key, x[:2].astype(jnp.float32), train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
@@ -110,24 +138,63 @@ def main():
         opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
         opt_state = hvd.replicate(opt.init(params))
         step = make_flax_train_step(model.apply, opt)
-    batch = hvd.shard_batch((x, y))
 
-    # Warmup (compile + cache + one warm window).  float() is a
-    # device->host fetch -- the only fence that really waits here (see
-    # module docstring).
-    for _ in range(8):
-        params, batch_stats, opt_state, loss = step(params, batch_stats,
-                                                    opt_state, batch)
-    float(loss)
+    gap_fraction = None
+    if SCANLOOP:
+        # Steps-per-execution runner: SCAN_K steps per dispatch through
+        # ONE lax.scan executable (same step body bitwise -- training.py),
+        # host-dispatch-gap fraction measured per window.
+        from horovod_tpu.training import make_flax_train_loop, shard_steps
+        from horovod_tpu.timeline import DispatchGapMonitor
+        loop = make_flax_train_loop(model.apply, opt,
+                                    steps_per_execution=SCAN_K,
+                                    zero_stage=1 if ZERO else 0)
+        batch = shard_steps(
+            jax.tree.map(lambda a: jnp.stack([a] * SCAN_K), (x, y)))
+        calls = max(1, STEPS // SCAN_K)
+        monitor = DispatchGapMonitor()
+        for _ in range(2):  # warmup: compile + one warm window
+            params, batch_stats, opt_state, losses = loop(
+                params, batch_stats, opt_state, batch)
+        float(losses[-1])
+        rates = []
+        for _ in range(WINDOWS):
+            monitor.begin_window()
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                with monitor.dispatch():
+                    params, batch_stats, opt_state, losses = loop(
+                        params, batch_stats, opt_state, batch)
+            with monitor.dispatch():
+                float(losses[-1])  # forces the full window's step chain
+            dt = time.perf_counter() - t0
+            monitor.end_window()
+            rates.append(calls * SCAN_K * global_batch / dt / n)
+        gap_fraction = monitor.gap_fraction
+        print(f"# scanloop k={SCAN_K}: {calls} dispatches/window, "
+              f"host dispatch-gap fraction "
+              f"{[round(g, 4) for g in monitor.windows]} "
+              f"(mean {gap_fraction:.4f})", file=sys.stderr)
+    else:
+        batch = hvd.shard_batch((x, y))
 
-    rates = []
-    for _ in range(WINDOWS):
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            params, batch_stats, opt_state, loss = step(params, batch_stats,
-                                                        opt_state, batch)
-        float(loss)  # forces the full step chain
-        rates.append(STEPS * global_batch / (time.perf_counter() - t0) / n)
+        # Warmup (compile + cache + one warm window).  float() is a
+        # device->host fetch -- the only fence that really waits here (see
+        # module docstring).
+        for _ in range(8):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, batch)
+        float(loss)
+
+        rates = []
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                params, batch_stats, opt_state, loss = step(
+                    params, batch_stats, opt_state, batch)
+            float(loss)  # forces the full step chain
+            rates.append(
+                STEPS * global_batch / (time.perf_counter() - t0) / n)
     rates = np.asarray(rates)
     ips = float(rates.mean())
 
@@ -159,6 +226,8 @@ def main():
     }
     if zero_stats is not None:
         result["zero"] = zero_stats
+    if gap_fraction is not None:
+        result["dispatch_gap"] = round(gap_fraction, 4)
     print(json.dumps(result), flush=True)
     os._exit(0)  # skip slow atexit teardown; result is already printed
 

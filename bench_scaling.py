@@ -24,6 +24,9 @@ Usage::
 
     python bench_scaling.py                  # rn50 + bert-large, n=8/16/32
     python bench_scaling.py --models rn50 --ns 8 16
+    python bench_scaling.py --models rn50-chunked --ns 8 16
+                         # chunked RS+AG exchange (HOROVOD_EXCHANGE_CHUNK_MB)
+                         # -- same eq-AR payload, zero bucket all-reduces
     python bench_scaling.py --worker rn50 8  # (internal) one subprocess
 
 Prints one summary JSON line (machine-readable gate) after the tables.
@@ -107,6 +110,9 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
                                            sharding=sharding), tree)
 
     cnn_base = model[:-4] if model.endswith("-fp8") else model
+    chunked = model.endswith("-chunked")
+    if chunked:
+        cnn_base = model[:-len("-chunked")]
     if cnn_base in _CNN_CASES:
         from horovod_tpu import models as zoo
         # fp32 params = the bench configuration's wire dtype; the -fp8
@@ -144,9 +150,20 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         stats_leaves = len(jax.tree.leaves(stats))
         grad_leaves = jax.tree.leaves(params)
         # Emitted all-reduces: one per gradient fusion bucket, one per
-        # mutated BN-stat leaf, one for the loss mean.
+        # mutated BN-stat leaf, one for the loss mean.  The -chunked
+        # variant (HOROVOD_EXCHANGE_CHUNK_MB, set by run_worker) replaces
+        # every bucket all-reduce with reduce-scatter+all-gather chunks,
+        # so only the BN-stat and loss all-reduces remain -- and each
+        # chunk's RS(c)+AG(c) moves exactly one AR(c) of link wire, so
+        # the equivalent-allreduce payload must MATCH the plain rn50 row
+        # (chunk padding is <= n-1 elements per bucket tail: noise).
         buckets = len(plan_buckets(grad_leaves).buffers)
-        expected_emitted = None if fp8 else buckets + stats_leaves + 1
+        if fp8:
+            expected_emitted = None
+        elif chunked:
+            expected_emitted = stats_leaves + 1
+        else:
+            expected_emitted = buckets + stats_leaves + 1
         grad_bytes = sum(l.size * l.dtype.itemsize for l in grad_leaves)
         if fp8:
             grad_bytes //= 4  # e4m3 wire (+ one f32 scale per bucket)
@@ -297,6 +314,11 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
     the in-process libtpu (the compiler takes a host-wide lockfile), so
     topology workers run sequentially.
     """
+    if model.endswith("-chunked"):
+        # The chunk knob must be in the environment before init()
+        # snapshots the config; 4 MiB splits every >4 MiB fusion bucket.
+        os.environ.setdefault("HOROVOD_EXCHANGE_CHUNK_MB", "4")
+
     import jax
 
     import horovod_tpu as hvd
@@ -376,7 +398,14 @@ def _spawn(model: str, n: int, timeout: int = 2400,
     # function without .lower(), which the AOT accounting needs.
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
-                        "HOROVOD_AUTOTUNE", "HVD_TPU_AUTOTUNE")}
+                        "HOROVOD_AUTOTUNE", "HVD_TPU_AUTOTUNE",
+                        # Per-case knobs: the -chunked worker sets its own
+                        # chunk size; a stray ambient value must not leak
+                        # into the baseline rows' accounting.
+                        "HOROVOD_EXCHANGE_CHUNK_MB",
+                        "HVD_TPU_EXCHANGE_CHUNK_MB",
+                        "HOROVOD_STEPS_PER_EXEC",
+                        "HVD_TPU_STEPS_PER_EXEC")}
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", model,
            str(n)]
     if topology:

@@ -64,6 +64,9 @@ from .training import (  # noqa: F401
     make_train_step, make_flax_train_step, make_eval_step, shard_batch,
     shard_batch_from_local, replicate, batch_sharding,
     replicated_sharding, sync_batch_norm,
+    make_train_loop, make_flax_train_loop, stack_steps, shard_steps,
+    stacked_batch_sharding, steps_per_execution,
 )
+from .data import DevicePrefetcher, prefetch_to_device  # noqa: F401
 
 __version__ = "0.1.0"

@@ -37,6 +37,21 @@ knobs where a real choice survives under XLA:
   state layout is fixed at step build time, so the axis only opens when
   the run is zero-configured.
 
+PR 2 adds the latency-hiding axes:
+
+* **exchange chunk size** (OPT-IN via ``HOROVOD_AUTOTUNE_CHUNK=1``,
+  because scatter-reduce chunks change reduction order): 0 (monolithic
+  bucket allreduce) vs chunked reduce-scatter + all-gather exchange
+  (``collectives/ops.py::chunked_allreduce``).  Trace-time: flows
+  through :meth:`Autotuner.trace_key`.
+* **steps per execution** (OPT-IN via
+  ``HOROVOD_AUTOTUNE_STEPS_PER_EXEC=1``): how many train steps
+  ``make_train_loop`` compiles into one ``lax.scan`` executable.  This
+  is a BUILD-time structural knob -- it changes the loop's input shapes
+  -- so it is NOT part of ``trace_key()``; ``make_train_loop`` reads
+  :meth:`Autotuner.steps_per_exec` when it is (re)built, and the score
+  loop in ``training._maybe_tuned`` normalizes per-step time by k.
+
 The response-cache toggle stays collapsed: an executable-cache hit is
 always strictly cheaper than a retrace, so there is nothing to search.
 """
@@ -59,10 +74,11 @@ MAX_SAMPLES = 12
 COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8 = 0, 1, 2, 3
 
 
-def _grid(thresholds, cycles, hiers, comps,
-          zeros) -> List[Tuple[int, float, int, int, int]]:
-    return [(t, c, h, k, z) for t in thresholds for c in cycles
-            for h in hiers for k in comps for z in zeros]
+def _grid(thresholds, cycles, hiers, comps, zeros, chunks,
+          steps) -> List[Tuple[int, float, int, int, int, int, int]]:
+    return [(t, c, h, k, z, ch, sp) for t in thresholds for c in cycles
+            for h in hiers for k in comps for z in zeros for ch in chunks
+            for sp in steps]
 
 
 def _mesh_is_two_level() -> bool:
@@ -111,14 +127,32 @@ class Autotuner:
         self.tunes_zero = bool(_env_bool("AUTOTUNE_ZERO") and
                                configured_zero)
         zeros = [0, 1] if self.tunes_zero else [configured_zero]
+        # Chunked-exchange axis (opt-in, HOROVOD_AUTOTUNE_CHUNK=1: scatter-
+        # reduce chunks change reduction order): monolithic vs chunked
+        # RS+AG exchange (collectives/ops.py::chunked_allreduce).
+        configured_chunk = int(getattr(config, "exchange_chunk_bytes", 0))
+        if _env_bool("AUTOTUNE_CHUNK"):
+            chunks = sorted({0, 4 * _MiB, 16 * _MiB, configured_chunk})
+        else:
+            chunks = [configured_chunk]
+        # Steps-per-execution axis (opt-in,
+        # HOROVOD_AUTOTUNE_STEPS_PER_EXEC=1): build-time knob read by
+        # make_train_loop, not a trace_key member (it changes the loop's
+        # input shapes, so the loop must be rebuilt to apply it).
+        configured_steps = max(1, int(getattr(config, "steps_per_exec", 1)))
+        if _env_bool("AUTOTUNE_STEPS_PER_EXEC"):
+            steps = sorted({1, 4, 16, configured_steps})
+        else:
+            steps = [configured_steps]
         self.grid = _grid(sorted(self.candidates), sorted(cycles), hiers,
-                          comps, zeros)
+                          comps, zeros, chunks, steps)
         self.steps_per_sample = steps_per_sample
         self.max_samples = min(max_samples, len(self.grid))
         self.log_path = config.autotune_log
         self._opt = BayesianOptimizer(
-            [(float(t), c, float(h), float(k), float(z))
-             for t, c, h, k, z in self.grid])
+            [(float(t), c, float(h), float(k), float(z), float(ch),
+              float(sp))
+             for t, c, h, k, z, ch, sp in self.grid])
         self._samples: List[tuple] = []
         self._best: Optional[Tuple[int, float]] = None
         self._step = 0
@@ -134,7 +168,7 @@ class Autotuner:
         self._idx = self._next_index()
 
     # -- current knobs ----------------------------------------------------
-    def _current(self) -> Tuple[int, float, int, int, int]:
+    def _current(self) -> Tuple[int, float, int, int, int, int, int]:
         return self._best or self.grid[self._idx]
 
     def fusion_threshold(self) -> int:
@@ -165,14 +199,26 @@ class Autotuner:
         exchange, 1 = reduce-scatter + allgather; optim/zero.py)."""
         return int(self._current()[4])
 
+    def exchange_chunk_bytes(self) -> int:
+        """Chunked-exchange size of the current sample (0 = monolithic
+        bucket allreduce; collectives/ops.py::chunked_allreduce)."""
+        return int(self._current()[5])
+
+    def steps_per_exec(self) -> int:
+        """Scan-loop steps-per-execution of the current sample.  Applied
+        when ``make_train_loop`` is (re)built -- a BUILD-time knob, not
+        part of :meth:`trace_key` (it changes the loop's input shapes)."""
+        return int(self._current()[6])
+
     def trace_key(self) -> tuple:
         """The TRACE-TIME knobs of the current sample (the compiled step
         cache in ``training.make_train_step`` keys on this).  Cycle time
         is deliberately excluded: it is a RUNTIME knob applied through
         ``_apply_to_batcher``, and keying on it would recompile an
-        identical trace for every cycle-axis sample."""
-        thr, _cyc, hier, comp, zero = self._current()
-        return (thr, hier, comp, zero)
+        identical trace for every cycle-axis sample.  Steps-per-exec is
+        likewise excluded (a build-time structural knob)."""
+        thr, _cyc, hier, comp, zero, chunk, _sp = self._current()
+        return (thr, hier, comp, zero, chunk)
 
     @property
     def done(self) -> bool:
@@ -261,19 +307,27 @@ class Autotuner:
                         parts = line.strip().split(",")
                         if len(parts) == 3:     # pre-round-3 log format
                             cfg = (int(float(parts[0])), float(parts[1]),
-                                   0, COMP_DEFAULT, 0)
+                                   0, COMP_DEFAULT, 0, 0, 1)
                             score = float(parts[2])
                         elif len(parts) == 5:   # rounds 3-5: no zero axis
                             cfg = (int(float(parts[0])), float(parts[1]),
                                    int(float(parts[2])),
-                                   int(float(parts[3])), 0)
+                                   int(float(parts[3])), 0, 0, 1)
                             score = float(parts[4])
-                        elif len(parts) >= 6:
+                        elif len(parts) == 6:   # PR-1: zero, no chunk/steps
                             cfg = (int(float(parts[0])), float(parts[1]),
                                    int(float(parts[2])),
                                    int(float(parts[3])),
-                                   int(float(parts[4])))
+                                   int(float(parts[4])), 0, 1)
                             score = float(parts[5])
+                        elif len(parts) >= 8:   # PR-2: chunk + steps axes
+                            cfg = (int(float(parts[0])), float(parts[1]),
+                                   int(float(parts[2])),
+                                   int(float(parts[3])),
+                                   int(float(parts[4])),
+                                   int(float(parts[5])),
+                                   int(float(parts[6])))
+                            score = float(parts[7])
                         else:
                             continue
                         if cfg in self.grid:
@@ -293,8 +347,10 @@ class Autotuner:
             return
         with open(self.log_path, "w") as f:
             f.write("fusion_threshold_bytes,cycle_time_ms,hierarchical,"
-                    "compression,zero,score_bytes_per_s\n")
-            for thr, cyc, hier, comp, zero, score in self._samples:
-                f.write(f"{thr},{cyc},{hier},{comp},{zero},{score}\n")
-            f.write(f"# best,{self._best[0]},{self._best[1]},"
-                    f"{self._best[2]},{self._best[3]},{self._best[4]}\n")
+                    "compression,zero,exchange_chunk_bytes,steps_per_exec,"
+                    "score_bytes_per_s\n")
+            for thr, cyc, hier, comp, zero, chunk, sp, score \
+                    in self._samples:
+                f.write(f"{thr},{cyc},{hier},{comp},{zero},{chunk},{sp},"
+                        f"{score}\n")
+            f.write("# best," + ",".join(str(v) for v in self._best) + "\n")

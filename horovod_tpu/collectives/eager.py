@@ -615,24 +615,52 @@ def _grouped_allreduce_buckets(xs, op: ReduceOp = Average, *, name=None,
     host_in = all(isinstance(x, np.ndarray) for x in xs)
     if not host_in:
         xs = [jnp.asarray(x) for x in xs]
-    by_dtype: Dict[Any, List[int]] = {}
-    for i, x in enumerate(xs):
-        by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
+    plan = _bucket_layout(xs, k, ps)
     cat = np.concatenate if host_in else jnp.concatenate
     reds, spec = [], []
     from . import joinop as _join
-    with _join.flush(ps, len(by_dtype)):  # ONE presence round per flush
-        for dt, idxs in by_dtype.items():
+    with _join.flush(ps, len(plan)):  # ONE presence round per flush
+        for dt, idxs, widths, tails in plan:
             flats = [xs[i].reshape(k, -1) for i in idxs]
-            widths = [f.shape[1] for f in flats]
             fused = flats[0] if len(flats) == 1 else cat(flats, axis=1)
             reds.append(allreduce(
                 fused, op, name=f"{name or 'grouped_allreduce'}.{dt.name}",
                 process_set=process_set, compression=compression,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor))
-            spec.append((idxs, widths, [xs[i].shape[1:] for i in idxs]))
+            spec.append((idxs, widths, tails))
     return reds, (spec, len(xs))
+
+
+def _bucket_layout(xs, k: int, ps):
+    """Memoized dtype-bucket layout for the per-step eager hot path.
+
+    The grouping (and every width/tail it implies) is pure in the input
+    shapes/dtypes, the local rank count and the process set, yet was
+    recomputed on every grouped call.  The plan lives in the shared fusion
+    plan cache (``controller.fusion``'s ``ExecutableCache``), keyed on
+    (shapes, dtypes, threshold, process set); hit/miss counters surface
+    through :func:`horovod_tpu.controller.fusion.plan_cache_stats`.
+    """
+    from ..controller import fusion as _fusion
+    cache = _fusion._get_plan_cache()
+    key = _fusion.plan_key(xs, _fusion._threshold(),
+                           extra=("eager_grouped", k, ps.name))
+
+    def build():
+        by_dtype: Dict[Any, List[int]] = {}
+        for i, x in enumerate(xs):
+            by_dtype.setdefault(jnp.dtype(x.dtype), []).append(i)
+        return tuple(
+            (dt, tuple(idxs),
+             # width == reshape(k, -1).shape[1], computed without touching
+             # array data
+             tuple(int(np.prod(xs[i].shape, dtype=np.int64)) // k
+                   for i in idxs),
+             tuple(tuple(xs[i].shape[1:]) for i in idxs))
+            for dt, idxs in by_dtype.items())
+
+    return cache.get_or_build(key, build)
 
 
 def _unfuse_buckets(reds, spec, to_host: bool = False):

@@ -266,6 +266,68 @@ def hierarchical_allreduce(x,
     return y
 
 
+def chunked_allreduce(x,
+                      op: ReduceOp = Average,
+                      *,
+                      chunk_bytes: int,
+                      axes: Optional[AxisSpec] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    """Allreduce decomposed into chunk-sized reduce-scatter + all-gather
+    pairs (``HOROVOD_EXCHANGE_CHUNK_MB``; Sum/Average, full mesh only).
+
+    This XLA toolchain emits all-gather (and collective-permute) with async
+    start/done pairs but keeps all-reduce and reduce-scatter synchronous
+    (see ``utils/scaling.py``), so one monolithic bucket allreduce gives the
+    latency-hiding scheduler nothing to overlap.  Splitting the bucket into
+    chunk-sized ``psum_scatter`` + ``all_gather`` pieces moves the same
+    total link payload -- RS(B) + AG(B) == 2*(n-1)/n*B == AR(B) -- while
+    handing the scheduler independent pieces to interleave with the
+    remaining backward compute.  Each chunk is zero-padded to a multiple of
+    the mesh size (at most ``n-1`` elements per chunk, same trick as
+    :func:`hierarchical_allreduce`).
+
+    The reduction ORDER differs from a single ``psum`` (scatter-reduce
+    semantics), so results are close but not bitwise identical to
+    :func:`allreduce`; the knob is therefore opt-in (0 = off).
+    """
+    if op not in (Sum, Average):
+        raise ValueError(f"chunked_allreduce supports Sum/Average, got {op}")
+    axes, members = _resolve(axes, None)
+    n = math.prod(lax.axis_size(a) for a in axes)
+    if n == 1 or int(chunk_bytes) <= 0:
+        return allreduce(x, op, axes=axes, prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor)
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+    shape, dtype = x.shape, x.dtype
+    flat = x.ravel()
+    itemsize = jnp.dtype(dtype).itemsize
+    # A chunk holds chunk_bytes, rounded up to a multiple of n elements so
+    # every chunk scatters evenly across the mesh.
+    chunk_elems = max(1, int(chunk_bytes) // itemsize)
+    chunk_elems += (-chunk_elems) % n
+    pieces = []
+    for off in range(0, flat.size, chunk_elems):
+        piece = flat[off:off + chunk_elems]
+        pad = (-piece.size) % n
+        if pad:
+            piece = jnp.concatenate([piece, jnp.zeros((pad,), dtype)])
+        shard = lax.psum_scatter(piece, axes, scatter_dimension=0,
+                                 tiled=True)
+        if op is Average:
+            shard = _divide_in_dtype(shard, n)
+        full = lax.all_gather(shard, axes, axis=0, tiled=True)
+        if pad:
+            full = full[:-pad]
+        pieces.append(full)
+    y = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+    y = y.reshape(shape)
+    if postscale_factor != 1.0:
+        y = y * jnp.asarray(postscale_factor, dtype=y.dtype)
+    return y
+
+
 def grouped_allreduce(xs: Sequence,
                       op: ReduceOp = Average,
                       *,

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.state import global_state
+from .cache import ExecutableCache
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,19 +50,82 @@ def _threshold() -> int:
     return 64 * 1024 * 1024
 
 
-def plan_buckets(leaves: Sequence[jax.Array],
+def exchange_chunk_bytes() -> int:
+    """Resolved chunk size for the chunked gradient exchange (0 = off).
+
+    Reads ``HOROVOD_EXCHANGE_CHUNK_MB`` through the parsed config; when the
+    autotuner is active its chunk-size axis wins (like ``_threshold``).
+    """
+    st = global_state()
+    if st.config is not None:
+        if st.autotuner is not None:
+            return st.autotuner.exchange_chunk_bytes()
+        return st.config.exchange_chunk_bytes
+    return 0
+
+
+# Bucket-plan memoization (ResponseCache spirit): the eager path replans
+# identical gradient lists every step, and plan_buckets is pure in
+# (shapes, dtypes, threshold).  Bounded LRU so shape-polymorphic callers
+# cannot grow it without bound; capacity follows HOROVOD_CACHE_CAPACITY.
+_plan_cache: Optional[ExecutableCache] = None
+
+
+def _get_plan_cache() -> ExecutableCache:
+    global _plan_cache
+    st = global_state()
+    cap = st.config.cache_capacity if st.config is not None else 1024
+    if _plan_cache is None or _plan_cache.capacity != cap:
+        _plan_cache = ExecutableCache(capacity=cap)
+    return _plan_cache
+
+
+def plan_cache_stats() -> dict:
+    """Hit/miss/eviction counters for the memoized bucket planner."""
+    c = _get_plan_cache()
+    return {"hits": c.hits, "misses": c.misses, "evictions": c.evictions,
+            "size": len(c)}
+
+
+def clear_plan_cache() -> None:
+    global _plan_cache
+    _plan_cache = None
+
+
+def plan_key(leaves: Sequence[Any], threshold_bytes: int,
+             extra: Tuple = ()) -> Tuple:
+    """Hashable memoization key for a bucket plan: per-leaf (shape, dtype)
+    plus the threshold and any caller context (e.g. process-set name)."""
+    return (tuple((tuple(x.shape), str(jnp.dtype(x.dtype))) for x in leaves),
+            int(threshold_bytes)) + tuple(extra)
+
+
+def plan_buckets(leaves: Sequence[Any],
                  threshold_bytes: Optional[int] = None) -> FusionSpec:
     """Greedily pack leaves into per-dtype buckets of <= threshold bytes.
 
     Order within a dtype follows leaf order (gradients arrive in reverse
     topological order, which keeps adjacent-layer gradients adjacent in the
     buffer -- same locality the reference's cycle batching produces).
+
+    Leaves may be concrete arrays OR abstract ``jax.ShapeDtypeStruct``s
+    (anything with ``.shape``/``.dtype``): the plan depends only on shapes
+    and dtypes, so the scan-loop runner can plan its exchange ahead of data.
+    Plans are memoized in a bounded LRU (see :func:`plan_cache_stats`).
     """
     if threshold_bytes is None:
         threshold_bytes = _threshold()
+    leaves = [x if hasattr(x, "dtype") else jnp.asarray(x) for x in leaves]
+    cache = _get_plan_cache()
+    key = plan_key(leaves, threshold_bytes)
+    return cache.get_or_build(
+        key, lambda: _plan_buckets_uncached(leaves, threshold_bytes))
+
+
+def _plan_buckets_uncached(leaves: Sequence[Any],
+                           threshold_bytes: int) -> FusionSpec:
     by_dtype: dict = {}
     for i, x in enumerate(leaves):
-        x = jnp.asarray(x) if not hasattr(x, "dtype") else x
         by_dtype.setdefault(jnp.dtype(x.dtype), []).append(
             _LeafSpec(i, tuple(x.shape), int(np.prod(x.shape, dtype=np.int64))))
     buffers: List[Tuple[Any, Tuple[_LeafSpec, ...]]] = []

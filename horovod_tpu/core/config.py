@@ -83,6 +83,20 @@ class Config:
     # for steps built without an explicit argument (optim/zero.py).
     zero_stage: int = 0
 
+    # Steps-per-execution scan loop (HOROVOD_STEPS_PER_EXEC): default k for
+    # make_train_loop / make_flax_train_loop built without an explicit
+    # steps_per_execution argument.  k steps compile into ONE lax.scan
+    # executable, so they cost one host dispatch and one device->host fence.
+    steps_per_exec: int = 1
+
+    # Chunked gradient exchange (HOROVOD_EXCHANGE_CHUNK_MB, megabytes;
+    # 0 disables).  Decomposes each fusion bucket's allreduce into
+    # chunk-sized reduce-scatter + all-gather pairs so XLA's latency-hiding
+    # scheduler can interleave communication with remaining backward
+    # compute (all-gather compiles async on this toolchain; a monolithic
+    # all-reduce does not).
+    exchange_chunk_bytes: int = 0
+
     # Stall/heartbeat inspector for the launcher/elastic plane.
     stall_check_disable: bool = False
     stall_check_time: float = 60.0
@@ -212,6 +226,8 @@ def load_config() -> Config:
         autotune=_env_bool("AUTOTUNE"),
         autotune_log=_env("AUTOTUNE_LOG"),
         zero_stage=_env_int("ZERO", 0),
+        steps_per_exec=_env_int("STEPS_PER_EXEC", 1),
+        exchange_chunk_bytes=_env_int("EXCHANGE_CHUNK_MB", 0) * _MiB,
         stall_check_disable=_env_bool("STALL_CHECK_DISABLE"),
         # Upstream spells these *_TIME_SECONDS; accept both spellings.
         stall_check_time=_env_float(

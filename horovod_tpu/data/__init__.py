@@ -1,0 +1,11 @@
+"""Input-pipeline helpers: host->device transfer that overlaps compute.
+
+The reference's data plane (per-rank Petastorm readers, ``ElasticSampler``)
+leaves H2D copies on the training thread; here a background thread stages
+batches onto the mesh ahead of the step so the copy rides under compute
+(:mod:`horovod_tpu.data.prefetch`).
+"""
+
+from .prefetch import DevicePrefetcher, prefetch_to_device  # noqa: F401
+
+__all__ = ["DevicePrefetcher", "prefetch_to_device"]

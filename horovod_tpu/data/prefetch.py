@@ -1,0 +1,165 @@
+"""Double-buffered host->device batch prefetcher.
+
+The reference's input pipeline hands each rank a host iterator and pays
+the H2D copy synchronously inside the step loop; its background thread
+only hides the COLLECTIVE, not the copy.  Under JAX the device transfer
+(``jax.device_put`` to the batch sharding) is itself async, so a small
+producer thread that stays ``depth`` batches ahead of the consumer makes
+the copy overlap the previous step's compute entirely: by the time the
+training loop asks for batch i, its buffers are already on (or streaming
+to) the chips.  ``depth=2`` is classic double buffering -- one batch in
+flight to the device while the previous one computes.
+
+Pairs with :func:`horovod_tpu.training.make_train_loop`:
+``DevicePrefetcher(it, stack_steps=k)`` groups k host batches, stacks
+them on a leading steps axis, and ships the stacked window -- exactly
+the layout the k-step ``lax.scan`` loop consumes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+
+
+class _Stop:
+    """Sentinel carrying the producer's exit: clean end or an exception."""
+
+    def __init__(self, error: Optional[BaseException] = None):
+        self.error = error
+
+
+class DevicePrefetcher:
+    """Iterate host batches already placed on the mesh, ``depth`` ahead.
+
+    Parameters
+    ----------
+    iterator:
+        Any iterable of batch pytrees (numpy/host arrays per leaf, leading
+        batch dim sized for the GLOBAL batch -- same contract as
+        :func:`horovod_tpu.training.shard_batch`).
+    depth:
+        Bounded queue depth (default 2: double buffering).  The producer
+        blocks once ``depth`` device batches are unconsumed, bounding HBM
+        held by staged input at ``depth * batch_bytes``.
+    mesh / sharding:
+        Where to put the data; defaults to the initialized mesh's
+        batch sharding (leading dim split over every mesh axis).
+    stack_steps:
+        When > 1, group this many host batches per yielded item and stack
+        each leaf on a NEW leading axis (the
+        :func:`horovod_tpu.training.stack_steps` layout for
+        ``make_train_loop``).  A trailing partial group (fewer than
+        ``stack_steps`` batches left) is dropped -- a scan loop cannot run
+        a short window; ``dropped_remainder`` reports how many host
+        batches were discarded.
+    """
+
+    def __init__(self, iterator: Iterable,
+                 depth: int = 2,
+                 mesh=None,
+                 sharding=None,
+                 stack_steps: int = 1):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        if stack_steps < 1:
+            raise ValueError(
+                f"stack_steps must be >= 1, got {stack_steps}")
+        from ..training import batch_sharding, stacked_batch_sharding
+        if sharding is None:
+            # Stacked layout: dim 0 is the steps axis (unsharded), dim 1
+            # is the global batch split over the mesh.
+            sharding = stacked_batch_sharding(mesh) if stack_steps > 1 \
+                else batch_sharding(mesh)
+        self._sharding = sharding
+        self._stack = stack_steps
+        self.dropped_remainder = 0
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, args=(iter(iterator),),
+            name="hvd-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- producer ---------------------------------------------------------
+    def _put(self, item) -> bool:
+        """Enqueue, giving up promptly if the consumer closed us."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, it: Iterator) -> None:
+        import numpy as np
+        try:
+            while not self._stop.is_set():
+                if self._stack > 1:
+                    group = []
+                    for _ in range(self._stack):
+                        try:
+                            group.append(next(it))
+                        except StopIteration:
+                            break
+                    if len(group) < self._stack:
+                        self.dropped_remainder += len(group)
+                        break
+                    host = jax.tree.map(lambda *xs: np.stack(xs), *group)
+                else:
+                    try:
+                        host = next(it)
+                    except StopIteration:
+                        break
+                # device_put is async: the copy streams while the consumer
+                # computes on earlier batches.
+                dev = jax.tree.map(
+                    lambda x: jax.device_put(x, self._sharding), host)
+                if not self._put(dev):
+                    return
+            self._put(_Stop())
+        except BaseException as e:  # surface in the consumer thread
+            self._put(_Stop(e))
+
+    # -- consumer ---------------------------------------------------------
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if isinstance(item, _Stop):
+            self._stop.set()
+            if item.error is not None:
+                raise item.error
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and drop queued batches."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def prefetch_to_device(iterator: Iterable, depth: int = 2, mesh=None,
+                       sharding=None, stack_steps: int = 1
+                       ) -> DevicePrefetcher:
+    """Functional spelling of :class:`DevicePrefetcher` (flax idiom)."""
+    return DevicePrefetcher(iterator, depth=depth, mesh=mesh,
+                            sharding=sharding, stack_steps=stack_steps)

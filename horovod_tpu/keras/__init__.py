@@ -21,6 +21,27 @@ from ..collectives.compression import Compression  # noqa: F401
 from ..tensorflow import (  # noqa: F401
     DistributedOptimizer, allreduce, barrier, broadcast, broadcast_variables,
 )
+from ..training import steps_per_execution  # noqa: F401
+
+
+def compile_args(**overrides) -> dict:
+    """Keras ``model.compile`` kwargs honoring ``HOROVOD_STEPS_PER_EXEC``.
+
+    Keras already owns a steps-per-execution scan loop
+    (``model.compile(steps_per_execution=k)`` drives k steps per
+    ``train_function`` call on the JAX backend); this helper routes the
+    framework-wide env knob into it so keras and the native
+    :func:`horovod_tpu.training.make_train_loop` runner pick up the SAME
+    configuration::
+
+        model.compile(optimizer=opt, loss=loss,
+                      **hvd.keras.compile_args())
+
+    Explicit ``overrides`` win over the env.
+    """
+    args = {"steps_per_execution": steps_per_execution()}
+    args.update(overrides)
+    return args
 
 
 class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
@@ -110,6 +131,7 @@ __all__ = [
     "DistributedOptimizer", "BroadcastGlobalVariablesCallback",
     "MetricAverageCallback", "LearningRateWarmupCallback",
     "LearningRateScheduleCallback", "callbacks",
+    "steps_per_execution", "compile_args",
 ]
 
 from . import callbacks  # noqa: E402,F401  (hvd.callbacks.* namespace)

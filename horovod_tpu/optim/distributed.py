@@ -49,8 +49,10 @@ def allreduce_gradients(grads,
     (``HOROVOD_AUTOTUNE_COMPRESSION=1``) -- the compression codec.
     """
     from ..collectives.compression import is_fp8
+    from ..controller.fusion import exchange_chunk_bytes
     from ..core.state import global_state
     st = global_state()
+    chunk_bytes = exchange_chunk_bytes()
     tuner = st.autotuner
     if tuner is not None:
         override = tuner.compression_override(compression)
@@ -94,6 +96,16 @@ def allreduce_gradients(grads,
                 and op in (_ops.Sum, Average)):
             r = _ops.hierarchical_allreduce(
                 c, op, dcn_axis=ax[0], ici_axis=ax[1],
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+        elif (chunk_bytes > 0 and process_set is None
+              and op in (_ops.Sum, Average)):
+            # HOROVOD_EXCHANGE_CHUNK_MB (or the tuner's chunk axis):
+            # decompose the bucket into overlap-friendly RS+AG chunks.
+            # Chunking acts on the compressed wire buffer, so it composes
+            # with fp16/bf16 codecs.
+            r = _ops.chunked_allreduce(
+                c, op, chunk_bytes=chunk_bytes, axes=ax,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor)
         else:

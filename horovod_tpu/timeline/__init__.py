@@ -81,6 +81,16 @@ class Timeline:
                                  "pid": self._pid(track), "tid": 0,
                                  "ts": self._us()})
 
+    def counter(self, name: str, value: float,
+                track: str = "counters") -> None:
+        """Chrome-trace counter sample ("C" event) -- renders as a
+        stacked-area track (the reference plots tensor bytes this way)."""
+        with self._lock:
+            self._events.append({"name": name, "ph": "C",
+                                 "pid": self._pid(track), "tid": 0,
+                                 "ts": self._us(),
+                                 "args": {name: float(value)}})
+
     def mark_cycle(self) -> None:
         if self.mark_cycles:
             self.instant("CYCLE")
@@ -125,6 +135,61 @@ class Timeline:
         self._file.write("\n]\n")
         self._file.close()
         atexit.unregister(self.close)
+
+
+class DispatchGapMonitor:
+    """Per-window host-dispatch-gap fraction.
+
+    The scan-loop layer exists to shrink host time that is NOT spent
+    inside device dispatch/fetch calls -- Python glue, input handling,
+    the per-step fence.  This monitor measures it directly: wrap every
+    dispatch (step/loop call, final value fetch) in :meth:`dispatch`;
+    per window, ``gap_fraction = 1 - dispatched_time / wall_time`` --
+    the fraction of wall-clock the devices could have been starved by
+    the host.  A k-step scan loop drives it toward zero because one
+    dispatch covers k steps.
+
+    Feeds ``bench.py``'s ``scanloop`` config and, when a
+    :class:`Timeline` is active, a ``host_dispatch_gap`` counter track.
+    """
+
+    def __init__(self, timeline: Optional[Timeline] = None):
+        self.timeline = timeline
+        self.windows: list = []
+        self._t0: Optional[float] = None
+        self._dispatched = 0.0
+
+    def begin_window(self) -> None:
+        self._t0 = time.perf_counter()
+        self._dispatched = 0.0
+
+    @contextlib.contextmanager
+    def dispatch(self):
+        """Time one host->device dispatch (or device->host fetch)."""
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._dispatched += time.perf_counter() - t
+
+    def end_window(self) -> float:
+        """Close the window; returns (and records) its gap fraction."""
+        if self._t0 is None:
+            raise RuntimeError("end_window() without begin_window()")
+        wall = time.perf_counter() - self._t0
+        gap = 1.0 - min(self._dispatched / wall, 1.0) if wall > 0 else 0.0
+        self.windows.append(gap)
+        self._t0 = None
+        if self.timeline is not None:
+            self.timeline.counter("host_dispatch_gap", gap)
+        return gap
+
+    @property
+    def gap_fraction(self) -> float:
+        """Mean gap fraction over all closed windows (0.0 if none)."""
+        if not self.windows:
+            return 0.0
+        return float(sum(self.windows) / len(self.windows))
 
 
 @contextlib.contextmanager

@@ -44,6 +44,12 @@ from ..collectives.reduce_op import (  # noqa: F401
     ReduceOp, Average, Sum, Min, Max, Product, Adasum,
 )
 from ..collectives.compression import Compression  # noqa: F401
+# HOROVOD_STEPS_PER_EXEC pickup: torch stays a host-side autograd engine,
+# so there is no scan loop to compile into -- but torch training scripts
+# use the same knob to size their inner step loop between fences/logging
+# (and the cycle scheduler batches that window's collectives), keeping the
+# env contract uniform across the keras/torch/native frontends.
+from ..training import steps_per_execution  # noqa: F401
 from . import elastic_state as elastic  # noqa: F401  (hvd.elastic.TorchState)
 # Make `import horovod_tpu.torch.elastic` work as a module path too (the
 # file is elastic_state.py; register the reference-style names under both

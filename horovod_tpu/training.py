@@ -39,6 +39,21 @@ def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def stacked_batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Sharding for :func:`stack_steps` output: dim 0 is the (unsharded)
+    steps axis the scan loop consumes, dim 1 the global batch split over
+    every mesh axis."""
+    mesh = mesh or _basics.mesh()
+    return NamedSharding(mesh, P(None, tuple(mesh.axis_names)))
+
+
+def shard_steps(stacked: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Place a k-step stacked batch (``[k, global_batch, ...]`` leaves --
+    :func:`stack_steps`) onto the mesh for :func:`make_train_loop`."""
+    sharding = stacked_batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
+
+
 def shard_batch_from_local(local_batch: Any,
                            mesh: Optional[Mesh] = None) -> Any:
     """Assemble the global batch from each process's local rows.
@@ -110,6 +125,41 @@ def _resolve_zero_stage(zero_stage: Optional[int]) -> int:
     return zero_stage
 
 
+def steps_per_execution(default: int = 1) -> int:
+    """Resolved steps-per-execution k (``HOROVOD_STEPS_PER_EXEC``).
+
+    The keras/torch shims read this to pick up the env knob (pass it to
+    ``model.compile(steps_per_execution=...)`` / use it as the torch
+    micro-loop length); :func:`make_train_loop` calls it when built
+    without an explicit ``steps_per_execution``.  When the autotuner's
+    opt-in steps axis is active, the current sample's value wins.
+    """
+    from .core.state import global_state
+    st = global_state()
+    if st.autotuner is not None:
+        return max(1, st.autotuner.steps_per_exec())
+    if st.config is not None:
+        return max(1, st.config.steps_per_exec)
+    return max(1, default)
+
+
+def _resolve_steps(k: Optional[int]) -> int:
+    """``None`` defers to :func:`steps_per_execution` (env/tuner)."""
+    k = steps_per_execution() if k is None else int(k)
+    if k < 1:
+        raise ValueError(f"steps_per_execution must be >= 1, got {k}")
+    return k
+
+
+def stack_steps(batches) -> Any:
+    """Stack k per-step batches into the scanned layout ``make_train_loop``
+    consumes: each leaf gains a leading steps axis ``[k, batch, ...]``."""
+    batches = list(batches)
+    if not batches:
+        raise ValueError("stack_steps needs at least one batch")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+
+
 def make_train_step(
     loss_fn: Callable[[Any, Any], jnp.ndarray],
     optimizer: optax.GradientTransformation,
@@ -159,6 +209,30 @@ def make_train_step(
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
+    local_step = _build_local_step(loss_fn, optimizer, axes, loss_has_aux,
+                                   aux_mode, with_frozen, zero_stage,
+                                   zero_compression)
+
+    aux_spec = () if not loss_has_aux else \
+        ((P(),) if aux_mode == "averaged" else (P(axes),))
+    frozen_spec = (P(),) if with_frozen else ()
+    opt_spec = P(axes) if zero_stage else P()
+    shard = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), opt_spec, P(axes)) + frozen_spec,
+        out_specs=(P(), opt_spec, P()) + aux_spec,
+        check_vma=False)
+    donate_argnums = (0, 1) if donate else ()
+
+    return _maybe_tuned(shard, donate_argnums, loss_index=2)
+
+
+def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
+                      with_frozen, zero_stage, zero_compression):
+    """The per-device step body shared by :func:`make_train_step` (one
+    shard_map call) and :func:`make_train_loop` (the ``lax.scan`` body).
+    Sharing the exact closure is what makes the k-step loop bitwise
+    identical to k sequential step calls."""
 
     def local_step(params, opt_state, batch, *frozen):
         lf = (lambda p, b: loss_fn(p, frozen[0], b)) if with_frozen \
@@ -185,21 +259,87 @@ def make_train_step(
             return params, opt_state, loss, aux
         return params, opt_state, loss
 
+    return local_step
+
+
+def make_train_loop(
+    loss_fn: Callable[[Any, Any], jnp.ndarray],
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    steps_per_execution: Optional[int] = None,
+    donate: bool = True,
+    loss_has_aux: bool = False,
+    aux_mode: str = "stacked",
+    with_frozen: bool = False,
+    zero_stage: Optional[int] = None,
+    zero_compression=None,
+) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
+    """Steps-per-execution runner: k train steps as ONE executable.
+
+    Builds ``loop(params, opt_state, batches) -> (params, opt_state,
+    losses)`` where ``batches`` stacks k per-step batches on a leading
+    axis (``[k, global_batch, ...]`` per leaf -- :func:`stack_steps`, or
+    :class:`horovod_tpu.data.DevicePrefetcher` with ``stack_steps=k``)
+    and ``losses`` is the ``[k]`` per-step global-mean loss history.
+
+    The k steps run inside one ``jax.lax.scan`` with the params/opt-state
+    carry donated, so a whole window costs ONE host dispatch and ONE
+    device->host fence instead of k of each -- the reference hides that
+    host overhead behind its background thread; under XLA the loop simply
+    never returns to the host.  The step body is byte-for-byte the
+    :func:`make_train_step` body, so k scanned steps match k sequential
+    step calls bitwise.
+
+    ``steps_per_execution=None`` reads ``HOROVOD_STEPS_PER_EXEC``
+    (autotuner steps axis wins when active -- see
+    :func:`steps_per_execution`).  All other knobs (``loss_has_aux``,
+    ``aux_mode``, ``with_frozen``, ``zero_stage``...) behave as in
+    :func:`make_train_step`; stacked aux gains a leading k axis.
+    """
+    if aux_mode not in ("stacked", "averaged"):
+        raise ValueError(f"unknown aux_mode {aux_mode!r}")
+    zero_stage = _resolve_zero_stage(zero_stage)
+    if zero_stage:
+        _zero._reject_distributed(optimizer)
+    mesh = mesh or _basics.mesh()
+    axes = tuple(mesh.axis_names)
+    k = _resolve_steps(steps_per_execution)
+    local_step = _build_local_step(loss_fn, optimizer, axes, loss_has_aux,
+                                   aux_mode, with_frozen, zero_stage,
+                                   zero_compression)
+
+    def local_loop(params, opt_state, batches, *frozen):
+        def body(carry, batch):
+            out = local_step(carry[0], carry[1], batch, *frozen)
+            if loss_has_aux:
+                p, o, loss, aux = out
+                return (p, o), (loss, aux)
+            p, o, loss = out
+            return (p, o), loss
+
+        (params, opt_state), ys = jax.lax.scan(
+            body, (params, opt_state), batches, length=k)
+        if loss_has_aux:
+            losses, aux = ys
+            return params, opt_state, losses, aux
+        return params, opt_state, ys
+
+    # Batch leaves carry a leading steps axis: dim 0 scans, dim 1 shards.
     aux_spec = () if not loss_has_aux else \
-        ((P(),) if aux_mode == "averaged" else (P(axes),))
+        ((P(),) if aux_mode == "averaged" else (P(None, axes),))
     frozen_spec = (P(),) if with_frozen else ()
     opt_spec = P(axes) if zero_stage else P()
     shard = jax.shard_map(
-        local_step, mesh=mesh,
-        in_specs=(P(), opt_spec, P(axes)) + frozen_spec,
+        local_loop, mesh=mesh,
+        in_specs=(P(), opt_spec, P(None, axes)) + frozen_spec,
         out_specs=(P(), opt_spec, P()) + aux_spec,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
-    return _maybe_tuned(shard, donate_argnums, loss_index=2)
+    return _maybe_tuned(shard, donate_argnums, loss_index=2, steps=k)
 
 
-def _maybe_tuned(shard, donate_argnums, loss_index: int):
+def _maybe_tuned(shard, donate_argnums, loss_index: int, steps: int = 1):
     """jit the sharded step; under HOROVOD_AUTOTUNE=1 wrap it in the
     ParameterManager score loop.
 
@@ -210,6 +350,10 @@ def _maybe_tuned(shard, donate_argnums, loss_index: int):
     not ``block_until_ready``: on the tunnelled TPU the latter can return
     before execution completes (measured; see bench.py) -- the fetch adds
     a constant per-step latency that cancels in the per-config ranking.
+
+    ``steps`` is the scan-loop steps-per-execution: one call of a k-step
+    loop moves k steps' worth of gradient bytes, so the bytes/sec score
+    stays comparable across loop shapes.
     """
     from .core.state import global_state
     tuner = global_state().autotuner
@@ -234,7 +378,8 @@ def _maybe_tuned(shard, donate_argnums, loss_index: int):
         t0 = _time.perf_counter()
         out = fn(params, *rest)
         float(jnp.asarray(out[loss_index]).ravel()[0])  # honest fence
-        tuner.record_step(_time.perf_counter() - t0, grad_nbytes[0])
+        tuner.record_step(_time.perf_counter() - t0,
+                          grad_nbytes[0] * steps)
         return out
 
     return tuned_step
@@ -267,6 +412,24 @@ def make_flax_train_step(
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
+    local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn, axes,
+                                        zero_stage, zero_compression)
+
+    opt_spec = P(axes) if zero_stage else P()
+    shard = jax.shard_map(local_step, mesh=mesh,
+                          in_specs=(P(), P(), opt_spec, P(axes)),
+                          out_specs=(P(), P(), opt_spec, P()),
+                          check_vma=False)
+    donate_argnums = (0, 1, 2) if donate else ()
+    # Autotune applies here too (HOROVOD_AUTOTUNE=1): loss is element 3.
+    return _maybe_tuned(shard, donate_argnums, loss_index=3)
+
+
+def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
+                           zero_compression):
+    """Per-device flax step body shared by :func:`make_flax_train_step`
+    and :func:`make_flax_train_loop` (bitwise parity, as with
+    :func:`_build_local_step`)."""
     if loss_fn is None:
         def loss_fn(logits, y):
             return _softmax_xent(logits, y)
@@ -297,14 +460,57 @@ def make_flax_train_step(
         loss = _ops.allreduce(loss, Average, axes=axes)
         return params, new_stats, opt_state, loss
 
+    return local_step
+
+
+def make_flax_train_loop(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    loss_fn: Optional[Callable] = None,
+    mesh: Optional[Mesh] = None,
+    steps_per_execution: Optional[int] = None,
+    donate: bool = True,
+    zero_stage: Optional[int] = None,
+    zero_compression=None,
+):
+    """Steps-per-execution runner for flax modules with batch stats.
+
+    Returns ``loop(params, batch_stats, opt_state, batches) -> (params,
+    batch_stats, opt_state, losses)``: the :func:`make_flax_train_step`
+    body scanned k times in one executable (one dispatch, one fence),
+    with the params/stats/opt-state carry donated.  ``batches`` stacks k
+    ``(x, y)`` pairs on a leading axis (:func:`stack_steps`); ``losses``
+    is the ``[k]`` per-step loss history.  See :func:`make_train_loop`.
+
+    Note the flax carry includes batch stats only when non-empty: an
+    empty-stats model scans the same body with an empty-dict carry leaf,
+    exactly as the single step does.
+    """
+    zero_stage = _resolve_zero_stage(zero_stage)
+    if zero_stage:
+        _zero._reject_distributed(optimizer)
+    mesh = mesh or _basics.mesh()
+    axes = tuple(mesh.axis_names)
+    k = _resolve_steps(steps_per_execution)
+    local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn, axes,
+                                        zero_stage, zero_compression)
+
+    def local_loop(params, batch_stats, opt_state, batches):
+        def body(carry, batch):
+            p, s, o, loss = local_step(*carry, batch)
+            return (p, s, o), loss
+
+        (params, batch_stats, opt_state), losses = jax.lax.scan(
+            body, (params, batch_stats, opt_state), batches, length=k)
+        return params, batch_stats, opt_state, losses
+
     opt_spec = P(axes) if zero_stage else P()
-    shard = jax.shard_map(local_step, mesh=mesh,
-                          in_specs=(P(), P(), opt_spec, P(axes)),
+    shard = jax.shard_map(local_loop, mesh=mesh,
+                          in_specs=(P(), P(), opt_spec, P(None, axes)),
                           out_specs=(P(), P(), opt_spec, P()),
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
-    # Autotune applies here too (HOROVOD_AUTOTUNE=1): loss is element 3.
-    return _maybe_tuned(shard, donate_argnums, loss_index=3)
+    return _maybe_tuned(shard, donate_argnums, loss_index=3, steps=k)
 
 
 def _softmax_xent(logits, y):

@@ -107,7 +107,7 @@ def test_autotuner_skips_cycle_axis_without_torch_shim(monkeypatch):
                         raising=False)
     monkeypatch.delitem(sys.modules, "horovod_tpu.torch", raising=False)
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
-    cycles = {c for _, c, _h, _k, _z in t.grid}
+    cycles = {c for _, c, *_rest in t.grid}
     assert cycles == {Config().cycle_time}
 
 
@@ -116,7 +116,7 @@ def test_autotuner_tunes_cycle_axis_with_torch_shim(monkeypatch):
     monkeypatch.setitem(sys.modules, "horovod_tpu.torch_api",
                         sys.modules[__name__])  # any module object works
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert len({c for _, c, _h, _k, _z in t.grid}) > 1
+    assert len({c for _, c, *_rest in t.grid}) > 1
 
 
 def test_autotuner_hierarchical_axis_requires_two_level_mesh(hvd):
@@ -127,14 +127,14 @@ def test_autotuner_hierarchical_axis_requires_two_level_mesh(hvd):
     from horovod_tpu.parallel.mesh import build_mesh
 
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert {h for _t, _c, h, _k, _z in t.grid} == {0}
+    assert {h for _t, _c, h, *_rest in t.grid} == {0}
 
     hv_mod.shutdown()
     mesh = build_mesh(jax.devices()[:8], hierarchical=True, dcn_size=2)
     hv_mod.init(mesh=mesh)
     try:
         t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
-        assert {h for _t, _c, h, _k, _z in t2.grid} == {0, 1}
+        assert {h for _t, _c, h, *_rest in t2.grid} == {0, 1}
     finally:
         hv_mod.shutdown()
         hv_mod.init()
@@ -144,12 +144,12 @@ def test_autotuner_compression_axis_is_opt_in(monkeypatch):
     from horovod_tpu.collectives.compression import Compression
 
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert {k for _t, _c, _h, k, _z in t.grid} == {0}
+    assert {k for _t, _c, _h, k, *_rest in t.grid} == {0}
     assert t.compression_override(Compression.none) is Compression.none
 
     monkeypatch.setenv("HOROVOD_AUTOTUNE_COMPRESSION", "1")
     t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
-    assert {k for _t, _c, _h, k, _z in t2.grid} == {0, 1, 2, 3}
+    assert {k for _t, _c, _h, k, *_rest in t2.grid} == {0, 1, 2, 3}
     # Force a sample on the bf16 / fp8 codecs and check the overrides
     # resolve.
     for want, codec in [(1, Compression.bf16), (3, Compression.fp8)]:
@@ -167,25 +167,25 @@ def test_autotuner_zero_axis_is_opt_in(monkeypatch):
     exchange over the sharded arena is searchable)."""
     t = Autotuner(Config(autotune=True), steps_per_sample=1)
     assert not t.tunes_zero
-    assert {z for _t, _c, _h, _k, z in t.grid} == {0}
+    assert {z for _t, _c, _h, _k, z, *_rest in t.grid} == {0}
 
     # Env alone is not enough: a replicated run has no zero exchange.
     monkeypatch.setenv("HOROVOD_AUTOTUNE_ZERO", "1")
     t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
     assert not t2.tunes_zero
-    assert {z for _t, _c, _h, _k, z in t2.grid} == {0}
+    assert {z for _t, _c, _h, _k, z, *_rest in t2.grid} == {0}
 
     # Zero-configured run without the env: pinned to 1.
     monkeypatch.delenv("HOROVOD_AUTOTUNE_ZERO")
     t3 = Autotuner(Config(autotune=True, zero_stage=1), steps_per_sample=1)
     assert not t3.tunes_zero
-    assert {z for _t, _c, _h, _k, z in t3.grid} == {1}
+    assert {z for _t, _c, _h, _k, z, *_rest in t3.grid} == {1}
 
     # Both: the axis opens and the accessor tracks the current sample.
     monkeypatch.setenv("HOROVOD_AUTOTUNE_ZERO", "1")
     t4 = Autotuner(Config(autotune=True, zero_stage=1), steps_per_sample=1)
     assert t4.tunes_zero
-    assert {z for _t, _c, _h, _k, z in t4.grid} == {0, 1}
+    assert {z for _t, _c, _h, _k, z, *_rest in t4.grid} == {0, 1}
     for want in (0, 1):
         for i, cfg in enumerate(t4.grid):
             if cfg[4] == want:
@@ -193,6 +193,71 @@ def test_autotuner_zero_axis_is_opt_in(monkeypatch):
                 break
         assert t4.zero_stage() == want
         assert t4.trace_key()[3] == want
+
+
+def test_autotuner_chunk_axis_is_opt_in(monkeypatch):
+    """HOROVOD_AUTOTUNE_CHUNK=1 opens the exchange-chunk-size axis
+    (trace-time knob: it IS part of the trace key); otherwise the axis is
+    pinned to the configured HOROVOD_EXCHANGE_CHUNK_MB value."""
+    _MiB = 1 << 20
+    t = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert {cfg[5] for cfg in t.grid} == {0}
+    assert t.exchange_chunk_bytes() == 0
+
+    t1 = Autotuner(Config(autotune=True, exchange_chunk_bytes=8 * _MiB),
+                   steps_per_sample=1)
+    assert {cfg[5] for cfg in t1.grid} == {8 * _MiB}
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_CHUNK", "1")
+    t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert {cfg[5] for cfg in t2.grid} == {0, 4 * _MiB, 16 * _MiB}
+    for want in (0, 4 * _MiB, 16 * _MiB):
+        for i, cfg in enumerate(t2.grid):
+            if cfg[5] == want:
+                t2._idx = i
+                break
+        assert t2.exchange_chunk_bytes() == want
+        assert t2.trace_key()[4] == want  # retrace per chunk size
+
+
+def test_autotuner_steps_axis_is_opt_in_and_build_time(monkeypatch):
+    """HOROVOD_AUTOTUNE_STEPS_PER_EXEC=1 opens the steps-per-execution
+    axis.  Unlike every other knob it changes the LOOP INPUT SHAPES
+    (stacked batches), so it is a build-time knob and must NOT appear in
+    the trace key -- the runner rebuilds, it does not just retrace."""
+    t = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert {cfg[6] for cfg in t.grid} == {1}
+    assert t.steps_per_exec() == 1
+
+    t1 = Autotuner(Config(autotune=True, steps_per_exec=8),
+                   steps_per_sample=1)
+    assert {cfg[6] for cfg in t1.grid} == {8}
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_EXEC", "1")
+    t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert {cfg[6] for cfg in t2.grid} == {1, 4, 16}
+    assert len(t2.trace_key()) == 5  # thr, hier, comp, zero, chunk -- no k
+    for want in (1, 4, 16):
+        for i, cfg in enumerate(t2.grid):
+            if cfg[6] == want:
+                t2._idx = i
+                break
+        assert t2.steps_per_exec() == want
+
+
+def test_autotuner_pr1_log_format_warm_starts(tmp_path):
+    """6-column logs from the zero-axis era map onto the chunk=0/steps=1
+    plane."""
+    log = tmp_path / "pr1.csv"
+    cfg = Config(autotune=True, autotune_log=str(log))
+    thr = 32 * 1024 * 1024
+    log.write_text(
+        "fusion_threshold_bytes,cycle_time_ms,hierarchical,compression,"
+        "zero,score_bytes_per_s\n"
+        f"{thr},{Config().cycle_time},0,0,0,456.0\n")
+    t = Autotuner(cfg, steps_per_sample=1)
+    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 456.0) in [
+        tuple(s) for s in t._samples]
 
 
 def test_hierarchical_allreduce_matches_flat_psum(hvd):
@@ -267,7 +332,7 @@ def test_autotune_e2e_explores_hierarchical_axis(tmp_path, hvd):
             losses.append(float(loss))
             guard += 1
         assert st.autotuner.done
-        sampled_h = {h for _t, _c, h, _k, _z, _s in st.autotuner._samples}
+        sampled_h = {s[2] for s in st.autotuner._samples}
         assert sampled_h == {0, 1}  # both algorithms really ran
         assert losses[-1] < losses[0]
     finally:
@@ -330,5 +395,5 @@ def test_autotuner_old_log_format_warm_starts(tmp_path):
     log.write_text("fusion_threshold_bytes,cycle_time_ms,score\n"
                    f"{thr},{Config().cycle_time},123.0\n")
     t = Autotuner(cfg, steps_per_sample=1)
-    assert (thr, Config().cycle_time, 0, 0, 0, 123.0) in [
+    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 123.0) in [
         tuple(s) for s in t._samples]

@@ -85,3 +85,97 @@ def test_guard_flags_unparseable_artifacts(tmp_path):
     (tmp_path / "BENCH_bad.json").write_text("{not json")
     bad = scan_bench_results(str(tmp_path), "")
     assert bad == [(str(tmp_path / "BENCH_bad.json"), "unparseable")]
+
+
+# -- scanloop config shape ---------------------------------------------------
+# bench.py's scanloop config (BENCH_SCANLOOP=1 / HOROVOD_STEPS_PER_EXEC>1)
+# is cross-config by construction (the config string gains "_scanloopK"),
+# so its vs_baseline must be null, and it must report the host-dispatch-gap
+# fraction the steps-per-execution runner exists to shrink.
+
+
+def scan_scanloop_entries(bench_dir):
+    """Return [(path, why), ...] for malformed scanloop bench entries:
+    a scanloop config must publish ``vs_baseline: null`` (different config
+    than the baseline's) and a ``dispatch_gap`` fraction in [0, 1]."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            if "scanloop" not in str(parsed.get("config", "")):
+                continue
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "scanloop vs_baseline must be null"))
+            gap = parsed.get("dispatch_gap")
+            if not isinstance(gap, (int, float)) or not 0.0 <= gap <= 1.0:
+                bad.append((path, f"bad dispatch_gap: {gap!r}"))
+    return bad
+
+
+def test_committed_scanloop_entries_well_formed():
+    assert scan_scanloop_entries(REPO) == []
+
+
+def _write_scanloop(tmp_path, name, vs_baseline, dispatch_gap):
+    parsed = {"metric": "resnet50_images_per_sec_per_chip", "value": 2600.0,
+              "unit": "images/s/chip", "vs_baseline": vs_baseline,
+              "config": "batch256_s2d_bf16_scanloop4",
+              "baseline_config": "batch256_s2d_bf16"}
+    if dispatch_gap is not None:
+        parsed["dispatch_gap"] = dispatch_gap
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def test_scanloop_validator_accepts_well_formed_entry(tmp_path):
+    _write_scanloop(tmp_path, "BENCH_r90.json", None, 0.034)
+    assert scan_scanloop_entries(str(tmp_path)) == []
+    # ...and the >=0.98 gate ignores it (vs_baseline null).
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_scanloop_validator_trips_on_nonnull_vs_baseline(tmp_path):
+    _write_scanloop(tmp_path, "BENCH_r91.json", 1.02, 0.034)
+    bad = scan_scanloop_entries(str(tmp_path))
+    assert bad == [(str(tmp_path / "BENCH_r91.json"),
+                    "scanloop vs_baseline must be null")]
+
+
+def test_scanloop_validator_trips_on_missing_or_bad_gap(tmp_path):
+    _write_scanloop(tmp_path, "BENCH_r92.json", None, None)
+    _write_scanloop(tmp_path, "BENCH_r93.json", None, 1.5)
+    bad = dict(scan_scanloop_entries(str(tmp_path)))
+    assert str(tmp_path / "BENCH_r92.json") in bad
+    assert str(tmp_path / "BENCH_r93.json") in bad
+
+
+def test_bench_config_string_gains_scanloop_suffix(monkeypatch):
+    """bench.py's config string must mark scanloop runs (that suffix is
+    what makes vs_baseline null via the same_config gate)."""
+    import importlib
+
+    import bench
+    monkeypatch.setenv("BENCH_SCANLOOP", "1")
+    monkeypatch.delenv("HOROVOD_STEPS_PER_EXEC", raising=False)
+    monkeypatch.delenv("HVD_TPU_STEPS_PER_EXEC", raising=False)
+    b = importlib.reload(bench)
+    assert b.SCANLOOP and b.SCAN_K == 4  # default k
+    assert b._config().endswith("_scanloop4")
+    assert b._config() != b.BASELINE_CONFIG
+
+    monkeypatch.delenv("BENCH_SCANLOOP")
+    monkeypatch.setenv("HOROVOD_STEPS_PER_EXEC", "8")
+    b = importlib.reload(bench)
+    assert b.SCANLOOP and b.SCAN_K == 8
+    assert b._config().endswith("_scanloop8")
+
+    monkeypatch.delenv("HOROVOD_STEPS_PER_EXEC")
+    b = importlib.reload(bench)
+    assert not b.SCANLOOP
+    assert b._config() == b.BASELINE_CONFIG

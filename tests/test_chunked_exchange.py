@@ -1,0 +1,133 @@
+"""Chunked gradient-exchange tests: ``chunked_allreduce`` decomposes an
+allreduce into reduce-scatter+allgather chunks (same reduction, same
+equivalent-allreduce wire payload, overlap-friendly all-gather legs).
+
+Numerics note: the chunked path reduces in psum_scatter order, which can
+differ from a flat psum's reduction order in the last float bit -- tests
+compare with tight tolerances, not bitwise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hv
+from horovod_tpu.collectives import ops as cops
+
+
+def _run_pair(x, op, chunk_bytes, **kw):
+    """(plain allreduce, chunked allreduce) of rank-stacked ``x``."""
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+
+    def f(xb):
+        plain = cops.allreduce(xb[0], op, axes=axes, **kw)
+        ch = cops.chunked_allreduce(xb[0], op, chunk_bytes=chunk_bytes,
+                                    axes=axes, **kw)
+        return plain[None], ch[None]
+
+    fs = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axes),
+                               out_specs=(P(axes),) * 2))
+    plain, ch = fs(jnp.asarray(x))
+    return np.asarray(plain[0]), np.asarray(ch[0])
+
+
+@pytest.mark.parametrize("op", ["sum", "avg"])
+@pytest.mark.parametrize("shape", [(37,), (5, 7), (64,)])
+def test_chunked_allreduce_matches_plain(hvd, n_devices, op, shape):
+    """Odd sizes force chunk padding; 2-D shapes exercise the
+    ravel/reshape round trip; 64 floats with 64-byte chunks force
+    multiple chunks."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(n_devices, *shape).astype(np.float32)
+    rop = hv.Sum if op == "sum" else hv.Average
+    plain, ch = _run_pair(x, rop, chunk_bytes=64)
+    assert ch.shape == shape
+    np.testing.assert_allclose(ch, plain, rtol=1e-6, atol=1e-6)
+
+
+def test_chunked_allreduce_prescale_postscale(hvd, n_devices):
+    rng = np.random.RandomState(4)
+    x = rng.randn(n_devices, 19).astype(np.float32)
+    plain, ch = _run_pair(x, hv.Sum, chunk_bytes=32,
+                          prescale_factor=0.5, postscale_factor=2.0)
+    np.testing.assert_allclose(ch, plain, rtol=1e-6, atol=1e-6)
+
+
+def test_chunked_allreduce_zero_chunk_is_plain(hvd, n_devices):
+    """chunk_bytes=0 (the default config) is the unchunked allreduce."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(n_devices, 11).astype(np.float32)
+    plain, ch = _run_pair(x, hv.Average, chunk_bytes=0)
+    np.testing.assert_array_equal(ch, plain)
+
+
+def test_chunked_allreduce_rejects_nonlinear_ops(hvd):
+    with pytest.raises(ValueError, match="Sum/Average"):
+        mesh = hv.mesh()
+        axes = tuple(mesh.axis_names)
+        jax.jit(jax.shard_map(
+            lambda xb: cops.chunked_allreduce(
+                xb[0], hv.Min, chunk_bytes=64, axes=axes)[None],
+            mesh=mesh, in_specs=P(axes), out_specs=P(axes)))(
+            jnp.ones((len(jax.devices()), 4)))
+
+
+def test_exchange_chunk_env_reaches_fusion_knob(monkeypatch):
+    from horovod_tpu.controller import fusion
+
+    monkeypatch.setenv("HOROVOD_EXCHANGE_CHUNK_MB", "4")
+    hv.shutdown()
+    hv.init()
+    try:
+        assert fusion.exchange_chunk_bytes() == 4 * 2 ** 20
+    finally:
+        hv.shutdown()
+
+
+def test_chunked_step_emits_rs_ag_and_converges(monkeypatch):
+    """End-to-end: with HOROVOD_EXCHANGE_CHUNK_MB set, the fused
+    gradient exchange lowers to reduce-scatter+all-gather (no gradient
+    all-reduce buckets) and training matches the unchunked path."""
+    import optax
+    from horovod_tpu.utils.scaling import emitted_collective_stats
+
+    def build_and_run():
+        opt = hv.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+        rng = np.random.RandomState(0)
+        params = hv.replicate(
+            {"w": rng.randn(6, 4).astype(np.float32)})
+        opt_state = hv.replicate(opt.init(params))
+        step = hv.make_train_step(
+            lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2), opt)
+        batch = hv.shard_batch(
+            (rng.randn(16, 6).astype(np.float32),
+             rng.randn(16, 4).astype(np.float32)))
+        lowered = step.lower(params, opt_state, batch)
+        for _ in range(4):
+            params, opt_state, loss = step(params, opt_state, batch)
+        return (emitted_collective_stats(lowered.as_text()).counts,
+                jax.tree.map(np.asarray, params), float(loss))
+
+    hv.shutdown()
+    hv.init()
+    base_counts, base_params, _ = build_and_run()
+    hv.shutdown()
+
+    monkeypatch.setenv("HOROVOD_EXCHANGE_CHUNK_MB", "1")
+    hv.init()
+    try:
+        counts, params, loss = build_and_run()
+        # The gradient bucket's all-reduce is gone; RS+AG appear.
+        assert counts.get("reduce-scatter", 0) >= 1
+        assert counts.get("all-gather", 0) >= 1
+        assert counts.get("all-reduce", 0) < \
+            base_counts.get("all-reduce", 0)
+        assert np.isfinite(loss)
+        for a, b in zip(jax.tree.leaves(base_params),
+                        jax.tree.leaves(params)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    finally:
+        hv.shutdown()

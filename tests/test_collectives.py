@@ -765,6 +765,39 @@ def test_grouped_allreduce(hvd, n_devices):
                                    np.sum(np.asarray(x), axis=0), rtol=1e-5)
 
 
+def test_grouped_allreduce_mixed_dtypes_unfuse_ordering(hvd, n_devices):
+    """Interleaved f32/bf16/int32 tensors fuse into per-dtype buckets with
+    NON-contiguous original positions; unfuse must hand every result back
+    at its input index with its input dtype and shape."""
+    n = n_devices
+    layout = [(jnp.float32, (4,)), (jnp.bfloat16, (2, 3)),
+              (jnp.int32, (5,)), (jnp.float32, (3, 2)),
+              (jnp.bfloat16, (7,)), (jnp.int32, (1, 4)),
+              (jnp.float32, (6,))]
+    xs = [rank_stacked(n, shape, dt, seed=10 + i)
+          for i, (dt, shape) in enumerate(layout)]
+    ys = hvd.grouped_allreduce(xs, hvd.Sum)
+    assert len(ys) == len(xs)
+    for (dt, shape), x, y in zip(layout, xs, ys):
+        assert y.dtype == jnp.dtype(dt)
+        assert y.shape[1:] == shape
+        expect = np.sum(np.asarray(x, dtype=np.float32), axis=0)
+        if dt == jnp.int32:
+            np.testing.assert_array_equal(
+                np.asarray(y[0]), expect.astype(np.int32))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(y[0], dtype=np.float32), expect,
+                rtol=3e-2 if dt == jnp.bfloat16 else 1e-5)
+    # Values must not have been swapped within a dtype bucket: each
+    # tensor's result matches ITS OWN stack, not a bucket neighbor's.
+    for i, j in [(0, 3), (3, 6), (1, 4), (2, 5)]:
+        a = np.asarray(ys[i], np.float32).ravel()
+        b = np.asarray(ys[j], np.float32).ravel()
+        m = min(a.size, b.size)
+        assert not np.allclose(a[:m], b[:m])
+
+
 def test_async_handles(hvd, n_devices):
     x = rank_stacked(n_devices, (16,), jnp.float32)
     h = hvd.allreduce_async(x, hvd.Sum, name="async1")

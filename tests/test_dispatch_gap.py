@@ -1,0 +1,74 @@
+"""DispatchGapMonitor + timeline counter-track tests.
+
+The monitor measures the fraction of a window's wall clock spent OUTSIDE
+dispatch/fetch calls -- the host overhead the steps-per-execution scan
+loop exists to hide.  These tests drive it with sleeps so the expected
+fractions are known.
+"""
+
+import json
+import time
+
+import pytest
+
+from horovod_tpu.timeline import DispatchGapMonitor, Timeline
+
+
+def test_gap_fraction_reflects_undispatched_time():
+    mon = DispatchGapMonitor()
+    mon.begin_window()
+    with mon.dispatch():
+        time.sleep(0.05)
+    time.sleep(0.05)  # host-side gap
+    gap = mon.end_window()
+    assert 0.2 < gap < 0.8
+    assert mon.windows == [gap]
+    assert mon.gap_fraction == gap
+
+
+def test_gap_near_zero_when_all_time_is_dispatched():
+    mon = DispatchGapMonitor()
+    mon.begin_window()
+    with mon.dispatch():
+        time.sleep(0.05)
+    gap = mon.end_window()
+    assert gap < 0.2
+
+
+def test_gap_fraction_averages_windows():
+    mon = DispatchGapMonitor()
+    for _ in range(3):
+        mon.begin_window()
+        with mon.dispatch():
+            pass
+        mon.end_window()
+    assert len(mon.windows) == 3
+    assert 0.0 <= mon.gap_fraction <= 1.0
+
+
+def test_end_window_without_begin_raises():
+    with pytest.raises(RuntimeError):
+        DispatchGapMonitor().end_window()
+
+
+def test_empty_monitor_reports_zero():
+    assert DispatchGapMonitor().gap_fraction == 0.0
+
+
+def test_monitor_emits_timeline_counter(tmp_path):
+    path = tmp_path / "tl.json"
+    tl = Timeline(str(path))
+    mon = DispatchGapMonitor(timeline=tl)
+    mon.begin_window()
+    with mon.dispatch():
+        time.sleep(0.01)
+    mon.end_window()
+    tl.counter("fused_bytes", 123.0)
+    tl.close()
+    doc = json.loads(path.read_text())
+    counters = [ev for ev in doc if ev.get("ph") == "C"]
+    names = {ev["name"] for ev in counters}
+    assert "host_dispatch_gap" in names
+    assert "fused_bytes" in names
+    gap_ev = [ev for ev in counters if ev["name"] == "host_dispatch_gap"][0]
+    assert 0.0 <= gap_ev["args"]["host_dispatch_gap"] <= 1.0

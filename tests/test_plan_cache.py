@@ -1,0 +1,80 @@
+"""Bucket-plan memoization tests (:mod:`horovod_tpu.controller.fusion`).
+
+The fusion planner and the eager grouped path share one
+``ExecutableCache`` keyed on (leaf shapes, dtypes, threshold, process
+set); planning is pure in those, so repeated steps must hit, and abstract
+``jax.ShapeDtypeStruct`` leaves must plan identically to concrete arrays
+(AOT lowering paths plan without materializing parameters).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hv
+from horovod_tpu.controller import fusion
+
+
+def _leaves():
+    return [jnp.zeros((4, 4), jnp.float32),
+            jnp.zeros((8,), jnp.bfloat16),
+            jnp.zeros((3, 2), jnp.float32)]
+
+
+def test_plan_buckets_memoizes_identical_shapes(hvd):
+    fusion.clear_plan_cache()
+    p1 = fusion.plan_buckets(_leaves())
+    s1 = fusion.plan_cache_stats()
+    assert s1["misses"] >= 1
+    p2 = fusion.plan_buckets(_leaves())
+    s2 = fusion.plan_cache_stats()
+    assert p2 is p1  # the cached plan object itself
+    assert s2["hits"] == s1["hits"] + 1
+    assert s2["misses"] == s1["misses"]
+
+
+def test_plan_buckets_threshold_in_key(hvd):
+    fusion.clear_plan_cache()
+    a = fusion.plan_buckets(_leaves(), threshold_bytes=1 << 20)
+    b = fusion.plan_buckets(_leaves(), threshold_bytes=1 << 10)
+    assert fusion.plan_cache_stats()["misses"] == 2
+    assert a is not b
+
+
+def test_plan_buckets_accepts_shape_dtype_structs(hvd):
+    """S2: abstract leaves plan identically to concrete arrays -- and
+    share the same cache entry (the key is shapes+dtypes only)."""
+    fusion.clear_plan_cache()
+    concrete = _leaves()
+    abstract = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in concrete]
+    pa = fusion.plan_buckets(abstract)
+    pc = fusion.plan_buckets(concrete)
+    assert pc is pa
+    s = fusion.plan_cache_stats()
+    assert (s["hits"], s["misses"]) == (1, 1)
+    # Two f32 leaves share a bucket; the bf16 leaf gets its own.
+    assert pa.num_leaves == 3
+    assert sorted(len(lvs) for _dt, lvs in pa.buffers) == [1, 2]
+
+
+def test_eager_grouped_allreduce_hits_plan_cache(hvd, n_devices):
+    fusion.clear_plan_cache()
+    rng = np.random.RandomState(0)
+    xs = [jnp.asarray(rng.randn(n_devices, 4).astype(np.float32)),
+          jnp.asarray(rng.randn(n_devices, 2, 3).astype(np.float32))]
+    hv.grouped_allreduce(xs, hv.Sum)
+    m1 = fusion.plan_cache_stats()["misses"]
+    hv.grouped_allreduce([x + 1 for x in xs], hv.Sum)
+    s = fusion.plan_cache_stats()
+    assert s["misses"] == m1       # same shapes: no replan
+    assert s["hits"] >= 1
+
+
+def test_plan_cache_capacity_evicts(hvd):
+    fusion.clear_plan_cache()
+    cap = fusion._get_plan_cache().capacity
+    for i in range(cap + 2):
+        fusion.plan_buckets([jnp.zeros((i + 1,), jnp.float32)])
+    s = fusion.plan_cache_stats()
+    assert s["evictions"] >= 2
+    assert s["size"] <= cap

@@ -1,0 +1,205 @@
+"""Steps-per-execution scan runner tests: k-step bitwise parity with the
+single-step path, buffer donation of the compiled executables, env/keras
+plumbing, and the stacked-batch helpers.
+
+Parity model: ``make_train_loop`` scans the EXACT ``make_train_step``
+closure (``training._build_local_step``), so k scanned steps must match k
+sequential step calls bit for bit -- params, optimizer state, batch stats,
+and the loss history.  Donation note: ``hvd.replicate`` outputs can alias
+already-on-device inputs, so every run here stages its initial state
+through fresh numpy copies before replicating.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hv
+
+
+def _quadratic_loss(p, b):
+    return jnp.mean((b[0] @ p["w"] + p["b"] - b[1]) ** 2)
+
+
+def _init_state(opt):
+    rng = np.random.RandomState(0)
+    params = {"w": rng.randn(6, 4).astype(np.float32),
+              "b": np.zeros((4,), np.float32)}
+    opt_state = jax.tree.map(np.asarray, opt.init(params))
+    return params, opt_state
+
+
+def _fresh(tree):
+    """Replicated copy that shares no buffers with ``tree``."""
+    return hv.replicate(jax.tree.map(np.copy, tree))
+
+
+def test_scan_loop_matches_sequential_steps_bitwise(hvd, n_devices):
+    k = 3
+    opt = hv.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    params0, opt_state0 = _init_state(opt)
+    rng = np.random.RandomState(1)
+    xs = rng.randn(k, 16, 6).astype(np.float32)
+    ys = rng.randn(k, 16, 4).astype(np.float32)
+
+    step = hv.make_train_step(_quadratic_loss, opt)
+    p, o = _fresh(params0), _fresh(opt_state0)
+    losses_seq = []
+    for i in range(k):
+        p, o, loss = step(p, o, hv.shard_batch((xs[i], ys[i])))
+        losses_seq.append(np.asarray(loss))
+    p_seq = jax.tree.map(np.asarray, p)
+    o_seq = jax.tree.map(np.asarray, o)
+
+    loop = hv.make_train_loop(_quadratic_loss, opt, steps_per_execution=k)
+    p2, o2 = _fresh(params0), _fresh(opt_state0)
+    batches = hv.shard_steps((jnp.asarray(xs), jnp.asarray(ys)))
+    p2, o2, losses = loop(p2, o2, batches)
+
+    for a, b in zip(jax.tree.leaves(p_seq),
+                    jax.tree.leaves(jax.tree.map(np.asarray, p2))):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(o_seq),
+                    jax.tree.leaves(jax.tree.map(np.asarray, o2))):
+        np.testing.assert_array_equal(a, b)
+    assert losses.shape == (k,)
+    np.testing.assert_array_equal(np.asarray(losses),
+                                  np.stack(losses_seq))
+
+
+def test_flax_scan_loop_matches_sequential_steps_bitwise(hvd, n_devices):
+    import flax.linen as nn
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Dense(8)(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             momentum=0.9)(x)
+            return nn.Dense(4)(x)
+
+    k = 2
+    model = TinyBN()
+    rng = np.random.RandomState(2)
+    xs = rng.randn(k, 16, 6).astype(np.float32)
+    ys = rng.randint(0, 4, size=(k, 16)).astype(np.int32)
+    variables = jax.tree.map(
+        np.asarray, model.init(jax.random.PRNGKey(0),
+                               jnp.asarray(xs[0][:2])))
+    params0, stats0 = variables["params"], variables["batch_stats"]
+    opt = hv.DistributedOptimizer(optax.sgd(0.05, momentum=0.9))
+    opt_state0 = jax.tree.map(np.asarray, opt.init(params0))
+
+    step = hv.make_flax_train_step(model.apply, opt)
+    p, s, o = _fresh(params0), _fresh(stats0), _fresh(opt_state0)
+    losses_seq = []
+    for i in range(k):
+        p, s, o, loss = step(p, s, o, hv.shard_batch((xs[i], ys[i])))
+        losses_seq.append(np.asarray(loss))
+    seq = jax.tree.map(np.asarray, (p, s, o))
+
+    loop = hv.make_flax_train_loop(model.apply, opt,
+                                   steps_per_execution=k)
+    p2, s2, o2 = _fresh(params0), _fresh(stats0), _fresh(opt_state0)
+    batches = hv.shard_steps((jnp.asarray(xs), jnp.asarray(ys)))
+    p2, s2, o2, losses = loop(p2, s2, o2, batches)
+
+    for a, b in zip(jax.tree.leaves(seq),
+                    jax.tree.leaves(jax.tree.map(np.asarray,
+                                                 (p2, s2, o2)))):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(losses),
+                                  np.stack(losses_seq))
+
+
+def _abstract(tree, sharding):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype,
+                                       sharding=sharding), tree)
+
+
+def test_train_step_and_loop_donate_buffers(hvd):
+    """Donation audit: the compiled single step AND the compiled k-step
+    loop alias params+opt-state inputs to outputs (in-place update --
+    without it a k-step window would hold two copies of the state)."""
+    from horovod_tpu.utils.scaling import has_buffer_donation
+
+    opt = hv.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    params0, opt_state0 = _init_state(opt)
+    rep = hv.replicated_sharding()
+    bat = hv.batch_sharding()
+
+    step = hv.make_train_step(_quadratic_loss, opt)
+    x = jax.ShapeDtypeStruct((16, 6), jnp.float32, sharding=bat)
+    y = jax.ShapeDtypeStruct((16, 4), jnp.float32, sharding=bat)
+    txt = step.lower(_abstract(params0, rep), _abstract(opt_state0, rep),
+                     (x, y)).compile().as_text()
+    assert has_buffer_donation(txt)
+
+    k = 4
+    loop = hv.make_train_loop(_quadratic_loss, opt, steps_per_execution=k)
+    sb = hv.stacked_batch_sharding()
+    xk = jax.ShapeDtypeStruct((k, 16, 6), jnp.float32, sharding=sb)
+    yk = jax.ShapeDtypeStruct((k, 16, 4), jnp.float32, sharding=sb)
+    txt = loop.lower(_abstract(params0, rep), _abstract(opt_state0, rep),
+                     (xk, yk)).compile().as_text()
+    assert has_buffer_donation(txt)
+
+    # donate=False must really opt out.
+    plain = hv.make_train_loop(_quadratic_loss, opt, steps_per_execution=k,
+                               donate=False)
+    txt = plain.lower(_abstract(params0, rep), _abstract(opt_state0, rep),
+                      (xk, yk)).compile().as_text()
+    assert not has_buffer_donation(txt)
+
+
+def test_train_loop_rejects_bad_steps(hvd):
+    opt = hv.DistributedOptimizer(optax.sgd(0.1))
+    with pytest.raises(ValueError, match="steps_per_execution"):
+        hv.make_train_loop(_quadratic_loss, opt, steps_per_execution=0)
+
+
+def test_stack_and_shard_steps_helpers(hvd):
+    batches = [{"x": np.full((16, 3), i, np.float32)} for i in range(3)]
+    stacked = hv.stack_steps(batches)
+    assert stacked["x"].shape == (3, 16, 3)
+    np.testing.assert_array_equal(np.asarray(stacked["x"][2]),
+                                  batches[2]["x"])
+    placed = hv.shard_steps(stacked)
+    sb = hv.stacked_batch_sharding()
+    assert placed["x"].sharding.is_equivalent_to(sb, 3)
+    with pytest.raises(ValueError):
+        hv.stack_steps([])
+
+
+def test_steps_per_execution_env_and_keras_pickup(monkeypatch):
+    """HOROVOD_STEPS_PER_EXEC flows config -> steps_per_execution() ->
+    keras.compile_args() / torch shim; an explicit override wins."""
+    from horovod_tpu.training import steps_per_execution
+
+    monkeypatch.setenv("HOROVOD_STEPS_PER_EXEC", "6")
+    hv.shutdown()
+    hv.init()
+    try:
+        assert steps_per_execution() == 6
+        from horovod_tpu import keras as hvk
+        from horovod_tpu import torch_api
+        assert hvk.compile_args()["steps_per_execution"] == 6
+        assert hvk.compile_args(
+            steps_per_execution=2)["steps_per_execution"] == 2
+        assert torch_api.steps_per_execution() == 6
+
+        # make_train_loop(None) resolves the same knob.
+        opt = hv.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+        params0, opt_state0 = _init_state(opt)
+        loop = hv.make_train_loop(_quadratic_loss, opt)
+        rng = np.random.RandomState(3)
+        xs = jnp.asarray(rng.randn(6, 16, 6).astype(np.float32))
+        ys = jnp.asarray(rng.randn(6, 16, 4).astype(np.float32))
+        _, _, losses = loop(_fresh(params0), _fresh(opt_state0),
+                            hv.shard_steps((xs, ys)))
+        assert losses.shape == (6,)
+    finally:
+        hv.shutdown()
