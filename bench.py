@@ -62,6 +62,17 @@ SCAN_K = int(os.environ.get("HVD_TPU_STEPS_PER_EXEC",
 SCANLOOP = _env_on("BENCH_SCANLOOP") or SCAN_K > 1
 if SCANLOOP and SCAN_K < 1:
     SCAN_K = 4
+# BENCH_OVERLAP=1 (or HOROVOD_MICROBATCHES>1) benches the backward-overlap
+# microbatched exchange (make_flax_train_step(microbatches=k)): per-bucket
+# reduce-scatter of microbatch i scheduled against backward compute of
+# microbatch i+1, reported alongside the exchange-overlap fraction
+# (timeline.OverlapMonitor).  Different config string -> vs_baseline null.
+MICRO_K = int(os.environ.get("HVD_TPU_MICROBATCHES",
+                             os.environ.get("HOROVOD_MICROBATCHES", "0"))
+              or 0)
+OVERLAP = _env_on("BENCH_OVERLAP") or MICRO_K > 1
+if OVERLAP and MICRO_K < 1:
+    MICRO_K = 4
 # BENCH_TINY=1 swaps RN50 for a one-stage 8-filter ResNet on 32x32 inputs:
 # a plumbing smoke config (CPU-runnable), never comparable to the baseline.
 TINY = _env_on("BENCH_TINY")
@@ -70,7 +81,8 @@ TINY = _env_on("BENCH_TINY")
 def _config() -> str:
     base = f"tinycnn_batch{BATCH}" if TINY else f"batch{BATCH}_s2d_bf16"
     return (base + ("_zero1" if ZERO else "")
-            + (f"_scanloop{SCAN_K}" if SCANLOOP else ""))
+            + (f"_scanloop{SCAN_K}" if SCANLOOP else "")
+            + (f"_microbatch{MICRO_K}" if OVERLAP else ""))
 FLOPS_PER_IMAGE = 12.3e9  # RN50 fwd+bwd estimate
 V5E_BF16_PEAK = 197e12
 
@@ -87,6 +99,13 @@ def _watchdog():
 
 def main():
     threading.Thread(target=_watchdog, daemon=True).start()
+    if OVERLAP and ZERO:
+        sys.exit("BENCH_OVERLAP / HOROVOD_MICROBATCHES>1 is incompatible "
+                 "with HOROVOD_ZERO=1 (the ZeRO arena exchange is already "
+                 "shard-based)")
+    if OVERLAP and SCANLOOP:
+        sys.exit("BENCH_OVERLAP and BENCH_SCANLOOP are separate configs; "
+                 "set exactly one")
 
     import jax
     import jax.numpy as jnp
@@ -140,6 +159,7 @@ def main():
         step = make_flax_train_step(model.apply, opt)
 
     gap_fraction = None
+    overlap_fraction = None
     if SCANLOOP:
         # Steps-per-execution runner: SCAN_K steps per dispatch through
         # ONE lax.scan executable (same step body bitwise -- training.py),
@@ -175,6 +195,61 @@ def main():
               f"host dispatch-gap fraction "
               f"{[round(g, 4) for g in monitor.windows]} "
               f"(mean {gap_fraction:.4f})", file=sys.stderr)
+    elif OVERLAP:
+        # Backward-overlap microbatched exchange.  The overlap fraction is
+        # self-calibrating: compute_s comes from a no-exchange (bare
+        # optimizer) step, comm_s from the single-shot step where the
+        # monolithic post-backward exchange is fully exposed --
+        # comm_s = t_singleshot - t_bare.  The monitor then reports how
+        # much of that budget the microbatched step hides.
+        from horovod_tpu.timeline import OverlapMonitor
+        batch = hvd.shard_batch((x, y))
+        step = make_flax_train_step(model.apply, opt, microbatches=MICRO_K)
+
+        def _per_step(fn, p, bs, st, reps=max(4, STEPS // 2)):
+            for _ in range(3):
+                p, bs, st, loss = fn(p, bs, st, batch)
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                p, bs, st, loss = fn(p, bs, st, batch)
+            float(loss)
+            return (time.perf_counter() - t0) / reps
+
+        def _clone(t):
+            return jax.tree.map(jnp.copy, t)
+
+        bare_opt = optax.sgd(0.1, momentum=0.9)
+        bare_step = make_flax_train_step(model.apply, bare_opt)
+        compute_s = _per_step(bare_step, _clone(params), _clone(batch_stats),
+                              hvd.replicate(bare_opt.init(params)))
+        single_step = make_flax_train_step(model.apply, opt)
+        single_s = _per_step(single_step, _clone(params),
+                             _clone(batch_stats), _clone(opt_state))
+        comm_s = max(0.0, single_s - compute_s)
+
+        monitor = OverlapMonitor(compute_s, comm_s)
+        for _ in range(2):  # warmup: compile + one warm window
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, batch)
+        float(loss)
+        rates = []
+        for _ in range(WINDOWS):
+            monitor.begin_window()
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                params, batch_stats, opt_state, loss = step(
+                    params, batch_stats, opt_state, batch)
+            float(loss)  # forces the full step chain
+            dt = time.perf_counter() - t0
+            monitor.end_window(STEPS)
+            rates.append(STEPS * global_batch / dt / n)
+        overlap_fraction = monitor.overlap_fraction
+        print(f"# overlap k={MICRO_K}: compute {compute_s*1e3:.1f} ms, "
+              f"single-shot {single_s*1e3:.1f} ms (exposed comm "
+              f"{comm_s*1e3:.1f} ms); exchange-overlap fraction "
+              f"{[round(w, 4) for w in monitor.windows]} "
+              f"(mean {overlap_fraction:.4f})", file=sys.stderr)
     else:
         batch = hvd.shard_batch((x, y))
 
@@ -228,6 +303,9 @@ def main():
         result["zero"] = zero_stats
     if gap_fraction is not None:
         result["dispatch_gap"] = round(gap_fraction, 4)
+    if overlap_fraction is not None:
+        result["overlap_fraction"] = round(overlap_fraction, 4)
+        result["microbatches"] = MICRO_K
     print(json.dumps(result), flush=True)
     os._exit(0)  # skip slow atexit teardown; result is already printed
 

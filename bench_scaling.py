@@ -27,6 +27,11 @@ Usage::
     python bench_scaling.py --models rn50-chunked --ns 8 16
                          # chunked RS+AG exchange (HOROVOD_EXCHANGE_CHUNK_MB)
                          # -- same eq-AR payload, zero bucket all-reduces
+    python bench_scaling.py --models rn50-overlap --ns 8 16
+                         # backward-overlap microbatched exchange
+                         # (microbatches=4): k per-bucket reduce-scatters
+                         # interleaved with backward + one final all-gather
+                         # -- eq payload (k+1)/2 x the padded bucket bytes
     python bench_scaling.py --worker rn50 8  # (internal) one subprocess
 
 Prints one summary JSON line (machine-readable gate) after the tables.
@@ -72,6 +77,10 @@ MEASURED_STEP_SECONDS = {
 # step time.  The mechanism stays for future variant configs.)
 _STEP_ALIASES = {}
 
+# Microbatch count for the -overlap variant (bench.py's counterpart is
+# BENCH_OVERLAP=1 / HOROVOD_MICROBATCHES=4).
+OVERLAP_K = 4
+
 # CNN cases: (constructor kwargs, image size).  Spatial size does not
 # affect gradient payload EXCEPT for VGG (the 224x224 fc1 holds most of
 # its 138M params), so VGG compiles at full resolution; Inception needs
@@ -113,6 +122,9 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
     chunked = model.endswith("-chunked")
     if chunked:
         cnn_base = model[:-len("-chunked")]
+    overlap = model.endswith("-overlap")
+    if overlap:
+        cnn_base = model[:-len("-overlap")]
     if cnn_base in _CNN_CASES:
         from horovod_tpu import models as zoo
         # fp32 params = the bench configuration's wire dtype; the -fp8
@@ -128,7 +140,9 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         ctor, kwargs, side = _CNN_CASES[cnn_base]
         m = getattr(zoo, ctor)(num_classes=1000, dtype=jnp.float32,
                                **kwargs)
-        pcb = per_chip_batch or 2
+        # The -overlap variant splits the per-chip batch into OVERLAP_K
+        # microbatches, so it needs a divisible per-chip batch.
+        pcb = per_chip_batch or (OVERLAP_K if overlap else 2)
         x = jax.ShapeDtypeStruct((pcb * n, side, side, 3), jnp.float32)
         y = jax.ShapeDtypeStruct((pcb * n,), jnp.int32)
         variables = jax.eval_shape(
@@ -142,7 +156,8 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
             compression=hvd.Compression.fp8 if fp8
             else hvd.Compression.none)
         opt_state = jax.eval_shape(opt.init, params)
-        step = make_flax_train_step(m.apply, opt)
+        step = make_flax_train_step(
+            m.apply, opt, microbatches=OVERLAP_K if overlap else None)
         args = (abstract(params, rep), abstract(stats, rep),
                 abstract(opt_state, rep),
                 (jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=bat),
@@ -158,18 +173,41 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         # the equivalent-allreduce payload must MATCH the plain rn50 row
         # (chunk padding is <= n-1 elements per bucket tail: noise).
         buckets = len(plan_buckets(grad_leaves).buffers)
+        stats_bytes = sum(l.size * l.dtype.itemsize
+                          for l in jax.tree.leaves(stats))
         if fp8:
             expected_emitted = None
-        elif chunked:
+        elif chunked or overlap:
+            # Bucket exchange is RS(+AG), not all-reduces: only the
+            # BN-stat and loss all-reduces remain.
             expected_emitted = stats_leaves + 1
         else:
             expected_emitted = buckets + stats_leaves + 1
         grad_bytes = sum(l.size * l.dtype.itemsize for l in grad_leaves)
         if fp8:
             grad_bytes //= 4  # e4m3 wire (+ one f32 scale per bucket)
-        payload = grad_bytes + \
-            sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(stats)) \
-            + 4
+        if overlap:
+            # Backward-overlap exchange: per bucket, OVERLAP_K per-
+            # microbatch reduce-scatters + ONE finalize all-gather, each
+            # over the bucket padded to the microbatch quantum
+            # (lcm(n, 256) -- mesh-invariant for n=8/16/32, so the eq
+            # payload spread across mesh sizes is exactly zero).  RS(P)
+            # and AG(P) each move one half-allreduce of wire, so the
+            # equivalent-allreduce payload is (k+1)/2 x the padded bucket
+            # bytes; the plan walks leaves in REVERSE (bucket-ready
+            # order), which regroups but never resizes the total.
+            from horovod_tpu.collectives.ops import microbatch_pad_quantum
+            rspec = plan_buckets(grad_leaves, reverse=True)
+            buckets = len(rspec.buffers)
+            q = microbatch_pad_quantum(n)
+            padded_bytes = 0
+            for dt, lspecs in rspec.buffers:
+                size = sum(s.size for s in lspecs)
+                padded = size + (-size) % q
+                padded_bytes += padded * jnp.dtype(dt).itemsize
+            payload = (OVERLAP_K + 1) * padded_bytes / 2 + stats_bytes + 4
+        else:
+            payload = grad_bytes + stats_bytes + 4
     elif model in ("bert-large", "bert-base", "bert-tiny",
                    "bert-large-fp8"):
         from horovod_tpu.models import (BERT_BASE, BERT_LARGE, BERT_TINY,
@@ -405,7 +443,9 @@ def _spawn(model: str, n: int, timeout: int = 2400,
                         "HOROVOD_EXCHANGE_CHUNK_MB",
                         "HVD_TPU_EXCHANGE_CHUNK_MB",
                         "HOROVOD_STEPS_PER_EXEC",
-                        "HVD_TPU_STEPS_PER_EXEC")}
+                        "HVD_TPU_STEPS_PER_EXEC",
+                        "HOROVOD_MICROBATCHES",
+                        "HVD_TPU_MICROBATCHES")}
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", model,
            str(n)]
     if topology:

@@ -65,7 +65,7 @@ from .training import (  # noqa: F401
     shard_batch_from_local, replicate, batch_sharding,
     replicated_sharding, sync_batch_norm,
     make_train_loop, make_flax_train_loop, stack_steps, shard_steps,
-    stacked_batch_sharding, steps_per_execution,
+    stacked_batch_sharding, steps_per_execution, microbatches,
 )
 from .data import DevicePrefetcher, prefetch_to_device  # noqa: F401
 

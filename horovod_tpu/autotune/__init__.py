@@ -52,6 +52,15 @@ PR 2 adds the latency-hiding axes:
   :meth:`Autotuner.steps_per_exec` when it is (re)built, and the score
   loop in ``training._maybe_tuned`` normalizes per-step time by k.
 
+PR 3 adds the backward-overlap axis:
+
+* **microbatches** (OPT-IN via ``HOROVOD_AUTOTUNE_MICROBATCH=1``): how
+  many sub-batches the train step splits the batch into for the
+  per-bucket comm/compute overlap (``training.py``, ``microbatches=``).
+  BUILD-time like steps-per-exec (k changes the unrolled step
+  structure), so it is excluded from ``trace_key()``; closed on
+  zero-configured runs (the two exchanges are build-time exclusive).
+
 The response-cache toggle stays collapsed: an executable-cache hit is
 always strictly cheaper than a retrace, so there is nothing to search.
 """
@@ -74,11 +83,11 @@ MAX_SAMPLES = 12
 COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8 = 0, 1, 2, 3
 
 
-def _grid(thresholds, cycles, hiers, comps, zeros, chunks,
-          steps) -> List[Tuple[int, float, int, int, int, int, int]]:
-    return [(t, c, h, k, z, ch, sp) for t in thresholds for c in cycles
+def _grid(thresholds, cycles, hiers, comps, zeros, chunks, steps,
+          micros) -> List[Tuple[int, float, int, int, int, int, int, int]]:
+    return [(t, c, h, k, z, ch, sp, mb) for t in thresholds for c in cycles
             for h in hiers for k in comps for z in zeros for ch in chunks
-            for sp in steps]
+            for sp in steps for mb in micros]
 
 
 def _mesh_is_two_level() -> bool:
@@ -144,15 +153,27 @@ class Autotuner:
             steps = sorted({1, 4, 16, configured_steps})
         else:
             steps = [configured_steps]
+        # Microbatch axis (opt-in, HOROVOD_AUTOTUNE_MICROBATCH=1): the
+        # backward-overlap exchange's k (training.py, microbatches=).
+        # BUILD-time like steps-per-exec -- k changes the unrolled step
+        # structure, so the step is rebuilt, not retraced, and the axis is
+        # excluded from trace_key.  Zero-configured runs pin k=1 (the two
+        # exchanges are mutually exclusive at build time).
+        configured_micro = max(1, int(getattr(config, "microbatches", 1)))
+        if _env_bool("AUTOTUNE_MICROBATCH") and not configured_zero:
+            micros = sorted({1, 2, 4, configured_micro})
+        else:
+            micros = [configured_micro]
         self.grid = _grid(sorted(self.candidates), sorted(cycles), hiers,
-                          comps, zeros, chunks, steps)
+                          comps, zeros, chunks, steps, micros)
         self.steps_per_sample = steps_per_sample
         self.max_samples = min(max_samples, len(self.grid))
         self.log_path = config.autotune_log
+        self.warm_start_skipped = 0
         self._opt = BayesianOptimizer(
             [(float(t), c, float(h), float(k), float(z), float(ch),
-              float(sp))
-             for t, c, h, k, z, ch, sp in self.grid])
+              float(sp), float(mb))
+             for t, c, h, k, z, ch, sp, mb in self.grid])
         self._samples: List[tuple] = []
         self._best: Optional[Tuple[int, float]] = None
         self._step = 0
@@ -168,7 +189,7 @@ class Autotuner:
         self._idx = self._next_index()
 
     # -- current knobs ----------------------------------------------------
-    def _current(self) -> Tuple[int, float, int, int, int, int, int]:
+    def _current(self) -> Tuple[int, float, int, int, int, int, int, int]:
         return self._best or self.grid[self._idx]
 
     def fusion_threshold(self) -> int:
@@ -210,14 +231,21 @@ class Autotuner:
         part of :meth:`trace_key` (it changes the loop's input shapes)."""
         return int(self._current()[6])
 
+    def microbatches(self) -> int:
+        """Backward-overlap microbatch count of the current sample.
+        Applied when a train step is (re)built (``training.microbatches``
+        resolver) -- a BUILD-time knob like :meth:`steps_per_exec`, not
+        part of :meth:`trace_key`."""
+        return int(self._current()[7])
+
     def trace_key(self) -> tuple:
         """The TRACE-TIME knobs of the current sample (the compiled step
         cache in ``training.make_train_step`` keys on this).  Cycle time
         is deliberately excluded: it is a RUNTIME knob applied through
         ``_apply_to_batcher``, and keying on it would recompile an
-        identical trace for every cycle-axis sample.  Steps-per-exec is
-        likewise excluded (a build-time structural knob)."""
-        thr, _cyc, hier, comp, zero, chunk, _sp = self._current()
+        identical trace for every cycle-axis sample.  Steps-per-exec and
+        microbatches are likewise excluded (build-time structural knobs)."""
+        thr, _cyc, hier, comp, zero, chunk, _sp, _mb = self._current()
         return (thr, hier, comp, zero, chunk)
 
     @property
@@ -298,42 +326,74 @@ class Autotuner:
         the broadcast protocol and deadlock.
         """
         obs: List[tuple] = []
+        skipped = 0
         if self.log_path and os.path.exists(self.log_path):
             try:
                 with open(self.log_path) as f:
-                    for line in f:
-                        if line.startswith(("fusion", "#")):
-                            continue
-                        parts = line.strip().split(",")
-                        if len(parts) == 3:     # pre-round-3 log format
-                            cfg = (int(float(parts[0])), float(parts[1]),
-                                   0, COMP_DEFAULT, 0, 0, 1)
-                            score = float(parts[2])
-                        elif len(parts) == 5:   # rounds 3-5: no zero axis
-                            cfg = (int(float(parts[0])), float(parts[1]),
-                                   int(float(parts[2])),
-                                   int(float(parts[3])), 0, 0, 1)
-                            score = float(parts[4])
-                        elif len(parts) == 6:   # PR-1: zero, no chunk/steps
-                            cfg = (int(float(parts[0])), float(parts[1]),
-                                   int(float(parts[2])),
-                                   int(float(parts[3])),
-                                   int(float(parts[4])), 0, 1)
-                            score = float(parts[5])
-                        elif len(parts) >= 8:   # PR-2: chunk + steps axes
-                            cfg = (int(float(parts[0])), float(parts[1]),
-                                   int(float(parts[2])),
-                                   int(float(parts[3])),
-                                   int(float(parts[4])),
-                                   int(float(parts[5])),
-                                   int(float(parts[6])))
-                            score = float(parts[7])
-                        else:
-                            continue
-                        if cfg in self.grid:
-                            obs.append((self.grid.index(cfg), score))
-            except (OSError, ValueError):  # pragma: no cover - corrupt log
-                obs = []
+                    lines = list(f)
+            except OSError:  # pragma: no cover - unreadable log
+                lines = []
+            for line in lines:
+                if line.startswith(("fusion", "#")) or not line.strip():
+                    continue
+                parts = line.strip().split(",")
+                # Each malformed row is SKIPPED, never fatal: one corrupt
+                # line (a half-written row after a crash, a hand edit, a
+                # future format) must not throw away the whole warm start
+                # or crash the tuner.  Skips are counted and warned once.
+                try:
+                    if len(parts) == 3:     # pre-round-3 log format
+                        cfg = (int(float(parts[0])), float(parts[1]),
+                               0, COMP_DEFAULT, 0, 0, 1, 1)
+                        score = float(parts[2])
+                    elif len(parts) == 5:   # rounds 3-5: no zero axis
+                        cfg = (int(float(parts[0])), float(parts[1]),
+                               int(float(parts[2])),
+                               int(float(parts[3])), 0, 0, 1, 1)
+                        score = float(parts[4])
+                    elif len(parts) == 6:   # PR-1: zero, no chunk/steps
+                        cfg = (int(float(parts[0])), float(parts[1]),
+                               int(float(parts[2])),
+                               int(float(parts[3])),
+                               int(float(parts[4])), 0, 1, 1)
+                        score = float(parts[5])
+                    elif len(parts) == 8:   # PR-2: chunk + steps axes
+                        cfg = (int(float(parts[0])), float(parts[1]),
+                               int(float(parts[2])),
+                               int(float(parts[3])),
+                               int(float(parts[4])),
+                               int(float(parts[5])),
+                               int(float(parts[6])), 1)
+                        score = float(parts[7])
+                    elif len(parts) == 9:   # PR-3: microbatch axis
+                        cfg = (int(float(parts[0])), float(parts[1]),
+                               int(float(parts[2])),
+                               int(float(parts[3])),
+                               int(float(parts[4])),
+                               int(float(parts[5])),
+                               int(float(parts[6])),
+                               int(float(parts[7])))
+                        score = float(parts[8])
+                    else:                   # unknown column count
+                        skipped += 1
+                        continue
+                except ValueError:          # non-numeric cell
+                    skipped += 1
+                    continue
+                if not np.isfinite(score):
+                    # A NaN/inf score would poison the GP posterior (every
+                    # expected-improvement comparison turns NaN).
+                    skipped += 1
+                    continue
+                if cfg in self.grid:
+                    obs.append((self.grid.index(cfg), score))
+        if skipped:
+            import warnings
+            warnings.warn(
+                f"autotune warm start: skipped {skipped} unusable row(s) "
+                f"in {self.log_path} (unknown column count or NaN/inf "
+                "score)", RuntimeWarning, stacklevel=2)
+        self.warm_start_skipped = skipped
         obs = self._sync(obs)
         for idx, score in obs:
             self._opt.observe(idx, score)
@@ -348,9 +408,9 @@ class Autotuner:
         with open(self.log_path, "w") as f:
             f.write("fusion_threshold_bytes,cycle_time_ms,hierarchical,"
                     "compression,zero,exchange_chunk_bytes,steps_per_exec,"
-                    "score_bytes_per_s\n")
-            for thr, cyc, hier, comp, zero, chunk, sp, score \
+                    "microbatches,score_bytes_per_s\n")
+            for thr, cyc, hier, comp, zero, chunk, sp, mb, score \
                     in self._samples:
                 f.write(f"{thr},{cyc},{hier},{comp},{zero},{chunk},{sp},"
-                        f"{score}\n")
+                        f"{mb},{score}\n")
             f.write("# best," + ",".join(str(v) for v in self._best) + "\n")

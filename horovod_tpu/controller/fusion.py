@@ -101,12 +101,21 @@ def plan_key(leaves: Sequence[Any], threshold_bytes: int,
 
 
 def plan_buckets(leaves: Sequence[Any],
-                 threshold_bytes: Optional[int] = None) -> FusionSpec:
+                 threshold_bytes: Optional[int] = None,
+                 reverse: bool = False) -> FusionSpec:
     """Greedily pack leaves into per-dtype buckets of <= threshold bytes.
 
     Order within a dtype follows leaf order (gradients arrive in reverse
     topological order, which keeps adjacent-layer gradients adjacent in the
     buffer -- same locality the reference's cycle batching produces).
+
+    ``reverse=True`` walks the leaves last-to-first instead: the
+    bucket-READY ordering for the backward-overlap exchange.  Flax/optax
+    trees flatten in parameter (forward) order, so the LAST leaves are the
+    layers whose gradients the backward pass finishes FIRST -- emitting
+    their buckets first matches upstream Horovod's fusion-cycle behaviour
+    (ready gradients go on the wire while earlier layers still compute).
+    Unpack is index-addressed, so leaf recovery is order-independent.
 
     Leaves may be concrete arrays OR abstract ``jax.ShapeDtypeStruct``s
     (anything with ``.shape``/``.dtype``): the plan depends only on shapes
@@ -117,15 +126,20 @@ def plan_buckets(leaves: Sequence[Any],
         threshold_bytes = _threshold()
     leaves = [x if hasattr(x, "dtype") else jnp.asarray(x) for x in leaves]
     cache = _get_plan_cache()
-    key = plan_key(leaves, threshold_bytes)
+    key = plan_key(leaves, threshold_bytes,
+                   extra=("rev",) if reverse else ())
     return cache.get_or_build(
-        key, lambda: _plan_buckets_uncached(leaves, threshold_bytes))
+        key, lambda: _plan_buckets_uncached(leaves, threshold_bytes, reverse))
 
 
 def _plan_buckets_uncached(leaves: Sequence[Any],
-                           threshold_bytes: int) -> FusionSpec:
+                           threshold_bytes: int,
+                           reverse: bool = False) -> FusionSpec:
     by_dtype: dict = {}
-    for i, x in enumerate(leaves):
+    indexed = list(enumerate(leaves))
+    if reverse:
+        indexed.reverse()
+    for i, x in indexed:
         by_dtype.setdefault(jnp.dtype(x.dtype), []).append(
             _LeafSpec(i, tuple(x.shape), int(np.prod(x.shape, dtype=np.int64))))
     buffers: List[Tuple[Any, Tuple[_LeafSpec, ...]]] = []

@@ -89,6 +89,14 @@ class Config:
     # executable, so they cost one host dispatch and one device->host fence.
     steps_per_exec: int = 1
 
+    # Microbatched backward-overlap exchange (HOROVOD_MICROBATCHES):
+    # default k for train steps built without an explicit ``microbatches``
+    # argument.  The per-step batch splits into k sub-batches inside ONE
+    # compiled executable; each sub-batch's gradient buckets reduce-scatter
+    # while the next sub-batch's backward pass is still running, so the
+    # latency-hiding scheduler can overlap wire time with FLOPs.
+    microbatches: int = 1
+
     # Chunked gradient exchange (HOROVOD_EXCHANGE_CHUNK_MB, megabytes;
     # 0 disables).  Decomposes each fusion bucket's allreduce into
     # chunk-sized reduce-scatter + all-gather pairs so XLA's latency-hiding
@@ -227,6 +235,7 @@ def load_config() -> Config:
         autotune_log=_env("AUTOTUNE_LOG"),
         zero_stage=_env_int("ZERO", 0),
         steps_per_exec=_env_int("STEPS_PER_EXEC", 1),
+        microbatches=_env_int("MICROBATCHES", 1),
         exchange_chunk_bytes=_env_int("EXCHANGE_CHUNK_MB", 0) * _MiB,
         stall_check_disable=_env_bool("STALL_CHECK_DISABLE"),
         # Upstream spells these *_TIME_SECONDS; accept both spellings.

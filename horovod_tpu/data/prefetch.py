@@ -79,6 +79,7 @@ class DevicePrefetcher:
         self.dropped_remainder = 0
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=self._produce, args=(iter(iterator),),
             name="hvd-prefetch", daemon=True)
@@ -123,6 +124,12 @@ class DevicePrefetcher:
                     return
             self._put(_Stop())
         except BaseException as e:  # surface in the consumer thread
+            # Record the error BEFORE the best-effort sentinel enqueue: if
+            # the sentinel is lost (queue torn down, nested failure while
+            # putting), the consumer's timeout path in ``__next__`` still
+            # surfaces the original exception instead of blocking forever
+            # on a starved queue.
+            self._error = e
             self._put(_Stop(e))
 
     # -- consumer ---------------------------------------------------------
@@ -132,7 +139,23 @@ class DevicePrefetcher:
     def __next__(self) -> Any:
         if self._stop.is_set():
             raise StopIteration
-        item = self._q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                # FIFO is preserved: queued good batches (and an enqueued
+                # error sentinel) always drain first.  Only once the queue
+                # is starved do we consult the producer's state -- a
+                # recorded error re-raises here even when its sentinel
+                # never landed; a dead producer with no error is a clean
+                # end of input.
+                if self._error is not None:
+                    self._stop.set()
+                    raise self._error
+                if not self._thread.is_alive():
+                    self._stop.set()
+                    raise StopIteration
         if isinstance(item, _Stop):
             self._stop.set()
             if item.error is not None:

@@ -176,6 +176,18 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         # zero_stage=1 replaces this allreduce with a reduce-scatter; the
         # zero path detects the wrap through this marker and rejects it.
         update._hvd_allreduce = True
+        # The microbatched step (training.py, microbatches=k>1) unwraps the
+        # optimizer and runs the exchange itself (per-microbatch shard
+        # reduce-scatter + one allgather), so it needs the inner optimizer
+        # and the exchange parameters this wrap would have applied.  Only
+        # the plain (non-accumulating) wrap exposes them: combining k>1
+        # with backward_passes_per_step>1 is rejected at build time.
+        update._hvd_inner = optimizer
+        update._hvd_exchange = dict(
+            op=op, compression=compression, fusion_threshold=fusion_threshold,
+            axes=axes, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
         return optax.GradientTransformation(init, update)
 
     n = backward_passes_per_step

@@ -192,6 +192,72 @@ class DispatchGapMonitor:
         return float(sum(self.windows) / len(self.windows))
 
 
+class OverlapMonitor:
+    """Per-window exchange-overlap fraction (the backward-overlap metric).
+
+    The microbatched exchange (``training.py``, ``microbatches=k``) exists
+    to hide gradient wire time behind backward compute.  This monitor
+    reports how much of a known communication budget was actually hidden:
+    give it the window's pure-compute time per step (``compute_s``, e.g.
+    measured at n=1 or with the exchange disabled) and the predicted
+    exchange time per step (``comm_s``, e.g. payload bytes / link
+    bandwidth); per window of ``steps`` steps,
+
+        exposed  = max(0, wall/steps - compute_s)   # comm NOT hidden
+        hidden   = max(0, comm_s - exposed)
+        fraction = hidden / comm_s                  # in [0, 1]
+
+    1.0 means the exchange vanished behind compute (perfect overlap);
+    0.0 means every wire second extended the step (no overlap -- the
+    monolithic post-backward exchange).  ``comm_s <= 0`` (single chip, no
+    exchange) records 0.0 by convention: there is nothing to hide.
+
+    Feeds ``bench.py``'s ``overlap`` config and, when a
+    :class:`Timeline` is active, an ``exchange_overlap`` counter track --
+    the overlap analogue of :class:`DispatchGapMonitor`.
+    """
+
+    def __init__(self, compute_s: float, comm_s: float,
+                 timeline: Optional[Timeline] = None):
+        if compute_s < 0 or comm_s < 0:
+            raise ValueError("compute_s and comm_s must be >= 0")
+        self.compute_s = compute_s
+        self.comm_s = comm_s
+        self.timeline = timeline
+        self.windows: list = []
+        self._t0: Optional[float] = None
+
+    def begin_window(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end_window(self, steps: int) -> float:
+        """Close a window of ``steps`` steps; returns (and records) its
+        overlap fraction."""
+        if self._t0 is None:
+            raise RuntimeError("end_window() without begin_window()")
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        wall = time.perf_counter() - self._t0
+        self._t0 = None
+        if self.comm_s <= 0.0:
+            frac = 0.0
+        else:
+            exposed = max(0.0, wall / steps - self.compute_s)
+            hidden = max(0.0, self.comm_s - exposed)
+            frac = min(hidden / self.comm_s, 1.0)
+        self.windows.append(frac)
+        if self.timeline is not None:
+            self.timeline.counter("exchange_overlap", frac)
+        return frac
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Mean overlap fraction over all closed windows (0.0 if none)."""
+        if not self.windows:
+            return 0.0
+        return float(sum(self.windows) / len(self.windows))
+
+
 @contextlib.contextmanager
 def device_trace(logdir: str):
     """Capture a device-side profiler trace alongside the semantic

@@ -21,8 +21,10 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import math
+
 from .collectives import ops as _ops
-from .collectives.reduce_op import Average
+from .collectives.reduce_op import Average, Sum
 from .core import basics as _basics
 from .optim import zero as _zero
 
@@ -151,6 +153,73 @@ def _resolve_steps(k: Optional[int]) -> int:
     return k
 
 
+def microbatches(default: int = 1) -> int:
+    """Resolved microbatch count k (``HOROVOD_MICROBATCHES``).
+
+    :func:`make_train_step` / :func:`make_flax_train_step` (and the loop
+    builders) call this when built without an explicit ``microbatches``
+    argument.  When the autotuner's opt-in microbatch axis is active
+    (``HOROVOD_AUTOTUNE_MICROBATCH=1``) the current sample's value wins.
+    k > 1 selects the backward-overlap exchange: the per-step batch splits
+    into k sub-batches inside one executable and each sub-batch's gradient
+    buckets reduce-scatter while the next sub-batch's backward pass runs.
+    """
+    from .core.state import global_state
+    st = global_state()
+    if st.autotuner is not None:
+        return max(1, st.autotuner.microbatches())
+    if st.config is not None:
+        return max(1, st.config.microbatches)
+    return max(1, default)
+
+
+def _resolve_microbatches(k: Optional[int]) -> int:
+    """``None`` defers to :func:`microbatches` (env/tuner)."""
+    k = microbatches() if k is None else int(k)
+    if k < 1:
+        raise ValueError(f"microbatches must be >= 1, got {k}")
+    return k
+
+
+def _microbatch_unwrap(optimizer):
+    """Decompose an optimizer for the microbatched exchange.
+
+    Returns ``(inner, exchange)``: the unwrapped optax optimizer plus the
+    exchange parameters a :func:`~horovod_tpu.DistributedOptimizer` wrap
+    would have applied (``None`` for a bare optimizer -- local microbatch
+    accumulation only, no collective, matching what the bare single-shot
+    step does).  The microbatched step must run the exchange itself --
+    per-microbatch bucket reduce-scatter, one closing allgather -- so a
+    wrapped optimizer's in-update allreduce cannot be reused: it would
+    exchange every microbatch's full gradient (k times the wire traffic)
+    with no overlap ordering.
+    """
+    upd = optimizer.update
+    if not getattr(upd, "_hvd_allreduce", False):
+        return optimizer, None
+    if not hasattr(upd, "_hvd_inner"):
+        raise ValueError(
+            "microbatches > 1 cannot combine with "
+            "backward_passes_per_step > 1 (both are gradient-accumulation "
+            "schemes; pick one)")
+    exchange = dict(upd._hvd_exchange)
+    if exchange["process_set"] is not None:
+        raise NotImplementedError(
+            "microbatches > 1 does not support process-set reductions "
+            "(the scatter-based exchange has no masked identity)")
+    if exchange["op"] not in (Sum, Average):
+        raise ValueError(
+            "microbatches > 1 supports Sum/Average reductions only, got "
+            f"{exchange['op']!r} (Adasum composes through "
+            "DistributedAdasumOptimizer without microbatching)")
+    from .collectives.compression import is_fp8
+    if is_fp8(exchange["compression"]):
+        raise NotImplementedError(
+            "microbatches > 1 does not support Compression.fp8 (the "
+            "quantized exchange owns its own collective); use fp16/bf16")
+    return upd._hvd_inner, exchange
+
+
 def stack_steps(batches) -> Any:
     """Stack k per-step batches into the scanned layout ``make_train_loop``
     consumes: each leaf gains a leading steps axis ``[k, batch, ...]``."""
@@ -170,6 +239,7 @@ def make_train_step(
     with_frozen: bool = False,
     zero_stage: Optional[int] = None,
     zero_compression=None,
+    microbatches: Optional[int] = None,
 ) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
 
@@ -201,17 +271,42 @@ def make_train_step(
     (``hvd.Compression.{fp16,bf16,fp8}``).  Pass the BARE optax optimizer
     (no :func:`~horovod_tpu.DistributedOptimizer` wrap) and build
     ``opt_state`` with :func:`horovod_tpu.zero_init`.
+
+    With ``microbatches=k > 1`` (default from ``HOROVOD_MICROBATCHES``)
+    the per-step batch splits into k sub-batches inside ONE executable:
+    each sub-batch's gradient buckets reduce-scatter the moment its
+    backward segment finishes, overlapping wire time with the next
+    sub-batch's backward compute (the reference's headline
+    backward-overlap, expressed as schedulable HLO).  Same optimizer
+    trajectory as single-shot at the same global batch, up to documented
+    accumulation-order tolerance (f32 cross-microbatch sum; bitwise at
+    k=1, which is exactly the single-shot path).  Requires a
+    per-example-mean loss, a local batch divisible by k, and is
+    incompatible with ``zero_stage=1``, Adasum, fp8 compression, process
+    sets, and ``backward_passes_per_step > 1``.
     """
     if aux_mode not in ("stacked", "averaged"):
         raise ValueError(f"unknown aux_mode {aux_mode!r}")
     zero_stage = _resolve_zero_stage(zero_stage)
+    k_micro = _resolve_microbatches(microbatches)
     if zero_stage:
+        if k_micro > 1:
+            raise ValueError(
+                "microbatches > 1 is incompatible with zero_stage=1 (the "
+                "ZeRO-1 arena reduce-scatter is already shard-based; "
+                "overlap it via HOROVOD_EXCHANGE_CHUNK_MB instead)")
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
-    local_step = _build_local_step(loss_fn, optimizer, axes, loss_has_aux,
-                                   aux_mode, with_frozen, zero_stage,
-                                   zero_compression)
+    if k_micro > 1:
+        inner, exchange = _microbatch_unwrap(optimizer)
+        local_step = _build_microbatch_local_step(
+            loss_fn, inner, exchange, axes, loss_has_aux, aux_mode,
+            with_frozen, k_micro)
+    else:
+        local_step = _build_local_step(loss_fn, optimizer, axes,
+                                       loss_has_aux, aux_mode, with_frozen,
+                                       zero_stage, zero_compression)
 
     aux_spec = () if not loss_has_aux else \
         ((P(),) if aux_mode == "averaged" else (P(axes),))
@@ -262,6 +357,216 @@ def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
     return local_step
 
 
+def _microbatch_grad_pipe(exchange, axes):
+    """Build ``(accumulate, finalize)`` for the backward-overlap exchange.
+
+    ``accumulate(grads, state)`` is called once per microbatch, right after
+    that microbatch's backward pass: it packs the gradients into fusion
+    buckets in READY order (``plan_buckets(reverse=True)`` -- last layers'
+    gradients finish first) and emits one tiled ``psum_scatter`` per bucket
+    IMMEDIATELY, so the collective for microbatch i is independent of (and
+    schedulable under) the backward compute of microbatch i+1.  Shards
+    accumulate in float32 across microbatches.  ``finalize(state, k,
+    grads)`` scales the accumulated shards (1/k; 1/n for Average;
+    postscale) and closes with ONE allgather per bucket.
+
+    Wire accounting: k reduce-scatters + 1 allgather of the
+    ``lcm(n, 256)``-padded bucket move an equivalent-allreduce payload of
+    ``(k+1)/2`` buckets -- the overlap costs extra bytes but each piece
+    rides under compute (``bench_scaling.py`` rn50-overlap gates the exact
+    number).  Numerics: the cross-rank reduce runs in the wire dtype like
+    the single-shot path, but the cross-MICROBATCH sum runs in f32 and the
+    Average divide happens once at the end, so k>1 matches single-shot to
+    accumulation-order tolerance, not bitwise (see ``make_train_step``).
+
+    ``exchange=None`` (bare optimizer, no DistributedOptimizer wrap) does
+    local f32 accumulation only -- no collective, matching the bare
+    single-shot step.
+    """
+    from .controller.fusion import pack, plan_buckets, unpack
+
+    if exchange is None:
+        def accumulate(grads, state):
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            if state is None:
+                return g32
+            return jax.tree.map(jnp.add, state, g32)
+
+        def finalize(state, k, grads_like):
+            return jax.tree.map(lambda a, g: (a / k).astype(g.dtype),
+                                state, grads_like)
+
+        return accumulate, finalize
+
+    compression = exchange["compression"]
+    threshold = exchange["fusion_threshold"]
+    pre = exchange["prescale_factor"]
+    post = exchange["postscale_factor"]
+
+    def accumulate(grads, state):
+        leaves = jax.tree.leaves(grads)
+        spec = plan_buckets(leaves, threshold, reverse=True)
+        bufs = pack(leaves, spec)
+        n = _ops.axis_size(axes)
+        q = _ops.microbatch_pad_quantum(n)
+        shards = []
+        for buf in bufs:
+            c, ctx = compression.compress(buf)
+            if pre != 1.0:
+                c = c * jnp.asarray(pre, dtype=c.dtype)
+            shard = _ops.psum_scatter_bucket(c, axes=axes, quantum=q)
+            shards.append(
+                compression.decompress(shard, ctx).astype(jnp.float32))
+        if state is None:
+            return shards
+        return [a + s for a, s in zip(state, shards)]
+
+    def finalize(state, k, grads_like):
+        leaves, treedef = jax.tree.flatten(grads_like)
+        spec = plan_buckets(leaves, threshold, reverse=True)
+        n = _ops.axis_size(axes)
+        scale = 1.0 / k
+        if exchange["op"] is Average:
+            scale = scale / n
+        out = []
+        for shard, (dt, lspecs) in zip(state, spec.buffers):
+            shard = shard * scale
+            if post != 1.0:
+                shard = shard * post
+            shard = shard.astype(dt)
+            c2, ctx2 = compression.compress(shard)
+            size = sum(s.size for s in lspecs)
+            full = _ops.allgather_bucket(c2, size, axes=axes)
+            out.append(compression.decompress(full, ctx2))
+        return jax.tree.unflatten(treedef, unpack(out, spec))
+
+    return accumulate, finalize
+
+
+def _split_microbatches(tree, k):
+    """Reshape each leaf's leading (local-batch) dim into ``[k, b/k, ...]``
+    contiguous sub-batches.  Shapes are static at trace time, so a
+    non-divisible batch fails the build, not the run."""
+    def split(leaf):
+        b0 = leaf.shape[0] if leaf.ndim else 0
+        if b0 % k:
+            raise ValueError(
+                f"microbatches={k} must divide the per-device batch "
+                f"(got leading dim {b0}); pad or resize the batch")
+        return leaf.reshape((k, b0 // k) + leaf.shape[1:])
+
+    return jax.tree.map(split, tree)
+
+
+def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
+                                 loss_has_aux, aux_mode, with_frozen, k):
+    """Per-device step body for ``microbatches=k > 1``: an UNROLLED loop
+    over k sub-batches whose trace interleaves each microbatch's bucket
+    reduce-scatters between backward segments (the HLO-structure the
+    overlap test asserts), one optimizer update on the merged gradients.
+
+    Equivalence contract: with a per-example-MEAN loss (the usual
+    ``.mean()`` losses; what the parity tests use), the mean of the k
+    sub-batch gradients equals the full-batch gradient, so k>1 matches the
+    single-shot step at the same global batch to accumulation-order
+    tolerance.  A per-example-SUM loss would need ``prescale_factor=k`` --
+    same caveat as any gradient-accumulation scheme.  ``aux_mode
+    "stacked"`` gains a leading ``[k]`` axis per device; ``"averaged"``
+    averages floating aux leaves over microbatches before the allreduce.
+    """
+    accumulate, finalize = _microbatch_grad_pipe(exchange, axes)
+
+    def local_step(params, opt_state, batch, *frozen):
+        lf = (lambda p, b: loss_fn(p, frozen[0], b)) if with_frozen \
+            else loss_fn
+        micro = _split_microbatches(batch, k)
+        state, losses, auxes, grads = None, [], [], None
+        for i in range(k):
+            mb = jax.tree.map(lambda a: a[i], micro)
+            if loss_has_aux:
+                (loss_i, aux_i), grads = jax.value_and_grad(
+                    lf, has_aux=True)(params, mb)
+                auxes.append(aux_i)
+            else:
+                loss_i, grads = jax.value_and_grad(lf)(params, mb)
+            losses.append(loss_i)
+            state = accumulate(grads, state)
+        reduced = finalize(state, k, grads)
+        updates, opt_state = inner.update(reduced, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = _ops.allreduce(jnp.mean(jnp.stack(losses)), Average,
+                              axes=axes)
+        if loss_has_aux:
+            if aux_mode == "averaged":
+                aux = jax.tree.map(
+                    lambda *xs: jnp.mean(jnp.stack(xs), axis=0)
+                    if jnp.issubdtype(xs[0].dtype, jnp.floating)
+                    else xs[-1], *auxes)
+                aux = jax.tree.map(
+                    lambda v: _ops.allreduce(v, Average, axes=axes)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v, aux)
+            else:
+                aux = jax.tree.map(lambda *xs: jnp.stack(xs), *auxes)
+            return params, opt_state, loss, aux
+        return params, opt_state, loss
+
+    return local_step
+
+
+def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
+                                      axes, k):
+    """Flax counterpart of :func:`_build_microbatch_local_step`.
+
+    BatchNorm note: batch statistics CHAIN through the k microbatches
+    (microbatch i normalizes with the stats microbatch i-1 produced, and
+    the running EMA advances k times per step) -- a real semantic
+    difference from the single-shot step's one full-batch normalization,
+    inherent to any microbatched BN.  Stats-free models match the
+    single-shot step to accumulation tolerance; the final stats cross the
+    mesh in the same one-allreduce-per-leaf exchange as single-shot.
+    """
+    if loss_fn is None:
+        def loss_fn(logits, y):
+            return _softmax_xent(logits, y)
+    accumulate, finalize = _microbatch_grad_pipe(exchange, axes)
+
+    def local_step(params, batch_stats, opt_state, batch):
+        x, y = batch
+        xs = _split_microbatches(x, k)
+        ys = _split_microbatches(y, k)
+        stats = batch_stats
+        state, losses, grads = None, [], None
+        for i in range(k):
+            xi = jax.tree.map(lambda a: a[i], xs)
+            yi = jax.tree.map(lambda a: a[i], ys)
+
+            def lf(p, stats=stats, xi=xi, yi=yi):
+                variables = {"params": p}
+                if stats:
+                    variables["batch_stats"] = stats
+                    logits, mutated = apply_fn(variables, xi, train=True,
+                                               mutable=["batch_stats"])
+                    return (loss_fn(logits, yi),
+                            mutated.get("batch_stats", {}))
+                logits = apply_fn(variables, xi, train=True)
+                return loss_fn(logits, yi), {}
+
+            (loss_i, stats), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            losses.append(loss_i)
+            state = accumulate(grads, state)
+        reduced = finalize(state, k, grads)
+        updates, opt_state = inner.update(reduced, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        new_stats = jax.tree.map(
+            lambda v: _ops.allreduce(v, Average, axes=axes), stats)
+        loss = _ops.allreduce(jnp.mean(jnp.stack(losses)), Average,
+                              axes=axes)
+        return params, new_stats, opt_state, loss
+
+    return local_step
+
+
 def make_train_loop(
     loss_fn: Callable[[Any, Any], jnp.ndarray],
     optimizer: optax.GradientTransformation,
@@ -273,6 +578,7 @@ def make_train_loop(
     with_frozen: bool = False,
     zero_stage: Optional[int] = None,
     zero_compression=None,
+    microbatches: Optional[int] = None,
 ) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
     """Steps-per-execution runner: k train steps as ONE executable.
 
@@ -293,20 +599,35 @@ def make_train_loop(
     ``steps_per_execution=None`` reads ``HOROVOD_STEPS_PER_EXEC``
     (autotuner steps axis wins when active -- see
     :func:`steps_per_execution`).  All other knobs (``loss_has_aux``,
-    ``aux_mode``, ``with_frozen``, ``zero_stage``...) behave as in
-    :func:`make_train_step`; stacked aux gains a leading k axis.
+    ``aux_mode``, ``with_frozen``, ``zero_stage``,  ``microbatches``...)
+    behave as in :func:`make_train_step`; stacked aux gains a leading k
+    axis.  ``microbatches > 1`` microbatches EACH scanned step (the two
+    k's compose: steps_per_execution batches dispatches, microbatches
+    overlaps the exchange inside every step).
     """
     if aux_mode not in ("stacked", "averaged"):
         raise ValueError(f"unknown aux_mode {aux_mode!r}")
     zero_stage = _resolve_zero_stage(zero_stage)
+    k_micro = _resolve_microbatches(microbatches)
     if zero_stage:
+        if k_micro > 1:
+            raise ValueError(
+                "microbatches > 1 is incompatible with zero_stage=1 (the "
+                "ZeRO-1 arena reduce-scatter is already shard-based; "
+                "overlap it via HOROVOD_EXCHANGE_CHUNK_MB instead)")
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
     k = _resolve_steps(steps_per_execution)
-    local_step = _build_local_step(loss_fn, optimizer, axes, loss_has_aux,
-                                   aux_mode, with_frozen, zero_stage,
-                                   zero_compression)
+    if k_micro > 1:
+        inner, exchange = _microbatch_unwrap(optimizer)
+        local_step = _build_microbatch_local_step(
+            loss_fn, inner, exchange, axes, loss_has_aux, aux_mode,
+            with_frozen, k_micro)
+    else:
+        local_step = _build_local_step(loss_fn, optimizer, axes,
+                                       loss_has_aux, aux_mode, with_frozen,
+                                       zero_stage, zero_compression)
 
     def local_loop(params, opt_state, batches, *frozen):
         def body(carry, batch):
@@ -393,6 +714,7 @@ def make_flax_train_step(
     donate: bool = True,
     zero_stage: Optional[int] = None,
     zero_compression=None,
+    microbatches: Optional[int] = None,
 ):
     """Data-parallel train step for flax modules with mutable batch stats.
 
@@ -406,14 +728,31 @@ def make_flax_train_step(
     ``zero_stage=1`` shards the optimizer state as in
     :func:`make_train_step` (bare optax optimizer +
     :func:`horovod_tpu.zero_init` state); batch stats stay replicated.
+
+    ``microbatches=k > 1`` (``HOROVOD_MICROBATCHES``) runs the
+    backward-overlap exchange as in :func:`make_train_step`.  BatchNorm
+    statistics chain through the k sub-batches (see
+    :func:`_build_flax_microbatch_local_step` for the semantics).
     """
     zero_stage = _resolve_zero_stage(zero_stage)
+    k_micro = _resolve_microbatches(microbatches)
     if zero_stage:
+        if k_micro > 1:
+            raise ValueError(
+                "microbatches > 1 is incompatible with zero_stage=1 (the "
+                "ZeRO-1 arena reduce-scatter is already shard-based; "
+                "overlap it via HOROVOD_EXCHANGE_CHUNK_MB instead)")
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
-    local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn, axes,
-                                        zero_stage, zero_compression)
+    if k_micro > 1:
+        inner, exchange = _microbatch_unwrap(optimizer)
+        local_step = _build_flax_microbatch_local_step(
+            apply_fn, inner, exchange, loss_fn, axes, k_micro)
+    else:
+        local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn,
+                                            axes, zero_stage,
+                                            zero_compression)
 
     opt_spec = P(axes) if zero_stage else P()
     shard = jax.shard_map(local_step, mesh=mesh,
@@ -472,6 +811,7 @@ def make_flax_train_loop(
     donate: bool = True,
     zero_stage: Optional[int] = None,
     zero_compression=None,
+    microbatches: Optional[int] = None,
 ):
     """Steps-per-execution runner for flax modules with batch stats.
 
@@ -487,13 +827,25 @@ def make_flax_train_loop(
     exactly as the single step does.
     """
     zero_stage = _resolve_zero_stage(zero_stage)
+    k_micro = _resolve_microbatches(microbatches)
     if zero_stage:
+        if k_micro > 1:
+            raise ValueError(
+                "microbatches > 1 is incompatible with zero_stage=1 (the "
+                "ZeRO-1 arena reduce-scatter is already shard-based; "
+                "overlap it via HOROVOD_EXCHANGE_CHUNK_MB instead)")
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
     k = _resolve_steps(steps_per_execution)
-    local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn, axes,
-                                        zero_stage, zero_compression)
+    if k_micro > 1:
+        inner, exchange = _microbatch_unwrap(optimizer)
+        local_step = _build_flax_microbatch_local_step(
+            apply_fn, inner, exchange, loss_fn, axes, k_micro)
+    else:
+        local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn,
+                                            axes, zero_stage,
+                                            zero_compression)
 
     def local_loop(params, batch_stats, opt_state, batches):
         def body(carry, batch):

@@ -256,7 +256,7 @@ def test_autotuner_pr1_log_format_warm_starts(tmp_path):
         "zero,score_bytes_per_s\n"
         f"{thr},{Config().cycle_time},0,0,0,456.0\n")
     t = Autotuner(cfg, steps_per_sample=1)
-    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 456.0) in [
+    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 456.0) in [
         tuple(s) for s in t._samples]
 
 
@@ -395,5 +395,73 @@ def test_autotuner_old_log_format_warm_starts(tmp_path):
     log.write_text("fusion_threshold_bytes,cycle_time_ms,score\n"
                    f"{thr},{Config().cycle_time},123.0\n")
     t = Autotuner(cfg, steps_per_sample=1)
-    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 123.0) in [
+    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 123.0) in [
         tuple(s) for s in t._samples]
+
+
+def test_autotuner_microbatch_axis_is_opt_in_and_build_time(monkeypatch):
+    """HOROVOD_AUTOTUNE_MICROBATCH=1 opens the microbatch axis.  Like
+    steps-per-execution it is a BUILD-TIME knob (it changes the step's
+    internal loop structure, so the runner rebuilds) and must NOT appear
+    in the trace key."""
+    t = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert {cfg[7] for cfg in t.grid} == {1}
+    assert t.microbatches() == 1
+
+    t1 = Autotuner(Config(autotune=True, microbatches=4),
+                   steps_per_sample=1)
+    assert {cfg[7] for cfg in t1.grid} == {4}
+    assert t1.microbatches() == 4
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_MICROBATCH", "1")
+    t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert {cfg[7] for cfg in t2.grid} == {1, 2, 4}
+    assert len(t2.trace_key()) == 5  # thr, hier, comp, zero, chunk only
+    for want in (1, 2, 4):
+        for i, cfg in enumerate(t2.grid):
+            if cfg[7] == want:
+                t2._idx = i
+                break
+        assert t2.microbatches() == want
+
+
+def test_autotuner_microbatch_axis_closed_on_zero_runs(monkeypatch):
+    """ZeRO's arena exchange is already shard-based; the microbatch axis
+    stays pinned on zero-configured runs even when opted in."""
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_MICROBATCH", "1")
+    t = Autotuner(Config(autotune=True, zero_stage=1), steps_per_sample=1)
+    assert {cfg[7] for cfg in t.grid} == {1}
+
+
+def test_autotuner_warm_start_skips_unusable_rows(tmp_path):
+    """NaN/inf scores and unknown column counts are skipped with a
+    counted warning, never fatal; the good rows still warm-start."""
+    log = tmp_path / "bad.csv"
+    cfg = Config(autotune=True, autotune_log=str(log))
+    thr = 32 * 1024 * 1024
+    ct = Config().cycle_time
+    log.write_text(
+        "fusion_threshold_bytes,cycle_time_ms,score\n"
+        f"{thr},{ct},nan\n"         # NaN score -> poisons the GP
+        f"{thr},{ct},inf\n"         # inf score
+        "1,2,3,4\n"                 # unknown column count (4)
+        f"{thr},{ct},oops\n"        # non-numeric cell
+        f"{thr},{ct},123.0\n")      # good row survives
+    with pytest.warns(RuntimeWarning, match="skipped 4 unusable row"):
+        t = Autotuner(cfg, steps_per_sample=1)
+    assert t.warm_start_skipped == 4
+    assert (thr, ct, 0, 0, 0, 0, 1, 1, 123.0) in [
+        tuple(s) for s in t._samples]
+
+
+def test_autotuner_warm_start_clean_log_no_warning(tmp_path):
+    log = tmp_path / "clean.csv"
+    cfg = Config(autotune=True, autotune_log=str(log))
+    thr = 32 * 1024 * 1024
+    log.write_text("fusion_threshold_bytes,cycle_time_ms,score\n"
+                   f"{thr},{Config().cycle_time},42.0\n")
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)
+        t = Autotuner(cfg, steps_per_sample=1)
+    assert t.warm_start_skipped == 0

@@ -179,3 +179,100 @@ def test_bench_config_string_gains_scanloop_suffix(monkeypatch):
     b = importlib.reload(bench)
     assert not b.SCANLOOP
     assert b._config() == b.BASELINE_CONFIG
+
+
+# -- overlap config shape ----------------------------------------------------
+# bench.py's overlap config (BENCH_OVERLAP=1 / HOROVOD_MICROBATCHES>1) is
+# cross-config by construction (the config string gains "_microbatchK"), so
+# its vs_baseline must be null, and it must report the exchange-overlap
+# fraction the microbatched step exists to maximise.
+
+
+def scan_overlap_entries(bench_dir):
+    """Return [(path, why), ...] for malformed overlap bench entries: an
+    overlap (microbatch) config must publish ``vs_baseline: null`` and an
+    ``overlap_fraction`` in [0, 1]."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            if "microbatch" not in str(parsed.get("config", "")):
+                continue
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "overlap vs_baseline must be null"))
+            frac = parsed.get("overlap_fraction")
+            if not isinstance(frac, (int, float)) or not 0.0 <= frac <= 1.0:
+                bad.append((path, f"bad overlap_fraction: {frac!r}"))
+    return bad
+
+
+def test_committed_overlap_entries_well_formed():
+    assert scan_overlap_entries(REPO) == []
+
+
+def _write_overlap(tmp_path, name, vs_baseline, overlap_fraction):
+    parsed = {"metric": "resnet50_images_per_sec_per_chip", "value": 2700.0,
+              "unit": "images/s/chip", "vs_baseline": vs_baseline,
+              "config": "batch256_s2d_bf16_microbatch4",
+              "baseline_config": "batch256_s2d_bf16", "microbatches": 4}
+    if overlap_fraction is not None:
+        parsed["overlap_fraction"] = overlap_fraction
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def test_overlap_validator_accepts_well_formed_entry(tmp_path):
+    _write_overlap(tmp_path, "BENCH_r80.json", None, 0.72)
+    assert scan_overlap_entries(str(tmp_path)) == []
+    # ...and the >=0.98 gate ignores it (vs_baseline null, 0.98 unchanged).
+    assert THRESHOLD == 0.98
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_overlap_validator_trips_on_nonnull_vs_baseline(tmp_path):
+    _write_overlap(tmp_path, "BENCH_r81.json", 1.05, 0.72)
+    bad = scan_overlap_entries(str(tmp_path))
+    assert bad == [(str(tmp_path / "BENCH_r81.json"),
+                    "overlap vs_baseline must be null")]
+
+
+def test_overlap_validator_trips_on_missing_or_bad_fraction(tmp_path):
+    _write_overlap(tmp_path, "BENCH_r82.json", None, None)
+    _write_overlap(tmp_path, "BENCH_r83.json", None, 1.2)
+    _write_overlap(tmp_path, "BENCH_r84.json", None, -0.1)
+    bad = dict(scan_overlap_entries(str(tmp_path)))
+    assert str(tmp_path / "BENCH_r82.json") in bad
+    assert str(tmp_path / "BENCH_r83.json") in bad
+    assert str(tmp_path / "BENCH_r84.json") in bad
+
+
+def test_bench_config_string_gains_microbatch_suffix(monkeypatch):
+    """bench.py's config string must mark overlap runs (that suffix is
+    what makes vs_baseline null via the same_config gate)."""
+    import importlib
+
+    import bench
+    monkeypatch.setenv("BENCH_OVERLAP", "1")
+    monkeypatch.delenv("HOROVOD_MICROBATCHES", raising=False)
+    monkeypatch.delenv("HVD_TPU_MICROBATCHES", raising=False)
+    b = importlib.reload(bench)
+    assert b.OVERLAP and b.MICRO_K == 4  # default k
+    assert b._config().endswith("_microbatch4")
+    assert b._config() != b.BASELINE_CONFIG
+
+    monkeypatch.delenv("BENCH_OVERLAP")
+    monkeypatch.setenv("HOROVOD_MICROBATCHES", "2")
+    b = importlib.reload(bench)
+    assert b.OVERLAP and b.MICRO_K == 2
+    assert b._config().endswith("_microbatch2")
+
+    monkeypatch.delenv("HOROVOD_MICROBATCHES")
+    b = importlib.reload(bench)
+    assert not b.OVERLAP
+    assert b._config() == b.BASELINE_CONFIG

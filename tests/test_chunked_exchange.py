@@ -131,3 +131,61 @@ def test_chunked_step_emits_rs_ag_and_converges(monkeypatch):
             np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
     finally:
         hv.shutdown()
+
+
+def _predict_chunk_payload(size, itemsize, chunk_bytes, n):
+    """Exact emitted payload of chunked_allreduce's RS/AG legs, mirroring
+    the chunking arithmetic in ``collectives/ops.py``: chunk_elems is
+    chunk_bytes worth of elements rounded up to a multiple of n; each
+    chunk (including a short tail) is padded to a multiple of n with at
+    most n-1 zero elements.  Returns (chunks, rs_bytes, ag_bytes) where
+    bytes are StableHLO RESULT-shape bytes (RS result = padded/n elems,
+    AG result = padded elems)."""
+    chunk_elems = max(1, chunk_bytes // itemsize)
+    chunk_elems += (-chunk_elems) % n
+    chunks = rs = ag = 0
+    for off in range(0, size, chunk_elems):
+        piece = min(chunk_elems, size - off)
+        padded = piece + (-piece) % n
+        chunks += 1
+        rs += padded // n * itemsize
+        ag += padded * itemsize
+    return chunks, rs, ag
+
+
+@pytest.mark.parametrize("size,chunk_bytes", [
+    (7, 1024),    # sub-chunk bucket: one short chunk, pad <= n-1
+    (200, 256),   # multiple chunks + non-divisible tail
+    (64, 64),     # exactly chunk-aligned, no tail
+])
+def test_chunked_allreduce_exact_payload_accounting(
+        hvd, n_devices, size, chunk_bytes):
+    """The emitted RS/AG payload must match the chunking arithmetic
+    EXACTLY -- no silent padding bytes beyond the documented <= n-1
+    elements per chunk."""
+    from horovod_tpu.utils.scaling import emitted_collective_stats
+
+    mesh = hv.mesh()
+    axes = tuple(mesh.axis_names)
+    n = n_devices
+    itemsize = 4  # float32
+
+    def f(xb):
+        return cops.chunked_allreduce(
+            xb[0], hv.Sum, chunk_bytes=chunk_bytes, axes=axes)[None]
+
+    lowered = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P(axes), out_specs=P(axes))).lower(
+        jnp.ones((n, size), jnp.float32))
+    stats = emitted_collective_stats(lowered.as_text())
+
+    chunks, rs_bytes, ag_bytes = _predict_chunk_payload(
+        size, itemsize, chunk_bytes, n)
+    assert stats.counts.get("reduce-scatter", 0) == chunks
+    assert stats.counts.get("all-gather", 0) == chunks
+    assert stats.bytes.get("reduce-scatter", 0) == rs_bytes
+    assert stats.bytes.get("all-gather", 0) == ag_bytes
+    # Padding bound: total AG payload exceeds the raw bucket by at most
+    # n-1 elements per chunk.
+    raw = size * itemsize
+    assert ag_bytes - raw <= chunks * (n - 1) * itemsize

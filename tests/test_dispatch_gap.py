@@ -72,3 +72,77 @@ def test_monitor_emits_timeline_counter(tmp_path):
     assert "fused_bytes" in names
     gap_ev = [ev for ev in counters if ev["name"] == "host_dispatch_gap"][0]
     assert 0.0 <= gap_ev["args"]["host_dispatch_gap"] <= 1.0
+
+
+# -- OverlapMonitor (backward-overlap observability) ------------------------
+
+def test_overlap_full_when_wall_equals_compute():
+    """Step wall == pure compute: every comm second was hidden."""
+    from horovod_tpu.timeline import OverlapMonitor
+    mon = OverlapMonitor(compute_s=0.05, comm_s=0.02)
+    mon.begin_window()
+    time.sleep(0.05)
+    frac = mon.end_window(steps=1)
+    assert frac > 0.8
+    assert mon.windows == [frac]
+    assert mon.overlap_fraction == frac
+
+
+def test_overlap_zero_when_comm_fully_exposed():
+    """Step wall == compute + comm: nothing was hidden."""
+    from horovod_tpu.timeline import OverlapMonitor
+    mon = OverlapMonitor(compute_s=0.02, comm_s=0.04)
+    mon.begin_window()
+    time.sleep(0.06)
+    frac = mon.end_window(steps=1)
+    assert frac < 0.3
+
+
+def test_overlap_normalizes_by_steps():
+    from horovod_tpu.timeline import OverlapMonitor
+    mon = OverlapMonitor(compute_s=0.02, comm_s=0.01)
+    mon.begin_window()
+    time.sleep(0.04)  # 2 steps of pure compute -> overlap ~1.0
+    frac = mon.end_window(steps=2)
+    assert frac > 0.8
+
+
+def test_overlap_zero_comm_budget_records_zero():
+    """comm_s == 0 (single chip): nothing to hide, 0.0 by convention."""
+    from horovod_tpu.timeline import OverlapMonitor
+    mon = OverlapMonitor(compute_s=0.01, comm_s=0.0)
+    mon.begin_window()
+    frac = mon.end_window(steps=1)
+    assert frac == 0.0
+
+
+def test_overlap_end_without_begin_raises():
+    from horovod_tpu.timeline import OverlapMonitor
+    with pytest.raises(RuntimeError):
+        OverlapMonitor(compute_s=0.01, comm_s=0.01).end_window(steps=1)
+    with pytest.raises(ValueError):
+        OverlapMonitor(compute_s=-1.0, comm_s=0.0)
+    mon = OverlapMonitor(compute_s=0.01, comm_s=0.01)
+    mon.begin_window()
+    with pytest.raises(ValueError):
+        mon.end_window(steps=0)
+
+
+def test_overlap_empty_monitor_reports_zero():
+    from horovod_tpu.timeline import OverlapMonitor
+    assert OverlapMonitor(compute_s=0.0, comm_s=0.0).overlap_fraction == 0.0
+
+
+def test_overlap_emits_timeline_counter(tmp_path):
+    from horovod_tpu.timeline import OverlapMonitor
+    path = tmp_path / "tl_overlap.json"
+    tl = Timeline(str(path))
+    mon = OverlapMonitor(compute_s=0.01, comm_s=0.005, timeline=tl)
+    mon.begin_window()
+    time.sleep(0.01)
+    mon.end_window(steps=1)
+    tl.close()
+    doc = json.loads(path.read_text())
+    counters = [ev for ev in doc if ev.get("ph") == "C"]
+    ev = [e for e in counters if e["name"] == "exchange_overlap"][0]
+    assert 0.0 <= ev["args"]["exchange_overlap"] <= 1.0

@@ -108,3 +108,35 @@ def test_prefetcher_rejects_bad_args(hvd):
 def test_prefetcher_empty_iterator(hvd):
     with hv.DevicePrefetcher([], depth=2) as pf:
         assert list(pf) == []
+
+
+def test_prefetcher_surfaces_error_even_when_sentinel_is_lost(
+        hvd, monkeypatch):
+    """A poisoned iterator must raise on the consumer's next __next__
+    even if the producer's error sentinel never lands in the queue
+    (regression: the consumer used to block forever on a starved queue)."""
+    from horovod_tpu.data.prefetch import _Stop
+
+    orig_put = hv.DevicePrefetcher._put
+
+    def lossy_put(self, item):
+        if isinstance(item, _Stop) and item.error is not None:
+            return False  # drop the error sentinel on the floor
+        return orig_put(self, item)
+
+    monkeypatch.setattr(hv.DevicePrefetcher, "_put", lossy_put)
+
+    def gen():
+        yield {"x": np.zeros((16, 3), np.float32)}
+        raise RuntimeError("poisoned iterator")
+
+    pf = hv.DevicePrefetcher(gen(), depth=2)
+    next(pf)  # the good batch still arrives first (FIFO preserved)
+    with pytest.raises(RuntimeError, match="poisoned iterator"):
+        next(pf)
+    # The producer thread must have exited cleanly, not be stuck.
+    pf._thread.join(timeout=5.0)
+    assert not pf._thread.is_alive()
+    # Subsequent iteration stays terminated.
+    with pytest.raises(StopIteration):
+        next(pf)
