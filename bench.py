@@ -76,6 +76,13 @@ if OVERLAP and MICRO_K < 1:
 # BENCH_TINY=1 swaps RN50 for a one-stage 8-filter ResNet on 32x32 inputs:
 # a plumbing smoke config (CPU-runnable), never comparable to the baseline.
 TINY = _env_on("BENCH_TINY")
+# BENCH_EAGER=1 benches the eager control plane instead of training
+# throughput: runs examples/eager_latency_probe.py under the launcher
+# (BENCH_EAGER_NP procs, default 2, forced CPU) and re-emits its JSON
+# line (sync vs deferred-unfused vs deferred-fused 8-op batch, grouped
+# reference).  Latency metric, no throughput baseline -> vs_baseline null.
+EAGER = _env_on("BENCH_EAGER")
+EAGER_NP = int(os.environ.get("BENCH_EAGER_NP", "2"))
 
 
 def _config() -> str:
@@ -97,8 +104,59 @@ def _watchdog():
     os._exit(2)
 
 
+def _main_eager():
+    """BENCH_EAGER=1: eager control-plane latency via the probe script."""
+    import subprocess
+    from horovod_tpu.utils.platform import multiprocess_cpu_supported
+    repo = os.path.dirname(os.path.abspath(__file__))
+    probe = os.path.join(repo, "examples", "eager_latency_probe.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    n_procs = EAGER_NP
+    if n_procs > 1 and not multiprocess_cpu_supported():
+        # This jaxlib cannot run multi-process CPU meshes; fall back to
+        # the single-process harness mode (forced deferral), which
+        # measures the dispatch-side share of the fusion win.  The config
+        # string marks the fallback, so the entry is never mistaken for a
+        # multi-process measurement.
+        print("# BENCH_EAGER: multiprocess CPU unsupported by this jaxlib; "
+              "falling back to -np 1 with PROBE_FORCE_DEFER=1",
+              file=sys.stderr)
+        n_procs = 1
+        env["PROBE_FORCE_DEFER"] = "1"
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(n_procs),
+           "--cpu", sys.executable, probe]
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=max(WATCHDOG_S - 30, 60))
+    # The launcher prefixes worker output ("[0]<stdout> {...}"); take the
+    # last line containing the probe's JSON object.
+    parsed = None
+    for line in out.stdout.splitlines():
+        brace = line.find("{")
+        if brace < 0:
+            continue
+        try:
+            cand = json.loads(line[brace:])
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and cand.get("metric") == \
+                "eager_latency_probe":
+            parsed = cand
+    if out.returncode != 0 or parsed is None:
+        print(out.stdout[-2000:] + out.stderr[-2000:], file=sys.stderr)
+        print(json.dumps({"metric": "eager_latency_probe", "value": 0.0,
+                          "unit": "ms/batch", "vs_baseline": None,
+                          "error": f"probe failed (rc={out.returncode})"}),
+              flush=True)
+        os._exit(2)
+    print(json.dumps(parsed), flush=True)
+    os._exit(0)
+
+
 def main():
     threading.Thread(target=_watchdog, daemon=True).start()
+    if EAGER:
+        _main_eager()
     if OVERLAP and ZERO:
         sys.exit("BENCH_OVERLAP / HOROVOD_MICROBATCHES>1 is incompatible "
                  "with HOROVOD_ZERO=1 (the ZeRO arena exchange is already "

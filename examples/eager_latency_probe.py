@@ -1,71 +1,130 @@
 """Eager-dispatch control-plane latency probe (multi-process path).
 
-Measures per-dispatch wall time for host-level collectives under the
+Measures per-batch wall time for an 8-op eager allreduce batch under the
 launcher (``hvdrun -np 2 --cpu python examples/eager_latency_probe.py``)
-so the join-presence + fence share of the eager hot path can be isolated
-(round-2 verdict weak #2).  Prints per-phase mean ms/dispatch on rank 0.
+across the three dispatch strategies the eager control plane now offers:
+
+* ``sync``             -- 8 sequential ``hvd.allreduce`` calls (one
+                          presence round + one fence EACH: the round-2
+                          lower bound for naive eager code);
+* ``deferred_unfused`` -- ``allreduce_async`` x8 + synchronize drain with
+                          ``HOROVOD_DEFERRED_FUSE=0`` (round-5 behavior:
+                          ONE presence round, but still one collective +
+                          one fence per op);
+* ``deferred_fused``   -- same batch with fusion on (round-6 tentpole:
+                          the flush routes through the fusion planner, so
+                          compatible ops share ONE collective + ONE fence
+                          per bucket).
+
+A ``grouped_allreduce`` of the same 8 tensors runs as the reference
+cost -- the fused deferred flush should land within ~10% of it, since
+both dispatch one collective per dtype bucket.  Rank 0 prints ONE JSON
+line (``metric: eager_latency_probe``, ``vs_baseline: null`` -- latency
+probes have no recorded throughput baseline) plus a human-readable
+summary on stderr.
 
 ``HOROVOD_JOIN_DISABLE=1`` skips the presence protocol entirely (for
 workloads that never call ``hvd.join()``), giving the lower bound.
+
+``PROBE_FORCE_DEFER=1`` routes ``allreduce_async`` through the deferred
+queue even on a single process (where the presence protocol -- the
+normal deferral trigger -- does not apply).  That isolates the
+dispatch-side share of the win (bucket planning + one fused collective
+vs K singleton dispatches) on jaxlib builds that cannot run
+multi-process CPU meshes; the presence-round and fence amortisation on
+top of it only shows under a real multi-process launch.
 """
 
+import dataclasses
+import json
 import os
+import sys
 import time
 
 import numpy as np
 
+K = 8  # ops per batch: 2 tensors x 4 dtypes -> 4 fusion buckets
+
+
+def _batch_tensors(hvd):
+    return [hvd.replicated_stack(np.full((64,), 1, dt))
+            for dt in (np.float32, np.float64, np.int32, np.int64)
+            for _ in range(2)]
+
+
+def _time_batches(fn, n_iter):
+    fn()  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        fn()
+    return (time.perf_counter() - t0) / n_iter * 1e3
+
 
 def main():
     import horovod_tpu as hvd
+    from horovod_tpu.collectives import eager as _eager
+    from horovod_tpu.core.state import global_state
 
     hvd.init()
     rank = hvd.rank()
+    n = hvd.size()
     n_iter = int(os.environ.get("PROBE_ITERS", "30"))
+    forced = os.environ.get("PROBE_FORCE_DEFER", "") == "1"
+    if forced:
+        _eager._defer_applies = lambda ps: True
+    xs = _batch_tensors(hvd)
 
-    x = hvd.replicated_stack(np.ones((64,), np.float32))
-    hvd.allreduce(x)                       # compile + settle
+    def sync_batch():
+        for x in xs:
+            hvd.allreduce(x, hvd.Sum)
 
-    t0 = time.perf_counter()
-    for _ in range(n_iter):
-        hvd.allreduce(x)
-    single = (time.perf_counter() - t0) / n_iter * 1e3
-
-    # 4 dtype buckets -> 4 collectives per group: the batched-flush
-    # protocol runs ONE presence round for all of them (was one each).
-    xs = [hvd.replicated_stack(np.full((64,), 1, dt))
-          for dt in (np.float32, np.float64, np.int32, np.int64)
-          for _ in range(2)]
-    hvd.grouped_allreduce(xs, hvd.Sum)     # compile + settle
-    t0 = time.perf_counter()
-    for _ in range(n_iter // 3):
-        hvd.grouped_allreduce(xs, hvd.Sum)
-    grouped = (time.perf_counter() - t0) / (n_iter // 3) * 1e3
-
-    # Ungrouped async loop: K allreduce_async_ + one synchronize drain.
-    # Round-5: deferred dispatch batches ALL K behind ONE presence round
-    # (was one round per op -- the reference's background loop amortizes
-    # the same way via its per-cycle negotiation).
-    from horovod_tpu.collectives import eager as _eager
-    K = 8
-    hs = [hvd.allreduce_async(x) for _ in range(K)]
-    deferred = _eager.deferred_count()
-    for h in hs:
-        hvd.synchronize(h)
-    t0 = time.perf_counter()
-    for _ in range(n_iter // 3):
-        hs = [hvd.allreduce_async(x) for _ in range(K)]
+    def async_batch():
+        hs = [hvd.allreduce_async(x, hvd.Sum) for x in xs]
         for h in hs:
             hvd.synchronize(h)
-    async_loop = (time.perf_counter() - t0) / (n_iter // 3) * 1e3
+
+    def with_fuse(enabled, fn):
+        st = global_state()
+        saved = st.config
+        st.config = dataclasses.replace(saved, deferred_fuse=enabled)
+        try:
+            return fn()
+        finally:
+            st.config = saved
+
+    sync_ms = _time_batches(sync_batch, n_iter)
+    unfused_ms = with_fuse(False, lambda: _time_batches(async_batch, n_iter))
+    _eager.reset_deferred()  # zero the fuse stats before the fused pass
+    fused_ms = with_fuse(True, lambda: _time_batches(async_batch, n_iter))
+    fuse_stats = _eager.deferred_fuse_stats()
+    grouped_ms = _time_batches(lambda: hvd.grouped_allreduce(xs, hvd.Sum),
+                               n_iter)
 
     if rank == 0:
         from horovod_tpu.core.config import _env_bool
         mode = "join-disabled" if _env_bool("JOIN_DISABLE") \
             else "join-enabled"
-        print(f"[{mode}] single allreduce: {single:.1f} ms/dispatch; "
-              f"grouped(8 tensors, 4 dtype buckets): {grouped:.1f} ms/group; "
-              f"async-ungrouped({K} ops, {deferred} deferred): "
-              f"{async_loop:.1f} ms/batch", flush=True)
+        print(f"# [{mode}] {K}-op batch ({n} procs): "
+              f"sync {sync_ms:.1f} ms; "
+              f"deferred-unfused {unfused_ms:.1f} ms; "
+              f"deferred-fused {fused_ms:.1f} ms "
+              f"({fuse_stats['fused_buckets']} buckets/"
+              f"{fuse_stats['flushes']} flushes); "
+              f"grouped reference {grouped_ms:.1f} ms", file=sys.stderr,
+              flush=True)
+        print(json.dumps({
+            "metric": "eager_latency_probe",
+            "value": round(fused_ms, 2),
+            "unit": "ms/batch",
+            "vs_baseline": None,
+            "config": f"eager_probe_np{n}_k{K}_{mode}"
+                      + ("_forced-defer" if forced else ""),
+            "variants": {"sync_ms": round(sync_ms, 2),
+                         "deferred_unfused_ms": round(unfused_ms, 2),
+                         "deferred_fused_ms": round(fused_ms, 2)},
+            "grouped_ms": round(grouped_ms, 2),
+            "fuse_stats": fuse_stats,
+        }), flush=True)
     hvd.shutdown()
 
 

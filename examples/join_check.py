@@ -61,17 +61,39 @@ def main():
         assert (outs[1][0] == 2).all(), outs[1]
         print(f"rank {rank}: grouped-during-join OK")
 
-        # Ungrouped async loop (round-5 deferred dispatch): THREE
-        # allreduce_async handles flush behind ONE presence round at the
-        # first synchronize; drained ranks read flush size 3 and replay
-        # all three with identity payloads before their next round.
+        # Ungrouped async loop (round-5 deferred dispatch, now fused by
+        # round 6): THREE compatible allreduce_async handles flush behind
+        # ONE presence round at the first synchronize AND -- same dtype/
+        # op/codec -- share ONE fused collective; drained ranks read
+        # flush size 1 (one dispatch unit) and replay the bucket-level
+        # collective bitwise from its published fused_widths.
+        from horovod_tpu.collectives.eager import deferred_fuse_stats
         hs = [hvd.allreduce_async(
             np.full((s, 2), float(i + 1), np.float32), hvd.Sum,
             name=f"join_async_{i}") for i in range(3)]
         for i, h in enumerate(hs):
             got = hvd.local_result(hvd.synchronize(h))[0]
             assert np.allclose(got, i + 1.0), (i, got)
+        st = deferred_fuse_stats()
+        assert st["fused_buckets"] >= 1 and st["fused_ops"] >= 3, st
         print(f"rank {rank}: async-ungrouped-during-join OK")
+
+        # Mixed-dtype async batch while the other rank(s) drain: the
+        # flush splits into TWO fused buckets (f32, f64), each replayed
+        # as its own bucket collective by the drained ranks.
+        hs = [hvd.allreduce_async(
+            np.full((s, 2), float(i + 1), np.float32), hvd.Sum,
+            name=f"join_fused_f32_{i}") for i in range(2)]
+        hs += [hvd.allreduce_async(
+            np.full((s, 3), 10.0 * (i + 1), np.float64), hvd.Sum,
+            name=f"join_fused_f64_{i}") for i in range(2)]
+        vals = [hvd.local_result(hvd.synchronize(h))[0] for h in hs]
+        assert np.allclose(vals[0], 1.0) and np.allclose(vals[1], 2.0)
+        assert np.allclose(vals[2], 10.0) and np.allclose(vals[3], 20.0)
+        st = deferred_fuse_stats()
+        assert st["fused_buckets"] >= 3 and st["fused_ops"] >= 7, st
+        print(f"rank {rank}: fused-async-during-join OK "
+              f"({st['fused_buckets']} buckets)")
 
     last = hvd.join()
     print(f"rank {rank}: join OK last={last}")

@@ -106,7 +106,8 @@ class Autotuner:
 
     def __init__(self, config, steps_per_sample: int = 10,
                  candidates: Optional[List[int]] = None,
-                 max_samples: int = MAX_SAMPLES):
+                 max_samples: int = MAX_SAMPLES,
+                 cycle_candidates: Optional[List[float]] = None):
         self.candidates = list(candidates or _THRESHOLDS)
         if config.fusion_threshold not in self.candidates:
             self.candidates.append(config.fusion_threshold)
@@ -114,10 +115,15 @@ class Autotuner:
         # The cycle-time axis only matters when the native cycle scheduler
         # (torch shim grad batching) is in play; tuning it in a pure-JAX
         # run would burn most of the sample budget re-measuring identical
-        # configurations under noise.
-        torch_shim = ("horovod_tpu.torch_api" in sys.modules
-                      or "horovod_tpu.torch" in sys.modules)
-        cycles = list(_CYCLES_MS) if torch_shim else []
+        # configurations under noise.  ``cycle_candidates`` pins the axis
+        # explicitly (the resident-module heuristic sees every import the
+        # process ever made, not whether THIS run drives the shim).
+        if cycle_candidates is not None:
+            cycles = list(cycle_candidates)
+        else:
+            torch_shim = ("horovod_tpu.torch_api" in sys.modules
+                          or "horovod_tpu.torch" in sys.modules)
+            cycles = list(_CYCLES_MS) if torch_shim else []
         if config.cycle_time not in cycles:
             cycles.append(config.cycle_time)
         # Hierarchical-allreduce choice only exists on a true 2-level

@@ -26,9 +26,10 @@ matching the reference's semantics without a background thread.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import threading
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -157,25 +158,50 @@ def _run(kind: str, x, name: Optional[str], ps, per_rank_fn, op_label: str,
         if publish_meta is not None:
             _publish_abort(e)
         raise
-    if _is_multiprocess(mesh):
-        # Serialize cross-process eager collectives.  Two hazards on the
-        # multi-process CPU (Gloo) backend, both observed as
-        # "op.preamble.length <= op.nbytes ... distributed collective
-        # mismatch" aborts:
-        #  1. separately-compiled programs reuse the same collective
-        #     channel tags, so two programs in flight at once interleave
-        #     their Gloo messages across processes;
-        #  2. consecutive executions of even the SAME program reuse slots,
-        #     and local completion on one rank does not imply the peer
-        #     drained its tail messages -- the next dispatch can race them.
-        # block_until_ready closes (1) locally; the coordination-service
-        # barrier (gRPC, independent of the Gloo transport) closes (2) by
-        # ensuring every participant fully finished before anyone starts
-        # the next collective.  In-step fused collectives (one program per
-        # step) are unaffected; single-process and TPU paths skip this.
-        jax.block_until_ready(out)
-        _coordination_fence(mesh)
+    _eager_fence(mesh, out)
     return out
+
+
+def _mesh_platform(mesh: Mesh) -> str:
+    """Hardware platform backing the eager mesh ("cpu"/"tpu"/"gpu")."""
+    return getattr(mesh.devices.flat[0], "platform", "cpu")
+
+
+def _transport_needs_fence(mesh: Mesh) -> bool:
+    """Does this mesh's collective transport need post-dispatch
+    serialization?  The two hazards fenced below are properties of the
+    multi-process CPU (Gloo-style) transport; TPU/GPU collectives run on
+    compiler-scheduled dedicated channels and never interleave."""
+    return _mesh_platform(mesh) == "cpu"
+
+
+def _eager_fence(mesh: Mesh, out) -> None:
+    """Serialize cross-process eager collectives (backend-scoped).
+
+    Two hazards on the multi-process CPU (Gloo) backend, both observed
+    as "op.preamble.length <= op.nbytes ... distributed collective
+    mismatch" aborts:
+     1. separately-compiled programs reuse the same collective channel
+        tags, so two programs in flight at once interleave their Gloo
+        messages across processes;
+     2. consecutive executions of even the SAME program reuse slots, and
+        local completion on one rank does not imply the peer drained its
+        tail messages -- the next dispatch can race them.
+    block_until_ready closes (1) locally; the coordination-service
+    barrier (gRPC, independent of the Gloo transport) closes (2) by
+    ensuring every participant fully finished before anyone starts the
+    next collective.  In-step fused collectives (one program per step)
+    are unaffected; single-process paths skip this entirely, and a
+    TPU/GPU-backed mesh skips the block + barrier (its channels cannot
+    interleave) while still advancing the fence SEQUENCE -- join replay
+    keys op metadata on that counter, so it must tick identically on
+    every backend (see :func:`_coordination_fence`).
+    """
+    if not _is_multiprocess(mesh):
+        return
+    if _transport_needs_fence(mesh):
+        jax.block_until_ready(out)
+    _coordination_fence(mesh)
 
 
 _fence_lock = threading.Lock()
@@ -208,12 +234,19 @@ def _coordination_fence(mesh: Mesh) -> None:
     the name carries a per-participant-set sequence number, which matches
     across processes because SPMD requires them to issue eager collectives
     in the same order.
+
+    The sequence number advances on EVERY backend (it keys join-replay
+    metadata slots, so active and drained ranks must count identically);
+    the barrier WAIT itself is scoped to the CPU/Gloo transport that
+    needs it (:func:`_transport_needs_fence`).
     """
     procs = tuple(sorted({d.process_index for d in mesh.devices.flat}))
     with _fence_lock:
         seq = _fence_seq[procs] = _fence_seq.get(procs, 0) + 1
     client = getattr(jax._src.distributed.global_state, "client", None)
     if client is None:  # pragma: no cover - not under jax.distributed
+        return
+    if not _transport_needs_fence(mesh):
         return
     name = "hvd_eager_fence_" + "_".join(map(str, procs)) + f"_{seq}"
     client.wait_at_barrier(name, 60_000, process_ids=list(procs))
@@ -344,10 +377,87 @@ def poll(handle: int) -> bool:
 # ---------------------------------------------------------------------------
 
 _deferred_lock = threading.Lock()
-_deferred: List[tuple] = []          # (handle, thunk) in issue order
+_deferred: List[tuple] = []          # (handle, entry) in issue order
 _MAX_DEFERRED = 512                  # capacity flush (deterministic: count)
 _flush_lock = threading.RLock()      # serializes flushes across threads
 _flush_tls = threading.local()       # .active: THIS thread is mid-flush
+_fused_meta_tls = threading.local()  # .extra: in-flight fused dispatch meta
+
+_fuse_stats_lock = threading.Lock()
+_fuse_stats = {"flushes": 0, "fused_buckets": 0, "fused_ops": 0,
+               "singleton_ops": 0}
+
+
+def deferred_fuse_stats() -> dict:
+    """Cumulative fused-flush accounting since the last reset: flushes
+    run, fused buckets dispatched, ops that rode a fused bucket, ops
+    dispatched per-op (singletons).  Mirrors the ``deferred_fused_*``
+    timeline counters for callers without a timeline."""
+    with _fuse_stats_lock:
+        return dict(_fuse_stats)
+
+
+@dataclasses.dataclass
+class _DeferredAllreduce:
+    """Structured deferred entry.
+
+    Round-6: carries the request fields instead of an opaque thunk, so
+    ``flush_deferred`` can group compatible pending ops through the
+    fusion planner (the reference's fusion-buffer cycle groups on the
+    same Request fields).  ``dispatch`` reproduces the exact per-op call
+    for the unfused/fallback path."""
+    x: Any
+    op: Any
+    name: Optional[str]
+    process_set: Any          # resolved ProcessSet
+    prescale: float
+    postscale: float
+    compression: Any
+
+    def fuse_key(self) -> tuple:
+        """Ops fuse only when every program-changing parameter matches
+        (kind, dtype, reduce op, scale factors, codec, process set) --
+        the bucket then compiles, publishes, and replays as ONE
+        collective."""
+        return ("allreduce", str(jnp.dtype(self.x.dtype)), str(self.op),
+                float(self.prescale), float(self.postscale),
+                self.compression.__name__, self.process_set.name)
+
+    def dispatch(self):
+        return allreduce(self.x, self.op, name=self.name,
+                         process_set=self.process_set,
+                         prescale_factor=self.prescale,
+                         postscale_factor=self.postscale,
+                         compression=self.compression)
+
+
+def _deferred_fuse_enabled() -> bool:
+    st = global_state()
+    if st.config is not None:
+        return st.config.deferred_fuse
+    from ..core.config import _env_bool
+    return _env_bool("DEFERRED_FUSE", True)
+
+
+def _deferred_fuse_threshold() -> int:
+    """Per-rank bucket byte cap for the fused flush
+    (HOROVOD_DEFERRED_FUSE_THRESHOLD; 0 = follow the fusion threshold,
+    autotuner included)."""
+    st = global_state()
+    if st.config is not None and st.config.deferred_fuse_threshold > 0:
+        return st.config.deferred_fuse_threshold
+    from ..controller import fusion as _fusion
+    return _fusion._threshold()
+
+
+def _defer_applies(ps) -> bool:
+    """Should an ``*_async`` op on ``ps`` defer to the batched flush?
+    Exactly when the presence protocol applies (multi-process, global
+    set, join enabled): everywhere else JAX dispatch is already async
+    and immediate dispatch is strictly better.  Separate seam so tests
+    can force the deferred path on a single-process mesh."""
+    from . import joinop as _join
+    return _join._applies(ps)
 
 
 def _in_flush() -> bool:
@@ -357,10 +467,12 @@ def _in_flush() -> bool:
     return getattr(_flush_tls, "active", False)
 
 
-def _defer(thunk) -> int:
+def _defer(entry) -> int:
+    """Enqueue a deferred op: a :class:`_DeferredAllreduce` record
+    (fusable at flush) or a bare thunk (always per-op)."""
     h = _alloc_handle(_PENDING)
     with _deferred_lock:
-        _deferred.append((h, thunk))
+        _deferred.append((h, entry))
         full = len(_deferred) >= _MAX_DEFERRED
     if full:
         flush_deferred()
@@ -382,6 +494,9 @@ def reset_deferred() -> None:
     with _handle_lock:
         for h, _ in dropped:
             _handles.pop(h, None)
+    with _fuse_stats_lock:
+        for key in _fuse_stats:
+            _fuse_stats[key] = 0
 
 
 def _deferred_error(handle: int, cause: BaseException,
@@ -399,16 +514,168 @@ def _deferred_error(handle: int, cause: BaseException,
     return err
 
 
+@dataclasses.dataclass
+class _FlushUnit:
+    """One collective dispatch within a flush: a fused bucket of
+    compatible ops, or a single op on the per-op path."""
+    pos: int                       # issue position of the first member
+    handles: List[int]
+    dispatch: Callable[[], Dict[int, Any]]
+    fused: bool = False
+
+
+def _single_unit(pos: int, h: int, entry) -> _FlushUnit:
+    d = entry.dispatch if isinstance(entry, _DeferredAllreduce) else entry
+    return _FlushUnit(pos, [h], lambda h=h, d=d: {h: d()})
+
+
+def _fused_unit(bucket, widths, k: int) -> _FlushUnit:
+    """ONE collective for a planner bucket of compatible deferred ops.
+
+    The member rank-stacks reshape to ``[k, width]`` rows and concatenate
+    into one ``[k, sum(widths)]`` payload; a single :func:`allreduce`
+    carries it (one presence slot, one fence).  Results slice back per
+    handle through a jitted unfuse program (eager slicing of a
+    multi-process global array is not allowed outside jit) memoized in
+    the shared executable cache.  The bucket name is derived from the
+    first member's issue position -- deterministic across SPMD processes,
+    stable across identical flushes so the compiled program and unfuse
+    slicer both cache-hit.
+    """
+    pos = min(p for p, _, _ in bucket)
+    handles = [h for _, h, _ in bucket]
+    recs = [r for _, _, r in bucket]
+    r0 = recs[0]
+    name = f"deferred_fused.{jnp.dtype(r0.x.dtype).name}.{pos}"
+    widths = [int(w) for w in widths]
+    tails = [tuple(int(d) for d in r.x.shape[1:]) for r in recs]
+
+    def dispatch():
+        host = all(isinstance(r.x, np.ndarray) for r in recs)
+        cat = np.concatenate if host else jnp.concatenate
+        flats = [(r.x if host else jnp.asarray(r.x)).reshape(k, -1)
+                 for r in recs]
+        fused = cat(flats, axis=1)
+        # Publish the fused layout with the op metadata: a drained rank
+        # replays the bucket-level collective bitwise from kind + fused
+        # shape (joinop._replay also cross-checks the widths).
+        _fused_meta_tls.extra = {"fused_ops": len(recs),
+                                 "fused_widths": widths}
+        try:
+            red = allreduce(fused, r0.op, name=name,
+                            process_set=r0.process_set,
+                            prescale_factor=r0.prescale,
+                            postscale_factor=r0.postscale,
+                            compression=r0.compression)
+        finally:
+            _fused_meta_tls.extra = None
+        st = global_state()
+        key = signature("deferred_unfuse", name,
+                        (tuple(red.shape), str(red.dtype)),
+                        f"{widths}|{tails}", r0.process_set.name)
+
+        def build():
+            def unfuse(buf):
+                out, off = [], 0
+                for w, tail in zip(widths, tails):
+                    out.append(buf[:, off:off + w].reshape(
+                        (buf.shape[0],) + tail))
+                    off += w
+                return out
+            return jax.jit(unfuse)
+
+        vals = st.cache.get_or_build(key, build)(red)
+        return dict(zip(handles, vals))
+
+    return _FlushUnit(pos, handles, dispatch, fused=True)
+
+
+def _plan_flush_units(pending, fuse: bool) -> List[_FlushUnit]:
+    """Group pending deferred entries into dispatch units.
+
+    Compatible structured ops (same :meth:`_DeferredAllreduce.fuse_key`)
+    route through the shared fusion planner
+    (:func:`~horovod_tpu.controller.fusion.plan_eager_flush`) and pack
+    into per-rank buckets of at most the deferred-fuse threshold: one
+    fused collective + one fence per bucket.  Everything else -- opaque
+    thunks, mismatched keys, inputs that are not a well-formed local rank
+    stack -- keeps the per-op path, as does any bucket with a single
+    member (no concat/slice overhead for the trivial case).  The grouping
+    is pure in issue order + op signatures, so every SPMD process cuts
+    identical units -- required, since the unit count is published to
+    drained ranks as the flush size.  Units dispatch in the issue order
+    of their first member.
+    """
+    from ..controller import fusion as _fusion
+    units: List[_FlushUnit] = []
+    groups: Dict[tuple, List[tuple]] = {}
+    for pos, (h, entry) in enumerate(pending):
+        if not (fuse and isinstance(entry, _DeferredAllreduce)):
+            units.append(_single_unit(pos, h, entry))
+            continue
+        k = local_rank_count(entry.process_set)
+        shape = getattr(entry.x, "shape", ())
+        if k < 1 or len(shape) < 1 or shape[0] != k:
+            # Not a local rank stack: the per-op path raises the same
+            # error immediate dispatch would have.
+            units.append(_single_unit(pos, h, entry))
+            continue
+        groups.setdefault(entry.fuse_key(), []).append((pos, h, entry))
+    threshold = _deferred_fuse_threshold()
+    for members in groups.values():
+        if len(members) == 1:
+            units.append(_single_unit(*members[0]))
+            continue
+        recs = [entry for _, _, entry in members]
+        k = local_rank_count(recs[0].process_set)
+        spec = _fusion.plan_eager_flush(
+            [r.x for r in recs], k, threshold,
+            extra=(recs[0].process_set.name,))
+        for _dt, lspecs in spec.buffers:
+            if len(lspecs) == 1:
+                units.append(_single_unit(*members[lspecs[0].index]))
+                continue
+            units.append(_fused_unit([members[s.index] for s in lspecs],
+                                     [s.size for s in lspecs], k))
+    units.sort(key=lambda u: u.pos)
+    return units
+
+
+def _note_flush(units: List[_FlushUnit]) -> None:
+    """Account the flush plan (module stats + timeline counters)."""
+    fused = [u for u in units if u.fused]
+    n_fused_ops = sum(len(u.handles) for u in fused)
+    n_single = len(units) - len(fused)
+    with _fuse_stats_lock:
+        _fuse_stats["flushes"] += 1
+        _fuse_stats["fused_buckets"] += len(fused)
+        _fuse_stats["fused_ops"] += n_fused_ops
+        _fuse_stats["singleton_ops"] += n_single
+    tl = global_state().timeline
+    if tl:
+        tl.counters({"deferred_fused_buckets": len(fused),
+                     "deferred_fused_ops": n_fused_ops,
+                     "deferred_singleton_ops": n_single})
+
+
 def flush_deferred() -> None:
     """Dispatch every deferred async op behind ONE presence round.
 
-    Serialized under an RLock: a REENTRANT call (a thunk's own dispatch
+    Serialized under an RLock: a REENTRANT call (a unit's own dispatch
     re-entering via ``_join_sync``/``joinop.flush`` on the flushing
     thread) sees the thread-local flag and returns; a CONCURRENT thread's
     ``synchronize``/``poll``/collective blocks here until the in-flight
     flush lands its results -- returning early would let it pop the raw
     ``_PENDING`` sentinel as the op's value, or corrupt the in-flight
     joinop flush accounting.
+
+    Round-6: compatible pending ops FUSE (see :func:`_plan_flush_units`);
+    the published flush size is the number of dispatch UNITS, and each
+    fused unit publishes bucket-level metadata so drained ranks replay
+    one identical fused collective per bucket.  Results scatter back per
+    handle under the existing error-stamping protocol: every handle in a
+    failed unit gets its own error chained to the cause, handles in later
+    units get "aborted" errors.
     """
     with _flush_lock:
         if _in_flush():
@@ -422,27 +689,34 @@ def flush_deferred() -> None:
         _flush_tls.active = True
         try:
             ps = _ps.get_process_set(None)
-            with _join.flush(ps, len(pending)):
+            units = _plan_flush_units(pending, _deferred_fuse_enabled())
+            _note_flush(units)
+            with _join.flush(ps, len(units)):
                 err = None
-                for h, thunk in pending:
+                for unit in units:
                     if err is None:
                         try:
-                            value = thunk()
+                            values = unit.dispatch()
                         except BaseException as e:  # noqa: BLE001
                             err = e
-                            value = _deferred_error(h, e,
-                                                    "failed during flush")
+                            values = {
+                                h: _deferred_error(h, e,
+                                                   "failed during flush")
+                                for h in unit.handles}
                     else:
-                        # Ops after a failure never dispatch (the flush
+                        # Units after a failure never dispatch (the flush
                         # context publishes an abort for their slots);
                         # their synchronize() raises a fresh error chained
                         # to the op that sank the batch.
-                        value = _deferred_error(
-                            h, err, "aborted: an earlier op in the "
-                            "flushed batch failed")
+                        values = {
+                            h: _deferred_error(
+                                h, err, "aborted: an earlier op in the "
+                                "flushed batch failed")
+                            for h in unit.handles}
                     with _handle_lock:
-                        if h in _handles:
-                            _handles[h] = value
+                        for h, value in values.items():
+                            if h in _handles:
+                                _handles[h] = value
                 if err is not None:
                     raise err
         except BaseException as e:
@@ -492,6 +766,12 @@ def _join_sync(ps, kind: str, x, name: Optional[str], extra: dict = None):
             "dtype": str(xa.dtype)}
     if extra:
         meta.update(extra)
+    fused_extra = getattr(_fused_meta_tls, "extra", None)
+    if fused_extra:
+        # A fused deferred-flush bucket is in flight on this thread:
+        # publish its layout (op count + per-rank widths) with the op
+        # metadata so drained ranks replay the bucket-level collective.
+        meta.update(fused_extra)
     return k, meta, mask
 
 
@@ -557,16 +837,14 @@ def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
 def allreduce_async(x, op: ReduceOp = Average, *, name=None, process_set=None,
                     prescale_factor=1.0, postscale_factor=1.0,
                     compression=Compression.none) -> int:
-    from . import joinop as _join
     ps_ = _ps.get_process_set(process_set)
-    if not _in_flush() and _join._applies(ps_):
+    if not _in_flush() and _defer_applies(ps_):
         # Snapshot host inputs: the caller may mutate the buffer between
         # enqueue and flush (jax arrays are immutable; no copy needed).
         x_snap = x if isinstance(x, jax.Array) else np.array(x, copy=True)
-        return _defer(lambda: allreduce(
-            x_snap, op, name=name, process_set=process_set,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, compression=compression))
+        return _defer(_DeferredAllreduce(
+            x_snap, op, name, ps_, prescale_factor, postscale_factor,
+            compression))
     out = allreduce(x, op, name=name, process_set=process_set,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor, compression=compression)

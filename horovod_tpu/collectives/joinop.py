@@ -223,7 +223,11 @@ def flush(ps, n_ops: int):
     (or under-issue) with slots still pending publishes an abort record
     at the next slot so drained ranks fail fast instead of blocking
     until HOROVOD_JOIN_TIMEOUT.  Used by the grouped/fused eager entry
-    points, whose op count is known up front.
+    points, whose op count is known up front -- including the fused
+    deferred flush, where ``n_ops`` is the number of dispatch UNITS
+    (fused buckets + per-op fallbacks), not the number of pending
+    handles: drained ranks replay one collective per unit, with fused
+    buckets carrying their layout in the published metadata.
     """
     global _flush_state
     from . import eager
@@ -376,6 +380,19 @@ def _replay(meta: dict) -> None:
         k_local = eager.local_rank_count(None)
         row = shape[1:]
         if kind == "allreduce":
+            # Fused deferred-flush buckets replay through this same
+            # branch: the published shape IS the fused [n, sum(widths)]
+            # layout, so re-issuing it reproduces the active ranks'
+            # bucket collective bitwise.  Like the codecs, the layout is
+            # derived from the metadata rather than hand-listed -- the
+            # widths ride along purely as a cross-check against a
+            # corrupt/raced record (their sum must equal the row size).
+            widths = meta.get("fused_widths")
+            if widths is not None and tuple(row) != (int(sum(widths)),):
+                raise RuntimeError(
+                    f"fused replay metadata is inconsistent: bucket shape "
+                    f"{tuple(meta['shape'])} does not match widths "
+                    f"{widths} (sum {int(sum(widths))})")
             fill = identity_value(meta["op"], dtype)
             x = np.full((k_local,) + row, fill, dtype)
             eager.allreduce(x, ReduceOp(meta["op"]), name=name,

@@ -159,6 +159,38 @@ def _plan_buckets_uncached(leaves: Sequence[Any],
     return FusionSpec(buffers=tuple(buffers), num_leaves=len(leaves))
 
 
+def plan_eager_flush(leaves: Sequence[Any], k: int,
+                     threshold_bytes: Optional[int] = None,
+                     extra: Tuple = ()) -> FusionSpec:
+    """Bucket plan for the fused deferred-async flush (eager path).
+
+    Same greedy per-dtype packing as :func:`plan_buckets`, but the eager
+    layout is RANK-STACKED (``[k, ...]`` with ``k`` local ranks), so
+    bucket sizes are counted over each op's per-rank row -- the payload a
+    rank actually puts on the wire -- not over the whole stack.  Each
+    returned ``_LeafSpec``'s shape/size describe that flat row
+    (``size == prod(shape) // k``); ``index`` addresses the caller's leaf
+    list as usual.  Memoized in the shared plan cache under an
+    eager-flush-scoped key (``extra`` carries caller context such as the
+    process-set name).
+    """
+    if threshold_bytes is None:
+        threshold_bytes = _threshold()
+    leaves = [x if hasattr(x, "dtype") else jnp.asarray(x) for x in leaves]
+    k = max(int(k), 1)
+    cache = _get_plan_cache()
+    key = plan_key(leaves, threshold_bytes,
+                   extra=("eager_flush", k) + tuple(extra))
+
+    def build():
+        rows = [jax.ShapeDtypeStruct(
+            (int(np.prod(x.shape, dtype=np.int64)) // k,), x.dtype)
+            for x in leaves]
+        return _plan_buckets_uncached(rows, threshold_bytes)
+
+    return cache.get_or_build(key, build)
+
+
 def pack(leaves: Sequence[jax.Array], spec: FusionSpec) -> List[jax.Array]:
     """Ravel+concat leaves into flat buffers per the spec."""
     out = []

@@ -97,6 +97,19 @@ class Config:
     # latency-hiding scheduler can overlap wire time with FLOPs.
     microbatches: int = 1
 
+    # Fused deferred-async flush (HOROVOD_DEFERRED_FUSE, default on).
+    # At a flush point, compatible pending ``*_async`` ops (same kind,
+    # dtype, process set, codec, pre/postscale) pack into fusion-planner
+    # buckets and dispatch ONE collective + ONE fence per bucket instead
+    # of one per op -- the eager-path analogue of the reference's
+    # fusion-buffer cycle.  Off = round-5 per-op dispatch (still one
+    # presence round per flush).
+    deferred_fuse: bool = True
+
+    # Per-rank bucket size cap in bytes for the fused deferred flush
+    # (HOROVOD_DEFERRED_FUSE_THRESHOLD); 0 = follow fusion_threshold.
+    deferred_fuse_threshold: int = 0
+
     # Chunked gradient exchange (HOROVOD_EXCHANGE_CHUNK_MB, megabytes;
     # 0 disables).  Decomposes each fusion bucket's allreduce into
     # chunk-sized reduce-scatter + all-gather pairs so XLA's latency-hiding
@@ -236,6 +249,8 @@ def load_config() -> Config:
         zero_stage=_env_int("ZERO", 0),
         steps_per_exec=_env_int("STEPS_PER_EXEC", 1),
         microbatches=_env_int("MICROBATCHES", 1),
+        deferred_fuse=_env_bool("DEFERRED_FUSE", True),
+        deferred_fuse_threshold=_env_int("DEFERRED_FUSE_THRESHOLD", 0),
         exchange_chunk_bytes=_env_int("EXCHANGE_CHUNK_MB", 0) * _MiB,
         stall_check_disable=_env_bool("STALL_CHECK_DISABLE"),
         # Upstream spells these *_TIME_SECONDS; accept both spellings.

@@ -91,6 +91,22 @@ class Timeline:
                                  "ts": self._us(),
                                  "args": {name: float(value)}})
 
+    def counters(self, values: Dict[str, float],
+                 track: str = "counters") -> None:
+        """Several counter samples at ONE timestamp (a single "C" event
+        with multiple args renders as one stacked area).  Used by the
+        fused deferred flush to emit its ``deferred_fused_buckets`` /
+        fused-vs-singleton op counts as an atomic snapshot -- separate
+        :meth:`counter` calls would get distinct timestamps and make the
+        per-flush ratios unreadable in the trace viewer."""
+        with self._lock:
+            self._events.append({"name": "|".join(sorted(values)),
+                                 "ph": "C",
+                                 "pid": self._pid(track), "tid": 0,
+                                 "ts": self._us(),
+                                 "args": {k: float(v)
+                                          for k, v in values.items()}})
+
     def mark_cycle(self) -> None:
         if self.mark_cycles:
             self.instant("CYCLE")

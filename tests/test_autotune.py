@@ -341,6 +341,62 @@ def test_autotune_e2e_explores_hierarchical_axis(tmp_path, hvd):
         hv_mod.init()
 
 
+def test_autotune_value_demo_selects_modeled_optimum(hvd):
+    """The committed demo (examples/autotune_value_demo.py): under an
+    injected per-link bandwidth model on a (2, 4) two-level mesh, a
+    cold-start tuner with the compression axis opted in locks
+    hierarchical+fp8 when the slow DCN tier rewards them, and rejects
+    both when uniform fast links make quantize cost and the extra phase
+    pure overhead."""
+    import importlib.util
+    import os
+    import jax
+    import horovod_tpu as hv_mod
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    spec = importlib.util.spec_from_file_location(
+        "autotune_value_demo",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "examples",
+            "autotune_value_demo.py"))
+    demo = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(demo)
+
+    hv_mod.shutdown()
+    mesh = build_mesh(jax.devices()[:8], hierarchical=True, dcn_size=2)
+    hv_mod.init(mesh=mesh)
+    try:
+        slow_dcn = demo.run_scenario("contended_dcn")
+        assert slow_dcn["selected"] == {"hierarchical": 1, "codec": "fp8"}
+        uniform = demo.run_scenario("uniform_fast")
+        assert uniform["selected"] == {"hierarchical": 0, "codec": "none"}
+        # The model really orders the configs the way the selections say.
+        costs = slow_dcn["modeled_ms"]
+        assert costs["hier1_fp8"] == min(costs.values())
+        costs = uniform["modeled_ms"]
+        assert costs["hier0_none"] == min(costs.values())
+    finally:
+        hv_mod.shutdown()
+        hv_mod.init()
+
+
+def test_autotune_value_demo_artifact_committed():
+    """The demo's artifact is committed and internally consistent."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "AUTOTUNE_DEMO.json")
+    assert os.path.exists(path), "run examples/autotune_value_demo.py"
+    doc = json.load(open(path))
+    by_name = {r["scenario"]: r for r in doc["results"]}
+    assert by_name["contended_dcn"]["matches_model_optimum"]
+    assert by_name["uniform_fast"]["matches_model_optimum"]
+    assert by_name["contended_dcn"]["selected"] == {
+        "hierarchical": 1, "codec": "fp8"}
+    assert by_name["uniform_fast"]["selected"] == {
+        "hierarchical": 0, "codec": "none"}
+
+
 def test_autotune_e2e_flax_step(hvd):
     """Round-5: the tuned wrapper also drives make_flax_train_step (the
     RN50/CNN path used by the on-chip autotune demo) -- the tuner

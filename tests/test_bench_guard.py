@@ -252,6 +252,115 @@ def test_overlap_validator_trips_on_missing_or_bad_fraction(tmp_path):
     assert str(tmp_path / "BENCH_r84.json") in bad
 
 
+# -- eager latency probe shape -----------------------------------------------
+# bench.py's eager config (BENCH_EAGER=1) re-emits the
+# examples/eager_latency_probe.py JSON: a latency metric with no recorded
+# throughput baseline, so its vs_baseline must be null, all three dispatch
+# variants must be present and positive, and the fused deferred flush must
+# not be SLOWER than the unfused one (that would mean the fusion planner
+# added overhead without removing dispatches -- the regression the probe
+# exists to catch).
+
+
+def scan_eager_probe_entries(bench_dir):
+    """Return [(path, why), ...] for malformed eager-probe bench entries."""
+    bad = []
+    variant_keys = ("sync_ms", "deferred_unfused_ms", "deferred_fused_ms")
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            if parsed.get("metric") != "eager_latency_probe":
+                continue
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "eager probe vs_baseline must be null"))
+            variants = parsed.get("variants") or {}
+            missing = [k for k in variant_keys
+                       if not isinstance(variants.get(k), (int, float))
+                       or variants.get(k) <= 0]
+            if missing:
+                bad.append((path, f"missing/bad variants: {missing}"))
+                continue
+            if variants["deferred_fused_ms"] > variants[
+                    "deferred_unfused_ms"]:
+                bad.append((path, "fused slower than unfused: "
+                            f"{variants['deferred_fused_ms']} > "
+                            f"{variants['deferred_unfused_ms']}"))
+    return bad
+
+
+def test_committed_eager_probe_entries_well_formed():
+    assert scan_eager_probe_entries(REPO) == []
+
+
+def _write_eager(tmp_path, name, vs_baseline, variants):
+    parsed = {"metric": "eager_latency_probe", "value": 2.0,
+              "unit": "ms/batch", "vs_baseline": vs_baseline,
+              "config": "eager_probe_np2_k8_join-enabled"}
+    if variants is not None:
+        parsed["variants"] = variants
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def test_eager_validator_accepts_well_formed_entry(tmp_path):
+    _write_eager(tmp_path, "BENCH_r70.json", None,
+                 {"sync_ms": 43.4, "deferred_unfused_ms": 12.0,
+                  "deferred_fused_ms": 5.5})
+    assert scan_eager_probe_entries(str(tmp_path)) == []
+    # ...and the >=0.98 gate ignores it (vs_baseline null, 0.98 unchanged).
+    assert THRESHOLD == 0.98
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_eager_validator_trips_on_nonnull_vs_baseline(tmp_path):
+    _write_eager(tmp_path, "BENCH_r71.json", 1.1,
+                 {"sync_ms": 1.0, "deferred_unfused_ms": 1.0,
+                  "deferred_fused_ms": 1.0})
+    bad = scan_eager_probe_entries(str(tmp_path))
+    assert bad == [(str(tmp_path / "BENCH_r71.json"),
+                    "eager probe vs_baseline must be null")]
+
+
+def test_eager_validator_trips_on_missing_variant(tmp_path):
+    _write_eager(tmp_path, "BENCH_r72.json", None, None)
+    _write_eager(tmp_path, "BENCH_r73.json", None,
+                 {"sync_ms": 1.0, "deferred_fused_ms": 0.0})
+    bad = dict(scan_eager_probe_entries(str(tmp_path)))
+    assert str(tmp_path / "BENCH_r72.json") in bad
+    assert str(tmp_path / "BENCH_r73.json") in bad
+
+
+def test_eager_validator_trips_on_fused_slower_than_unfused(tmp_path):
+    _write_eager(tmp_path, "BENCH_r74.json", None,
+                 {"sync_ms": 3.0, "deferred_unfused_ms": 1.5,
+                  "deferred_fused_ms": 2.5})
+    bad = scan_eager_probe_entries(str(tmp_path))
+    assert len(bad) == 1 and "fused slower" in bad[0][1]
+
+
+def test_bench_eager_mode_flags(monkeypatch):
+    """BENCH_EAGER=1 selects the probe path; BENCH_EAGER_NP sizes it."""
+    import importlib
+
+    import bench
+    monkeypatch.setenv("BENCH_EAGER", "1")
+    b = importlib.reload(bench)
+    assert b.EAGER and b.EAGER_NP == 2
+    monkeypatch.setenv("BENCH_EAGER_NP", "4")
+    b = importlib.reload(bench)
+    assert b.EAGER_NP == 4
+    monkeypatch.delenv("BENCH_EAGER")
+    monkeypatch.delenv("BENCH_EAGER_NP")
+    b = importlib.reload(bench)
+    assert not b.EAGER
+
+
 def test_bench_config_string_gains_microbatch_suffix(monkeypatch):
     """bench.py's config string must mark overlap runs (that suffix is
     what makes vs_baseline null via the same_config gate)."""

@@ -1,0 +1,413 @@
+"""Fused deferred-async flush + backend-scoped fencing (round 6).
+
+The tentpole behavior under test: ``flush_deferred`` groups compatible
+pending ``*_async`` ops through the fusion planner and dispatches ONE
+collective per bucket, scattering results back per handle; the
+multi-process eager fence is scoped to the CPU/Gloo transport.  The
+multi-process end-to-end path (fused flush while a rank is drained) runs
+in ``test_run.py``/``examples/join_check.py``; these cover the planner,
+the scatter, the error protocol, the fence gating, and the published
+fused metadata on the virtual single-process mesh by forcing the
+deferred path through ``eager._defer_applies``.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from horovod_tpu.collectives import eager, joinop
+from horovod_tpu.collectives.compression import Compression
+from horovod_tpu.core.state import global_state
+
+
+def _force_defer(monkeypatch):
+    """Route *_async enqueues through the deferred queue on the
+    single-process test mesh (where the presence protocol -- the normal
+    trigger -- does not apply)."""
+    monkeypatch.setattr(eager, "_defer_applies", lambda ps: True)
+
+
+def test_mixed_dtype_and_codec_pending_set_splits_into_buckets(
+        hvd, monkeypatch):
+    """4x f32 + 2x f64 (same op) fuse into one bucket each; an Average op
+    and an fp16-codec op are incompatible with both and stay per-op."""
+    _force_defer(monkeypatch)
+    n = hvd.size()
+    hs = [hvd.allreduce_async(
+        hvd.replicated_stack(np.full((3,), i + 1.0, np.float32)),
+        hvd.Sum, name=f"f32_{i}") for i in range(4)]
+    hs += [hvd.allreduce_async(
+        hvd.replicated_stack(np.full((2, 2), 10.0 * (i + 1), np.float64)),
+        hvd.Sum, name=f"f64_{i}") for i in range(2)]
+    h_avg = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((5,), 6.0, np.float32)))
+    h_fp16 = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((4,), 2.0, np.float32)), hvd.Sum,
+        compression=Compression.fp16)
+    assert eager.deferred_count() == 8
+
+    vals = [hvd.synchronize(h) for h in hs]
+    for i in range(4):
+        assert vals[i].shape == (n, 3)
+        np.testing.assert_allclose(np.asarray(vals[i]), n * (i + 1.0))
+    for i in range(2):
+        assert vals[4 + i].shape == (n, 2, 2)
+        np.testing.assert_allclose(np.asarray(vals[4 + i]),
+                                   n * 10.0 * (i + 1))
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h_avg)), 6.0)
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h_fp16)), n * 2.0)
+
+    st = eager.deferred_fuse_stats()
+    assert st == {"flushes": 1, "fused_buckets": 2, "fused_ops": 6,
+                  "singleton_ops": 2}
+
+
+def test_mixed_scale_factors_do_not_fuse(hvd, monkeypatch):
+    """prescale/postscale are program parameters: ops differing in them
+    must not share a bucket (the fused collective has ONE scale pair)."""
+    _force_defer(monkeypatch)
+    n = hvd.size()
+    h1 = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((2,), 1.0, np.float32)), hvd.Sum)
+    h2 = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((2,), 1.0, np.float32)), hvd.Sum,
+        prescale_factor=0.5)
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h1)), n * 1.0)
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h2)), n * 0.5)
+    st = eager.deferred_fuse_stats()
+    assert st["fused_buckets"] == 0 and st["singleton_ops"] == 2
+
+
+def test_threshold_splits_same_key_ops_into_multiple_buckets(
+        hvd, monkeypatch):
+    """Per-rank row bytes cap the bucket: 3x 16-byte rows under a 32-byte
+    threshold pack as [2-op bucket, 1-op singleton]."""
+    _force_defer(monkeypatch)
+    st = global_state()
+    st.config = dataclasses.replace(st.config, deferred_fuse_threshold=32)
+    n = hvd.size()
+    hs = [hvd.allreduce_async(
+        hvd.replicated_stack(np.full((4,), i + 1.0, np.float32)),
+        hvd.Sum, name=f"t{i}") for i in range(3)]
+    for i, h in enumerate(hs):
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   n * (i + 1.0))
+    stats = eager.deferred_fuse_stats()
+    assert stats["fused_buckets"] == 1
+    assert stats["fused_ops"] == 2
+    assert stats["singleton_ops"] == 1
+
+
+def test_single_pending_op_has_no_fusion_overhead(hvd, monkeypatch):
+    """One pending op dispatches on the plain per-op path: no concat, no
+    unfuse program, no fused bucket accounted."""
+    _force_defer(monkeypatch)
+    n = hvd.size()
+    h = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((3,), 5.0, np.float32)), hvd.Sum)
+    assert eager.deferred_count() == 1
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h)), n * 5.0)
+    st = eager.deferred_fuse_stats()
+    assert st == {"flushes": 1, "fused_buckets": 0, "fused_ops": 0,
+                  "singleton_ops": 1}
+
+
+def test_deferred_fuse_disabled_keeps_per_op_dispatch(hvd, monkeypatch):
+    """HOROVOD_DEFERRED_FUSE=0 (config off): the round-5 behavior -- every
+    pending op its own collective, results unchanged."""
+    _force_defer(monkeypatch)
+    st = global_state()
+    st.config = dataclasses.replace(st.config, deferred_fuse=False)
+    n = hvd.size()
+    hs = [hvd.allreduce_async(
+        hvd.replicated_stack(np.full((3,), i + 1.0, np.float32)),
+        hvd.Sum) for i in range(4)]
+    for i, h in enumerate(hs):
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   n * (i + 1.0))
+    stats = eager.deferred_fuse_stats()
+    assert stats["fused_buckets"] == 0 and stats["singleton_ops"] == 4
+
+
+def test_double_synchronize_after_fused_flush_raises_keyerror(
+        hvd, monkeypatch):
+    """The round-5 handle contract survives fusion: a fused handle is
+    consumed by its first synchronize; retrying is a KeyError."""
+    _force_defer(monkeypatch)
+    h1 = hvd.allreduce_async(
+        hvd.replicated_stack(np.ones((2,), np.float32)), hvd.Sum)
+    h2 = hvd.allreduce_async(
+        hvd.replicated_stack(np.ones((2,), np.float32)), hvd.Sum)
+    hvd.synchronize(h1)
+    hvd.synchronize(h2)
+    assert eager.deferred_fuse_stats()["fused_buckets"] == 1
+    with pytest.raises(KeyError):
+        hvd.synchronize(h1)
+    with pytest.raises(KeyError):
+        hvd.synchronize(h2)
+
+
+def test_fused_dispatch_failure_stamps_every_member_handle(
+        hvd, monkeypatch):
+    """A failed fused bucket writes a FRESH error (chained to the shared
+    cause) into every member handle; ops in later units abort."""
+    _force_defer(monkeypatch)
+    boom = RuntimeError("transport down")
+
+    def raising_allreduce(*a, **k):
+        raise boom
+    h1 = hvd.allreduce_async(
+        hvd.replicated_stack(np.ones((2,), np.float32)), hvd.Sum)
+    h2 = hvd.allreduce_async(
+        hvd.replicated_stack(np.ones((2,), np.float32)), hvd.Sum)
+    h3 = hvd.allreduce_async(
+        hvd.replicated_stack(np.ones((2,), np.float64)), hvd.Average)
+    monkeypatch.setattr(eager, "allreduce", raising_allreduce)
+    errs = []
+    for h in (h1, h2, h3):
+        with pytest.raises(RuntimeError) as ei:
+            hvd.synchronize(h)
+        errs.append(ei.value)
+    assert errs[0] is not errs[1]
+    assert errs[0].__cause__ is boom and errs[1].__cause__ is boom
+    assert "failed during flush" in str(errs[0])
+    assert "aborted" in str(errs[2])
+
+
+def test_malformed_input_falls_back_to_per_op_error(hvd, monkeypatch):
+    """An input that is not a rank stack cannot fuse; its per-op dispatch
+    raises the SAME ValueError immediate dispatch would have, and a
+    well-formed op sharing the flush still has its error stamped per the
+    batch-abort protocol."""
+    _force_defer(monkeypatch)
+    h_bad = hvd.allreduce_async(np.float32(3.0), hvd.Sum)  # scalar
+    h_ok = hvd.allreduce_async(
+        hvd.replicated_stack(np.ones((2,), np.float32)), hvd.Sum)
+    with pytest.raises(RuntimeError, match="failed during flush") as ei:
+        hvd.synchronize(h_bad)
+    assert isinstance(ei.value.__cause__, ValueError)
+    assert "rank-stacked" in str(ei.value.__cause__)
+    with pytest.raises(RuntimeError, match="aborted"):
+        hvd.synchronize(h_ok)
+
+
+def test_fused_metadata_published_with_layout(hvd, monkeypatch):
+    """When a rank is drained (mocked mask), the fused bucket publishes
+    kind + fused shape + op count + per-rank widths -- everything a
+    drained rank needs to replay the bucket collective bitwise."""
+    _force_defer(monkeypatch)
+    n = hvd.size()
+
+    class _KV:
+        def __init__(self):
+            self.store = {}
+
+        def key_value_set(self, k, v, allow_overwrite=False):
+            self.store[k] = v
+    kv = _KV()
+    mask = np.ones((n,), np.int32)
+    mask[-1] = 0
+    monkeypatch.setattr(joinop, "client", lambda: kv)
+    monkeypatch.setattr(joinop, "sync", lambda ps: mask.copy())
+    h1 = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((3,), 1.0, np.float32)), hvd.Sum)
+    h2 = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((2, 2), 2.0, np.float32)), hvd.Sum)
+    hvd.synchronize(h1)
+    hvd.synchronize(h2)
+    ops = {k: json.loads(v) for k, v in kv.store.items()
+           if "/op/" in k}
+    assert len(ops) == 1, kv.store
+    meta = next(iter(ops.values()))
+    assert meta["kind"] == "allreduce"
+    assert tuple(meta["shape"]) == (n, 7)
+    assert meta["fused_ops"] == 2
+    assert meta["fused_widths"] == [3, 4]
+    # The thread-local must not leak past the dispatch.
+    assert getattr(eager._fused_meta_tls, "extra", None) is None
+
+
+def test_replay_validates_fused_widths(hvd):
+    """joinop._replay derives the fused layout from the metadata and
+    rejects a record whose widths disagree with the bucket shape."""
+    n = hvd.size()
+    good = {"kind": "allreduce", "name": "b", "shape": (n, 5),
+            "dtype": "float32", "op": "sum", "pre": 1.0, "post": 1.0,
+            "compression": "NoneCompressor",
+            "fused_ops": 2, "fused_widths": [2, 3]}
+    joinop._replay(good)  # single-process: dispatches a real allreduce
+    bad = dict(good, fused_widths=[2, 2])
+    with pytest.raises(RuntimeError, match="fused replay metadata"):
+        joinop._replay(bad)
+
+
+def test_flush_plan_reuses_shared_plan_cache(hvd, monkeypatch):
+    """Identical async batches hit the memoized eager-flush plan (the
+    shared controller.fusion ExecutableCache), not a fresh plan."""
+    from horovod_tpu.controller import fusion
+    _force_defer(monkeypatch)
+
+    def batch():
+        hs = [hvd.allreduce_async(
+            hvd.replicated_stack(np.full((3,), 1.0, np.float32)),
+            hvd.Sum) for _ in range(3)]
+        for h in hs:
+            hvd.synchronize(h)
+    batch()
+    before = fusion.plan_cache_stats()
+    batch()
+    after = fusion.plan_cache_stats()
+    assert after["hits"] == before["hits"] + 1
+    assert after["misses"] == before["misses"]
+    assert eager.deferred_fuse_stats()["fused_buckets"] == 2
+
+
+def test_flush_emits_timeline_counters(hvd, monkeypatch):
+    """The flush plan surfaces as ONE counters snapshot:
+    deferred_fused_buckets + fused-vs-singleton op counts."""
+    _force_defer(monkeypatch)
+    recorded = []
+
+    class _TL:
+        def counters(self, values, track="counters"):
+            recorded.append(dict(values))
+
+        def counter(self, name, value, track="counters"):
+            recorded.append({name: value})
+
+        def range(self, tensor, phase):
+            import contextlib
+            return contextlib.nullcontext()
+    monkeypatch.setattr(global_state(), "timeline", _TL())
+    hs = [hvd.allreduce_async(
+        hvd.replicated_stack(np.ones((2,), np.float32)), hvd.Sum)
+        for _ in range(3)]
+    h_single = hvd.allreduce_async(
+        hvd.replicated_stack(np.ones((2,), np.float64)), hvd.Sum)
+    for h in hs + [h_single]:
+        hvd.synchronize(h)
+    snaps = [r for r in recorded if "deferred_fused_buckets" in r]
+    assert snaps == [{"deferred_fused_buckets": 1, "deferred_fused_ops": 3,
+                      "deferred_singleton_ops": 1}]
+
+
+def test_timeline_counters_event_shape(tmp_path):
+    """Timeline.counters writes one 'C' event carrying every value."""
+    from horovod_tpu.timeline import Timeline
+    path = str(tmp_path / "tl.json")
+    tl = Timeline(path)
+    tl.counters({"a": 1, "b": 2.5})
+    tl.close()
+    events = json.loads(open(path).read())
+    cs = [e for e in events if e.get("ph") == "C"]
+    assert len(cs) == 1
+    assert cs[0]["args"] == {"a": 1.0, "b": 2.5}
+
+
+# ---------------------------------------------------------------------------
+# Backend-scoped fencing.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _FakeDevice:
+    process_index: int
+    platform: str
+
+
+class _FakeMesh:
+    """Duck-typed mesh: just enough surface for the fence helpers."""
+
+    def __init__(self, platforms_by_proc):
+        self.devices = np.array(
+            [_FakeDevice(p, plat) for p, plat in platforms_by_proc],
+            dtype=object)
+
+
+class _BarrierSpy:
+    def __init__(self):
+        self.calls = []
+
+    def wait_at_barrier(self, name, timeout_ms, process_ids=None):
+        self.calls.append((name, tuple(process_ids)))
+
+
+def _spy_block(monkeypatch):
+    calls = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda x: calls.append(1) or real(x))
+    return calls
+
+
+def test_eager_fence_skipped_on_tpu_like_backend(hvd, monkeypatch):
+    """A multi-process mesh on a TPU-like backend must NOT pay the
+    block_until_ready + named barrier (the two hazards it closes are
+    Gloo-transport properties) -- but the fence SEQUENCE still advances,
+    because join replay keys op metadata on it."""
+    mesh = _FakeMesh([(0, "tpu"), (1, "tpu")])
+    spy = _BarrierSpy()
+    monkeypatch.setattr(jax._src.distributed.global_state, "client", spy,
+                        raising=False)
+    blocks = _spy_block(monkeypatch)
+    seq_before = eager._peek_next_seq((0, 1))
+    eager._eager_fence(mesh, np.zeros((2,)))
+    assert blocks == []
+    assert spy.calls == []
+    assert eager._peek_next_seq((0, 1)) == seq_before + 1
+
+
+def test_eager_fence_cpu_transport_unchanged(hvd, monkeypatch):
+    """The CPU/Gloo multi-process path keeps both halves of the fence:
+    local completion + the sequence-named coordination barrier."""
+    mesh = _FakeMesh([(0, "cpu"), (1, "cpu")])
+    spy = _BarrierSpy()
+    monkeypatch.setattr(jax._src.distributed.global_state, "client", spy,
+                        raising=False)
+    blocks = _spy_block(monkeypatch)
+    seq = eager._peek_next_seq((0, 1))
+    eager._eager_fence(mesh, np.zeros((2,)))
+    assert blocks == [1]
+    assert spy.calls == [(f"hvd_eager_fence_0_1_{seq}", (0, 1))]
+
+
+def test_eager_fence_noop_single_process(hvd, monkeypatch):
+    """Single-process meshes skip the fence entirely on every backend --
+    including the sequence bump (there is nobody to coordinate with)."""
+    mesh = _FakeMesh([(0, "cpu"), (0, "cpu")])
+    spy = _BarrierSpy()
+    monkeypatch.setattr(jax._src.distributed.global_state, "client", spy,
+                        raising=False)
+    blocks = _spy_block(monkeypatch)
+    seq_before = eager._peek_next_seq((0,))
+    eager._eager_fence(mesh, np.zeros((2,)))
+    assert blocks == [] and spy.calls == []
+    assert eager._peek_next_seq((0,)) == seq_before
+
+
+def test_transport_predicate_reads_mesh_platform(hvd):
+    assert eager._transport_needs_fence(_FakeMesh([(0, "cpu"), (1, "cpu")]))
+    assert not eager._transport_needs_fence(
+        _FakeMesh([(0, "tpu"), (1, "tpu")]))
+    assert not eager._transport_needs_fence(
+        _FakeMesh([(0, "gpu"), (1, "gpu")]))
+
+
+def test_real_eager_dispatch_on_mocked_tpu_mesh_skips_fence(
+        hvd, monkeypatch):
+    """End-to-end through _run: with the mesh reported multi-process and
+    TPU-backed, an eager allreduce must issue no barrier wait.  (The
+    compute itself still runs on the virtual CPU devices; only the
+    platform probe is mocked.)"""
+    spy = _BarrierSpy()
+    monkeypatch.setattr(jax._src.distributed.global_state, "client", spy,
+                        raising=False)
+    monkeypatch.setattr(eager, "_mesh_platform", lambda mesh: "tpu")
+    monkeypatch.setenv("HOROVOD_JOIN_DISABLE", "1")
+    n = hvd.size()
+    out = hvd.allreduce(
+        hvd.replicated_stack(np.ones((3,), np.float32)), hvd.Sum)
+    np.testing.assert_allclose(eager.one_row(out), n * 1.0)
+    assert spy.calls == []

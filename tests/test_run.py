@@ -172,6 +172,10 @@ def test_join_drains_stragglers(np_):
     # Round-5 deferred async batch (3 ops, one presence round) issued
     # while the other rank(s) are drained.
     assert f"rank {last}: async-ungrouped-during-join OK" in out.stdout
+    # Round-6 fused flush: a mixed-dtype async batch splits into two
+    # fused buckets mid-drain; drained ranks replay them bitwise from
+    # the published fused layouts.
+    assert f"rank {last}: fused-async-during-join OK" in out.stdout
     assert f"rank {last}: join2 OK last={last}" in out.stdout
 
 
