@@ -73,6 +73,15 @@ MICRO_K = int(os.environ.get("HVD_TPU_MICROBATCHES",
 OVERLAP = _env_on("BENCH_OVERLAP") or MICRO_K > 1
 if OVERLAP and MICRO_K < 1:
     MICRO_K = 4
+# HOROVOD_COMPRESSION=powersgd:<rank>|topk:<fraction> benches the
+# error-feedback compressed gradient exchange (collectives/compression.py):
+# the DistributedOptimizer threads residual state through the step and the
+# result carries wire bytes vs the uncompressed planner payload.  Composes
+# with HOROVOD_ZERO=1 (compressed param-delta allgather) and
+# HOROVOD_MICROBATCHES>1 (one exchange per step).  Different config string
+# -> vs_baseline null.
+COMPRESSION = (os.environ.get("HVD_TPU_COMPRESSION")
+               or os.environ.get("HOROVOD_COMPRESSION") or "").strip()
 # BENCH_TINY=1 swaps RN50 for a one-stage 8-filter ResNet on 32x32 inputs:
 # a plumbing smoke config (CPU-runnable), never comparable to the baseline.
 TINY = _env_on("BENCH_TINY")
@@ -87,9 +96,11 @@ EAGER_NP = int(os.environ.get("BENCH_EAGER_NP", "2"))
 
 def _config() -> str:
     base = f"tinycnn_batch{BATCH}" if TINY else f"batch{BATCH}_s2d_bf16"
+    comp = COMPRESSION.replace(":", "").replace(".", "p")
     return (base + ("_zero1" if ZERO else "")
             + (f"_scanloop{SCAN_K}" if SCANLOOP else "")
-            + (f"_microbatch{MICRO_K}" if OVERLAP else ""))
+            + (f"_microbatch{MICRO_K}" if OVERLAP else "")
+            + (f"_{comp}" if comp else ""))
 FLOPS_PER_IMAGE = 12.3e9  # RN50 fwd+bwd estimate
 V5E_BF16_PEAK = 197e12
 
@@ -153,6 +164,80 @@ def _main_eager():
     os._exit(0)
 
 
+TRAJECTORY_COLUMNS = ("round", "metric", "value", "unit", "vs_baseline",
+                      "config")
+_TRAJ_BEGIN = "<!-- BENCH_TRAJECTORY_BEGIN -->"
+_TRAJ_END = "<!-- BENCH_TRAJECTORY_END -->"
+
+
+def build_trajectory_rows(repo: str):
+    """Fold every ``BENCH_r*.json`` into one row list (round-sorted).
+
+    Each row carries exactly :data:`TRAJECTORY_COLUMNS`; files without a
+    ``parsed`` result (a crashed round) still get a row, with a null
+    value, so the trajectory never silently drops a round.
+    """
+    import glob
+    import re
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        p = rec.get("parsed") or {}
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        rows.append({
+            "round": int(rec.get("n", int(m.group(1)) if m else 0)),
+            "metric": p.get("metric", "(no result)"),
+            "value": p.get("value"),
+            "unit": p.get("unit", ""),
+            "vs_baseline": p.get("vs_baseline"),
+            "config": p.get("config", "-"),
+        })
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def render_trajectory_table(rows) -> str:
+    """Markdown table over :data:`TRAJECTORY_COLUMNS`."""
+    def cell(v):
+        return "null" if v is None else str(v)
+    lines = ["| " + " | ".join(TRAJECTORY_COLUMNS) + " |",
+             "|" + "---|" * len(TRAJECTORY_COLUMNS)]
+    for r in rows:
+        lines.append("| " + " | ".join(cell(r[c])
+                                       for c in TRAJECTORY_COLUMNS) + " |")
+    return "\n".join(lines)
+
+
+def _main_trajectory():
+    """``bench.py --trajectory``: merge the per-round result files into one
+    table between the trajectory markers in docs/benchmarks.md (replacing
+    the previous merge; appended as a new section on first run)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rows = build_trajectory_rows(repo)
+    if not rows:
+        sys.exit("no BENCH_r*.json files found; nothing to merge")
+    table = render_trajectory_table(rows)
+    block = (f"{_TRAJ_BEGIN}\n{table}\n{_TRAJ_END}")
+    doc = os.path.join(repo, "docs", "benchmarks.md")
+    with open(doc) as f:
+        text = f.read()
+    if _TRAJ_BEGIN in text and _TRAJ_END in text:
+        head, rest = text.split(_TRAJ_BEGIN, 1)
+        _, tail = rest.split(_TRAJ_END, 1)
+        text = head + block + tail
+    else:
+        text = (text.rstrip("\n")
+                + "\n\n## Benchmark trajectory (merged per-round results)\n\n"
+                + block + "\n")
+    with open(doc, "w") as f:
+        f.write(text)
+    print(f"merged {len(rows)} round(s) into {doc}")
+
+
 def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     if EAGER:
@@ -198,9 +283,12 @@ def main():
     zero_stats = None
     if ZERO:
         opt = optax.sgd(0.1, momentum=0.9)
-        opt_state = hvd.zero_init(opt, params)
-        step = make_flax_train_step(model.apply, opt, zero_stage=1)
-        zero_stats = hvd.zero_report(opt, params, n)
+        opt_state = hvd.zero_init(opt, params,
+                                  compression=COMPRESSION or None)
+        step = make_flax_train_step(model.apply, opt, zero_stage=1,
+                                    zero_compression=COMPRESSION or None)
+        zero_stats = hvd.zero_report(opt, params, n,
+                                     compression=COMPRESSION or None)
         print("# zero1: "
               f"RS {zero_stats['reducescatter_bytes_per_chip']/2**20:.1f} + "
               f"AG {zero_stats['allgather_bytes_per_chip']/2**20:.1f} MiB/"
@@ -212,7 +300,8 @@ def main():
               f"{zero_stats['opt_state_bytes_per_chip_replicated']/2**20:.1f}"
               " MiB replicated", file=sys.stderr)
     else:
-        opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                       compression=COMPRESSION or None)
         opt_state = hvd.replicate(opt.init(params))
         step = make_flax_train_step(model.apply, opt)
 
@@ -332,6 +421,32 @@ def main():
     ips = float(rates.mean())
 
     grad_bytes = sum(v.size * 4 for v in jax.tree.leaves(params))
+    comp_stats = None
+    if COMPRESSION:
+        from horovod_tpu.collectives.compression import (parse_compression,
+                                                         wire_payload_bytes)
+        comp = parse_compression(COMPRESSION)
+        if ZERO:
+            # zero_report already prices the compressed param-delta
+            # allgather; the ratio compares against the replicated
+            # allreduce equivalent over the same params.
+            wire = (zero_stats["reducescatter_bytes_per_chip"]
+                    + zero_stats["allgather_bytes_per_chip"])
+            raw = zero_stats["replicated_allreduce_bytes_per_chip"]
+        else:
+            from horovod_tpu.optim.distributed import ef_bucket_plan
+            plan = ef_bucket_plan(jax.tree.leaves(params), None, comp)
+            wire = sum(wire_payload_bytes(
+                comp, sum(s.size for s in lspecs),
+                jnp.dtype(dt).itemsize, n) for dt, lspecs in plan.buffers)
+            raw = grad_bytes
+        comp_stats = {"codec": COMPRESSION,
+                      "wire_bytes_per_step": int(wire),
+                      "uncompressed_bytes_per_step": int(raw),
+                      "ratio": round(raw / max(wire, 1), 2)}
+        print(f"# compression {COMPRESSION}: wire "
+              f"{wire/2**20:.2f} MiB/step vs {raw/2**20:.1f} MiB "
+              f"uncompressed ({comp_stats['ratio']:.1f}x)", file=sys.stderr)
     if n > 1:
         # Honest bus-BW bound (SURVEY.md section 7 hard part 4): each step
         # moves >= 2*(n-1)/n * grad_bytes per chip for a ring allreduce.
@@ -364,9 +479,14 @@ def main():
     if overlap_fraction is not None:
         result["overlap_fraction"] = round(overlap_fraction, 4)
         result["microbatches"] = MICRO_K
+    if comp_stats is not None:
+        result["compression"] = comp_stats
     print(json.dumps(result), flush=True)
     os._exit(0)  # skip slow atexit teardown; result is already printed
 
 
 if __name__ == "__main__":
-    main()
+    if "--trajectory" in sys.argv[1:]:
+        _main_trajectory()
+    else:
+        main()

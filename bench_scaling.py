@@ -32,6 +32,14 @@ Usage::
                          # (microbatches=4): k per-bucket reduce-scatters
                          # interleaved with backward + one final all-gather
                          # -- eq payload (k+1)/2 x the padded bucket bytes
+    python bench_scaling.py --models rn50-powersgd --ns 8 16
+                         # PowerSGD error-feedback exchange (rank 4): two
+                         # factor psums per bucket, eq payload r*(m+c)*4 B
+                         # per bucket (>=8x under the uncompressed row);
+                         # also runs a CPU convergence-proxy parity check
+                         # vs the uncompressed exchange.  (topk is bench.py
+                         # -only: its allgather wire grows with n, so the
+                         # mesh-invariance gate does not apply.)
     python bench_scaling.py --worker rn50 8  # (internal) one subprocess
 
 Prints one summary JSON line (machine-readable gate) after the tables.
@@ -81,6 +89,14 @@ _STEP_ALIASES = {}
 # BENCH_OVERLAP=1 / HOROVOD_MICROBATCHES=4).
 OVERLAP_K = 4
 
+# PowerSGD rank for the -powersgd variant (bench.py's counterpart is
+# HOROVOD_COMPRESSION=powersgd:4); parity bound for the CPU convergence
+# proxy (final-loss ratio vs uncompressed after PARITY_STEPS on the tiny
+# CNN -- the tests' EF parity bound is tighter, this is regression wire).
+POWERSGD_RANK = 4
+PARITY_STEPS = 30
+PARITY_BOUND = 1.25
+
 # CNN cases: (constructor kwargs, image size).  Spatial size does not
 # affect gradient payload EXCEPT for VGG (the 224x224 fc1 holds most of
 # its 138M params), so VGG compiles at full resolution; Inception needs
@@ -125,6 +141,10 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
     overlap = model.endswith("-overlap")
     if overlap:
         cnn_base = model[:-len("-overlap")]
+    efspec = ""
+    if model.endswith("-powersgd"):
+        cnn_base = model[:-len("-powersgd")]
+        efspec = f"powersgd:{POWERSGD_RANK}"
     if cnn_base in _CNN_CASES:
         from horovod_tpu import models as zoo
         # fp32 params = the bench configuration's wire dtype; the -fp8
@@ -153,13 +173,23 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         stats = variables.get("batch_stats", {})
         opt = hvd.DistributedOptimizer(
             optax.sgd(0.1, momentum=0.9),
-            compression=hvd.Compression.fp8 if fp8
-            else hvd.Compression.none)
+            compression=efspec or (hvd.Compression.fp8 if fp8
+                                   else hvd.Compression.none))
         opt_state = jax.eval_shape(opt.init, params)
         step = make_flax_train_step(
             m.apply, opt, microbatches=OVERLAP_K if overlap else None)
-        args = (abstract(params, rep), abstract(stats, rep),
-                abstract(opt_state, rep),
+        if efspec:
+            # Error-feedback state: per-bucket residuals are [n, size],
+            # sharded over the leading axis (the shard-map pytree-prefix
+            # spec in training._opt_state_spec), inner state replicated.
+            opt_abs = type(opt_state)(
+                residuals=tuple(
+                    jax.ShapeDtypeStruct(r.shape, r.dtype, sharding=bat)
+                    for r in opt_state.residuals),
+                inner=abstract(opt_state.inner, rep))
+        else:
+            opt_abs = abstract(opt_state, rep)
+        args = (abstract(params, rep), abstract(stats, rep), opt_abs,
                 (jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=bat),
                  jax.ShapeDtypeStruct(y.shape, y.dtype, sharding=bat)))
         stats_leaves = len(jax.tree.leaves(stats))
@@ -177,6 +207,11 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
                           for l in jax.tree.leaves(stats))
         if fp8:
             expected_emitted = None
+        elif efspec:
+            # PowerSGD: TWO factor psums per bucket (P, then the
+            # orthonormalized back-projection Q) replace the bucket
+            # all-reduce.
+            expected_emitted = 2 * buckets + stats_leaves + 1
         elif chunked or overlap:
             # Bucket exchange is RS(+AG), not all-reduces: only the
             # BN-stat and loss all-reduces remain.
@@ -206,6 +241,19 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
                 padded = size + (-size) % q
                 padded_bytes += padded * jnp.dtype(dt).itemsize
             payload = (OVERLAP_K + 1) * padded_bytes / 2 + stats_bytes + 4
+        elif efspec:
+            # Low-rank factor wire per bucket: r*(m+c) f32 elements across
+            # the two psums (mesh-invariant -- factor shapes depend only
+            # on the bucket size), plus the untouched BN-stat and loss
+            # all-reduces.
+            from horovod_tpu.collectives.compression import (
+                parse_compression, wire_payload_bytes)
+            comp = parse_compression(efspec)
+            payload = sum(
+                wire_payload_bytes(comp, sum(s.size for s in lspecs),
+                                   jnp.dtype(dt).itemsize, n)
+                for dt, lspecs in plan_buckets(grad_leaves).buffers) \
+                + stats_bytes + 4
         else:
             payload = grad_bytes + stats_bytes + 4
     elif model in ("bert-large", "bert-base", "bert-tiny",
@@ -334,11 +382,16 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         payload = sum(l.size * l.dtype.itemsize for l in grad_leaves) + 4
     else:
         raise SystemExit(f"unknown model {model!r}")
-    return step, args, {
+    expected = {
         "buckets": buckets,
         "expected_emitted_allreduces": expected_emitted,
         "predicted_payload_bytes": payload,
     }
+    if efspec:
+        expected["uncompressed_payload_bytes"] = \
+            sum(l.size * l.dtype.itemsize for l in grad_leaves) \
+            + stats_bytes + 4
+    return step, args, expected
 
 
 def run_worker(model: str, n: int, topology: str = "") -> None:
@@ -430,8 +483,71 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
     }), flush=True)
 
 
+def run_parity_worker(model: str, n: int,
+                      steps: int = PARITY_STEPS) -> None:
+    """Convergence proxy for the -powersgd variant: train the tiny CNN
+    (bench.py's BENCH_TINY config) on a virtual CPU mesh for ``steps``
+    steps with the error-feedback codec and uncompressed, same data and
+    init, and print the final-loss ratio as one JSON line.  A proxy, not
+    a benchmark: one repeated batch, so the loss must drop under both
+    exchanges and the ratio bounds the codec's optimization drag
+    (tests/test_compression_ef.py holds the tight bound)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.utils.platform import force_host_device_count
+    force_host_device_count(n, cpu=True)
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import BasicBlock, ResNet
+    from horovod_tpu.training import make_flax_train_step
+
+    hvd.init()
+    assert model.endswith("-powersgd"), model
+    spec = f"powersgd:{POWERSGD_RANK}"
+    m = ResNet(stage_sizes=[1], block_cls=BasicBlock, num_filters=8,
+               num_classes=10, dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+    gb = 4 * n
+    x = jax.random.normal(key, (gb, 32, 32, 3), jnp.float32)
+    y = jax.random.randint(key, (gb,), 0, 10, jnp.int32)
+
+    def run(compression):
+        # Fresh init per run (same key -> identical values): the donated
+        # step consumes the replicated buffers, which can alias the init
+        # tree, so reusing one init across runs reads deleted arrays.
+        variables = m.init(key, x[:2], train=True)
+        batch = hvd.shard_batch((x, y))
+        params = hvd.replicate(variables["params"])
+        stats = hvd.replicate(variables["batch_stats"])
+        opt = hvd.DistributedOptimizer(optax.sgd(0.05, momentum=0.9),
+                                       compression=compression)
+        opt_state = hvd.replicate(opt.init(variables["params"]))
+        step = make_flax_train_step(m.apply, opt)
+        losses = []
+        for _ in range(steps):
+            params, stats, opt_state, loss = step(params, stats,
+                                                  opt_state, batch)
+            losses.append(float(loss))
+        return losses
+
+    base = run(None)
+    comp = run(spec)
+    tail = max(steps // 6, 1)
+    b = float(np.mean(base[-tail:]))
+    c = float(np.mean(comp[-tail:]))
+    print(json.dumps({
+        "parity_spec": spec, "steps": steps, "n": n,
+        "loss_first": round(base[0], 4),
+        "final_loss_uncompressed": round(b, 4),
+        "final_loss_compressed": round(c, 4),
+        "ratio": round(c / max(b, 1e-9), 4),
+    }), flush=True)
+
+
 def _spawn(model: str, n: int, timeout: int = 2400,
-           topology: str = "") -> dict:
+           topology: str = "", parity: bool = False) -> dict:
     # Autotune must not leak into workers: the tuned wrapper is a plain
     # function without .lower(), which the AOT accounting needs.
     env = {k: v for k, v in os.environ.items()
@@ -445,9 +561,14 @@ def _spawn(model: str, n: int, timeout: int = 2400,
                         "HOROVOD_STEPS_PER_EXEC",
                         "HVD_TPU_STEPS_PER_EXEC",
                         "HOROVOD_MICROBATCHES",
-                        "HVD_TPU_MICROBATCHES")}
-    cmd = [sys.executable, os.path.abspath(__file__), "--worker", model,
-           str(n)]
+                        "HVD_TPU_MICROBATCHES",
+                        # The -powersgd worker passes its codec through
+                        # the optimizer argument, never the environment.
+                        "HOROVOD_COMPRESSION", "HVD_TPU_COMPRESSION",
+                        "HOROVOD_EF_RESIDUAL", "HVD_TPU_EF_RESIDUAL",
+                        "HOROVOD_AUTOTUNE_CODEC", "HVD_TPU_AUTOTUNE_CODEC")}
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--parity" if parity else "--worker", model, str(n)]
     if topology:
         cmd += ["--topology", topology]
     proc = subprocess.run(
@@ -552,6 +673,9 @@ def run_topology_mode(args) -> int:
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--worker", nargs=2, metavar=("MODEL", "N"))
+    p.add_argument("--parity", nargs=2, metavar=("MODEL", "N"),
+                   help="(internal) convergence-proxy subprocess for the "
+                        "-powersgd variant")
     p.add_argument("--models", nargs="+",
                    default=["rn50", "bert-large"])
     p.add_argument("--ns", nargs="+", type=int, default=[8, 16, 32])
@@ -569,6 +693,9 @@ def main() -> int:
     if args.worker:
         run_worker(args.worker[0], int(args.worker[1]),
                    topology=args.topology)
+        return 0
+    if args.parity:
+        run_parity_worker(args.parity[0], int(args.parity[1]))
         return 0
     if args.topology:
         return run_topology_mode(args)
@@ -620,6 +747,32 @@ def main() -> int:
             "payload_bytes": payloads[0], "planner_bytes": predicted,
             "spread": spread, "buckets": rows[0]["buckets"],
         }
+        # Gates 5+6 (-powersgd): the factor wire clears the >=8x
+        # reduction target, and the CPU convergence proxy stays within
+        # the parity bound of the uncompressed exchange.
+        unc = rows[0].get("uncompressed_payload_bytes")
+        if unc:
+            ratio = unc / payloads[0]
+            print(f"- wire: {payloads[0]/2**20:.2f} MiB eq-AR payload vs "
+                  f"{unc/2**20:.1f} MiB uncompressed ({ratio:.1f}x)")
+            summary[model]["wire_ratio_vs_uncompressed"] = round(ratio, 2)
+            if ratio < 8.0:
+                ok = False
+                print(f"FAIL: compressed wire ratio {ratio:.1f}x below "
+                      "the 8x target")
+        if model.endswith("-powersgd"):
+            pr = _spawn(model, min(args.ns), parity=True)
+            print(f"- convergence proxy ({pr['steps']} steps, tiny CNN, "
+                  f"n={pr['n']}): loss {pr['final_loss_compressed']} "
+                  f"EF-compressed vs {pr['final_loss_uncompressed']} "
+                  f"uncompressed (ratio {pr['ratio']}, bound "
+                  f"{PARITY_BOUND})")
+            summary[model]["parity"] = pr
+            if not (pr["ratio"] <= PARITY_BOUND
+                    and pr["final_loss_compressed"] < pr["loss_first"]):
+                ok = False
+                print(f"FAIL: EF convergence proxy outside bound "
+                      f"({pr})")
 
         if model in MEASURED_STEP_SECONDS:
             step_s = MEASURED_STEP_SECONDS[model]

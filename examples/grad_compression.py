@@ -1,0 +1,139 @@
+"""Error-feedback gradient compression on the wire — powersgd / topk.
+
+Trains the same small model three ways — uncompressed, ``powersgd:<r>``
+(rank-r low-rank factorization per fusion bucket), and ``topk:<f>``
+(top-k magnitude selection exchanged by allgather) — and prints the
+per-step wire bytes next to the loss trajectories, so the
+bandwidth/convergence trade is visible in one run.  Both codecs carry an
+error-feedback residual in the optimizer state: whatever a step's
+compression dropped is re-injected into the next step's exchange, which
+is what keeps the compressed loss tracking the exact one.
+
+Run on any device set (TPU chips or virtual CPU mesh)::
+
+    python examples/grad_compression.py [--steps 60] [--cpu-devices 8]
+    python examples/grad_compression.py --compression powersgd:8 --zero
+"""
+
+import sys as _sys
+from os.path import abspath as _abs, dirname as _dir
+_sys.path.insert(0, _dir(_dir(_abs(__file__))))  # repo root importable
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="global batch size (split across devices)")
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--compression", default=None,
+                   help="run ONLY this codec (e.g. powersgd:8, topk:0.1) "
+                        "instead of the three-way comparison")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1 path: compress the param-delta allgather "
+                        "leg, residuals on the shard owner")
+    p.add_argument("--microbatches", type=int, default=1,
+                   help="k>1: accumulate k microbatch gradients locally, "
+                        "ONE compressed exchange per step")
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="force N virtual CPU devices (testing)")
+    args = p.parse_args()
+
+    if args.cpu_devices:
+        from horovod_tpu.utils.platform import force_host_device_count
+        force_host_device_count(args.cpu_devices, cpu=True, exact=True)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.collectives.compression import (parse_compression,
+                                                     wire_payload_bytes)
+    from horovod_tpu.optim.distributed import ef_bucket_plan
+
+    hvd.init()
+    n = hvd.size()
+    if hvd.rank() == 0:
+        print(f"devices: {n} ({jax.devices()[0].platform})")
+
+    # Two-layer MLP on synthetic gaussian-cluster data: enough structure
+    # that the gradient has low-rank-ish content for powersgd to exploit.
+    rng = np.random.RandomState(42)
+    centers = rng.randn(10, 64).astype(np.float32)
+
+    def make_batch(step):
+        r = np.random.RandomState(step)
+        y = r.randint(0, 10, size=args.batch_size)
+        x = centers[y] + 0.5 * r.randn(args.batch_size, 64)
+        return x.astype(np.float32), y.astype(np.int32)
+
+    def init_params(key):
+        k1, k2 = jax.random.split(key)
+        he = jax.nn.initializers.he_normal()
+        return {"w1": he(k1, (64, 128), jnp.float32),
+                "b1": jnp.zeros((128,)),
+                "w2": he(k2, (128, 10), jnp.float32),
+                "b2": jnp.zeros((10,))}
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = jax.nn.relu(x @ params["w1"] + params["b1"])
+        logits = h @ params["w2"] + params["b2"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    def wire_per_step(spec, params):
+        grad_bytes = sum(l.size * 4 for l in jax.tree.leaves(params))
+        if not spec:
+            return grad_bytes
+        comp = parse_compression(spec)
+        plan = ef_bucket_plan(jax.tree.leaves(params), None, comp)
+        return sum(wire_payload_bytes(
+            comp, sum(s.size for s in leaves), jnp.dtype(dt).itemsize, n)
+            for dt, leaves in plan.buffers)
+
+    def train(spec):
+        params = hvd.replicate(init_params(jax.random.key(0)))
+        if args.zero:
+            opt = optax.sgd(args.lr, momentum=0.9)
+            opt_state = hvd.zero_init(opt, params, compression=spec)
+            step = hvd.make_train_step(loss_fn, opt, zero_stage=1,
+                                       zero_compression=spec)
+        else:
+            opt = hvd.DistributedOptimizer(
+                optax.sgd(args.lr, momentum=0.9), compression=spec)
+            opt_state = hvd.replicate(
+                opt.init(jax.device_get(
+                    hvd.replicate(init_params(jax.random.key(0))))))
+            step = hvd.make_train_step(loss_fn, opt,
+                                       microbatches=args.microbatches)
+        losses = []
+        for i in range(args.steps):
+            batch = hvd.shard_batch(make_batch(i))
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+        return losses
+
+    specs = [args.compression] if args.compression else \
+        [None, f"powersgd:4", f"topk:0.05"]
+    results = {}
+    for spec in specs:
+        results[spec or "uncompressed"] = (
+            train(spec), wire_per_step(spec, init_params(jax.random.key(0))))
+
+    if hvd.rank() == 0:
+        base_wire = wire_per_step(None, init_params(jax.random.key(0)))
+        print(f"\n{'codec':<14} {'wire/step':>12} {'ratio':>7} "
+              f"{'loss@0':>8} {'loss@end':>9}")
+        for name, (losses, wire) in results.items():
+            print(f"{name:<14} {wire:>10} B {base_wire / wire:>6.1f}x "
+                  f"{losses[0]:>8.4f} {losses[-1]:>9.4f}")
+        print("\n(error feedback keeps the compressed trajectories "
+              "tracking the exact one; try --microbatches 2 or --zero "
+              "to see the composed paths)")
+
+
+if __name__ == "__main__":
+    main()

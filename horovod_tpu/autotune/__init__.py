@@ -30,7 +30,10 @@ knobs where a real choice survives under XLA:
   (:func:`~horovod_tpu.collectives.ops.hierarchical_allreduce`);
 * **compression codec** (OPT-IN via ``HOROVOD_AUTOTUNE_COMPRESSION=1``,
   because it changes wire numerics): configured default vs bf16 vs fp16
-  vs fp8 (e4m3 exchange-level codec, ``compression.py``);
+  vs fp8 (e4m3 exchange-level codec, ``compression.py``).  PR 5 extends
+  the same axis with error-feedback codec candidates via
+  ``HOROVOD_AUTOTUNE_CODEC=powersgd:<r>,topk:<f>,...`` (probed in their
+  stateless form -- see ``Autotuner.__init__``);
 * **ZeRO exchange** (OPT-IN via ``HOROVOD_AUTOTUNE_ZERO=1`` on a
   ``HOROVOD_ZERO=1`` run): reduce-scatter + allgather vs allreduce
   gradient exchange over the sharded arena (``optim/zero.py``) -- the
@@ -79,8 +82,11 @@ _THRESHOLDS = [2 * _MiB, 8 * _MiB, 32 * _MiB, 64 * _MiB, 128 * _MiB]
 _CYCLES_MS = [0.5, 1.0, 5.0]
 MAX_SAMPLES = 12
 # Compression axis encoding (grid value -> codec); 0 keeps whatever the
-# optimizer was configured with.
+# optimizer was configured with.  Codes >= COMP_CODEC_BASE are
+# error-feedback codec candidates from HOROVOD_AUTOTUNE_CODEC, positional
+# in that comma list (see Autotuner.__init__).
 COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8 = 0, 1, 2, 3
+COMP_CODEC_BASE = 4
 
 
 def _grid(thresholds, cycles, hiers, comps, zeros, chunks, steps,
@@ -130,9 +136,29 @@ class Autotuner:
         # mesh; compression retuning is opt-in (it changes numerics).
         hiers = [0, 1] if _mesh_is_two_level() else \
             [1 if config.hierarchical_allreduce else 0]
-        from ..core.config import _env_bool
+        from ..core.config import _env, _env_bool
         comps = [COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8] \
             if _env_bool("AUTOTUNE_COMPRESSION") else [COMP_DEFAULT]
+        # Error-feedback codec candidates (HOROVOD_AUTOTUNE_CODEC, a comma
+        # list of "powersgd:<rank>" / "topk:<fraction>" specs): each spec
+        # extends the compression axis with its own code from
+        # COMP_CODEC_BASE upward, mapped back to the compressor by
+        # ``compression_override``.  The probe samples run the STATELESS
+        # form of the codec (no residual state threads through the tuner),
+        # so the score measures wire/ortho cost, not converged quality.
+        # Codes above the fixed four are positional in the env list --
+        # reorder the list between runs and a warm-start log's codec rows
+        # re-seed a different candidate, so keep the list stable.
+        self._codec_axis = {}
+        codec_spec = _env("AUTOTUNE_CODEC")
+        if codec_spec:
+            from ..collectives.compression import parse_compression
+            for i, tok in enumerate(
+                    t.strip() for t in codec_spec.split(",") if t.strip()):
+                code = COMP_CODEC_BASE + i
+                self._codec_axis[code] = parse_compression(tok)
+                if code not in comps:
+                    comps.append(code)
         # ZeRO exchange axis (opt-in, HOROVOD_AUTOTUNE_ZERO=1): only a
         # zero-configured run can switch -- the sharded state layout is
         # fixed at step build time, so the searchable pair is the
@@ -219,6 +245,8 @@ class Autotuner:
             return Compression.fp16
         if k == COMP_FP8:
             return Compression.fp8
+        if k >= COMP_CODEC_BASE:
+            return self._codec_axis[k]
         return configured
 
     def zero_stage(self) -> int:

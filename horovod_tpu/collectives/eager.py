@@ -808,10 +808,37 @@ def allreduce(x, op: ReduceOp = Average, *, name: Optional[str] = None,
         jmeta.update(op=str(op), pre=prescale_factor,
                      post=postscale_factor,
                      compression=compression.__name__)
+        from .compression import is_powersgd, powersgd_factor_widths
+        if is_powersgd(compression):
+            # Replay metadata for the low-rank codec: a drained rank
+            # re-traces the factor exchange from shape alone, so publish
+            # the factor widths (rank x matricized dims) for the replay
+            # cross-check in joinop._replay.
+            row = int(np.prod(np.asarray(x).shape[1:], dtype=np.int64))
+            jmeta.update(factor_widths=list(
+                powersgd_factor_widths(max(row, 1), compression.rank)))
 
     def per_rank(t):
-        from .compression import is_fp8
+        from .compression import is_fp8, is_powersgd, is_topk
         from .reduce_op import Adasum as _Adasum
+        if is_powersgd(compression) or is_topk(compression):
+            if op is _Adasum:
+                raise NotImplementedError(
+                    "error-feedback codecs do not compose with Adasum")
+            # Stateless form: the eager control plane has nowhere to
+            # thread residual state, so the residual is dropped (same
+            # one-shot semantics the autotuner's probe samples use).
+            if is_powersgd(compression):
+                out, _ = _ops.powersgd_allreduce(
+                    t, op, rank=compression.rank, axes=(HVD_AXIS,),
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+            else:
+                out, _ = _ops.topk_allreduce(
+                    t, op, fraction=compression.fraction, axes=(HVD_AXIS,),
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+            return out
         if is_fp8(compression):
             if op is _Adasum:
                 return _ops.allreduce(t, op, axes=(HVD_AXIS,),

@@ -354,13 +354,13 @@ def _replay(meta: dict) -> None:
     """Re-issue the published collective with an identity payload."""
     global _replaying
     from . import eager
-    from .compression import Compression
     from .reduce_op import ReduceOp
 
     # Derived from the namespace, not hand-listed: publish serializes ANY
     # compression.__name__, so a codec added to Compression must replay.
-    comps = {c.__name__: c for c in vars(Compression).values()
-             if isinstance(c, type)}
+    # resolve_compressor_name additionally re-derives parameterized codecs
+    # (PowerSGD<r>/TopK<f>) whose factory never ran on this drained rank.
+    from .compression import resolve_compressor_name
     kind = meta["kind"]
     name = meta.get("name")
     _replaying = True
@@ -393,12 +393,33 @@ def _replay(meta: dict) -> None:
                     f"fused replay metadata is inconsistent: bucket shape "
                     f"{tuple(meta['shape'])} does not match widths "
                     f"{widths} (sum {int(sum(widths))})")
+            comp = resolve_compressor_name(meta["compression"])
+            fwidths = meta.get("factor_widths")
+            if fwidths is not None:
+                # Low-rank replay cross-check: the widths the active side
+                # will exchange must match what this rank re-derives from
+                # shape + codec rank, or the traced factor programs
+                # diverge and the psum wedges.
+                from .compression import (powersgd_factor_widths,
+                                          is_powersgd)
+                if not is_powersgd(comp):
+                    raise RuntimeError(
+                        f"replay metadata carries factor_widths but codec "
+                        f"{meta['compression']!r} is not a low-rank codec")
+                size = max(int(np.prod(row, dtype=np.int64)), 1)
+                expect = list(powersgd_factor_widths(size, comp.rank))
+                if list(fwidths) != expect:
+                    raise RuntimeError(
+                        f"low-rank replay metadata is inconsistent: "
+                        f"published factor widths {list(fwidths)} != "
+                        f"{expect} derived from shape {tuple(meta['shape'])} "
+                        f"and rank {comp.rank}")
             fill = identity_value(meta["op"], dtype)
             x = np.full((k_local,) + row, fill, dtype)
             eager.allreduce(x, ReduceOp(meta["op"]), name=name,
                             prescale_factor=meta["pre"],
                             postscale_factor=meta["post"],
-                            compression=comps[meta["compression"]])
+                            compression=comp)
         elif kind == "broadcast":
             eager.broadcast(np.zeros((k_local,) + row, dtype),
                             meta["root"], name=name)

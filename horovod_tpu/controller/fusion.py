@@ -102,7 +102,8 @@ def plan_key(leaves: Sequence[Any], threshold_bytes: int,
 
 def plan_buckets(leaves: Sequence[Any],
                  threshold_bytes: Optional[int] = None,
-                 reverse: bool = False) -> FusionSpec:
+                 reverse: bool = False,
+                 extra: Tuple = ()) -> FusionSpec:
     """Greedily pack leaves into per-dtype buckets of <= threshold bytes.
 
     Order within a dtype follows leaf order (gradients arrive in reverse
@@ -121,13 +122,18 @@ def plan_buckets(leaves: Sequence[Any],
     (anything with ``.shape``/``.dtype``): the plan depends only on shapes
     and dtypes, so the scan-loop runner can plan its exchange ahead of data.
     Plans are memoized in a bounded LRU (see :func:`plan_cache_stats`).
+
+    ``extra`` is folded into the memo key for caller context that changes
+    what a bucket MEANS without changing its packing -- e.g. the exchange
+    codec name, so an error-feedback plan (whose bucket sizes fix the
+    residual-state shapes) never aliases a plain plan of the same leaves.
     """
     if threshold_bytes is None:
         threshold_bytes = _threshold()
     leaves = [x if hasattr(x, "dtype") else jnp.asarray(x) for x in leaves]
     cache = _get_plan_cache()
     key = plan_key(leaves, threshold_bytes,
-                   extra=("rev",) if reverse else ())
+                   extra=(("rev",) if reverse else ()) + tuple(extra))
     return cache.get_or_build(
         key, lambda: _plan_buckets_uncached(leaves, threshold_bytes, reverse))
 
@@ -229,15 +235,17 @@ def unfuse_flat(buffers: Sequence[jax.Array], spec: FusionSpec
 
 
 def fused_tree_collective(tree, collective_fn,
-                          threshold_bytes: Optional[int] = None):
+                          threshold_bytes: Optional[int] = None,
+                          extra: Tuple = ()):
     """Apply ``collective_fn(flat_buffer) -> flat_buffer`` to a whole pytree
     through the fusion buffers.  This is the gradient hot path used by
-    :class:`horovod_tpu.optim.DistributedOptimizer`.
+    :class:`horovod_tpu.optim.DistributedOptimizer`.  ``extra`` is caller
+    context for the plan memo key (see :func:`plan_buckets`).
     """
     leaves, treedef = jax.tree.flatten(tree)
     if not leaves:
         return tree
-    spec = plan_buckets(leaves, threshold_bytes)
+    spec = plan_buckets(leaves, threshold_bytes, extra=extra)
     buffers = pack(leaves, spec)
     reduced = [collective_fn(b) for b in buffers]
     return jax.tree.unflatten(treedef, unpack(reduced, spec))

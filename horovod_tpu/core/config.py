@@ -110,6 +110,19 @@ class Config:
     # (HOROVOD_DEFERRED_FUSE_THRESHOLD); 0 = follow fusion_threshold.
     deferred_fuse_threshold: int = 0
 
+    # Default gradient-exchange codec (HOROVOD_COMPRESSION): a spec string
+    # parsed by ``collectives.compression.parse_compression`` --
+    # none|fp16|bf16|fp8|powersgd:<rank>|topk:<fraction>.  Applies to
+    # DistributedOptimizer wraps built without an explicit ``compression``
+    # argument; None = no compression.
+    compression: Optional[str] = None
+
+    # Error-feedback residual carry for the powersgd/topk codecs
+    # (HOROVOD_EF_RESIDUAL, default on).  Off drops each step's
+    # compression error instead of feeding it back -- ablation only, it
+    # biases convergence.
+    ef_residual: bool = True
+
     # Chunked gradient exchange (HOROVOD_EXCHANGE_CHUNK_MB, megabytes;
     # 0 disables).  Decomposes each fusion bucket's allreduce into
     # chunk-sized reduce-scatter + all-gather pairs so XLA's latency-hiding
@@ -249,6 +262,8 @@ def load_config() -> Config:
         zero_stage=_env_int("ZERO", 0),
         steps_per_exec=_env_int("STEPS_PER_EXEC", 1),
         microbatches=_env_int("MICROBATCHES", 1),
+        compression=_env("COMPRESSION"),
+        ef_residual=_env_bool("EF_RESIDUAL", True),
         deferred_fuse=_env_bool("DEFERRED_FUSE", True),
         deferred_fuse_threshold=_env_int("DEFERRED_FUSE_THRESHOLD", 0),
         exchange_chunk_bytes=_env_int("EXCHANGE_CHUNK_MB", 0) * _MiB,

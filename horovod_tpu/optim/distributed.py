@@ -25,9 +25,55 @@ import jax.numpy as jnp
 import optax
 
 from ..collectives import ops as _ops
-from ..collectives.compression import Compression
+from ..collectives.compression import (Compression, is_error_feedback,
+                                       is_powersgd, parse_compression,
+                                       wire_payload_bytes)
 from ..collectives.reduce_op import ReduceOp, Average
 from ..controller.fusion import fused_tree_collective
+
+
+def _resolve_compression(compression):
+    """``None`` defers to ``HOROVOD_COMPRESSION`` (a spec string resolved
+    through :func:`parse_compression`); an explicit codec or spec string is
+    taken as-is.  Passing ``Compression.none`` explicitly disables the env
+    default."""
+    if compression is None:
+        from ..core.state import global_state
+        cfg = global_state().config
+        spec = cfg.compression if cfg is not None else None
+        return parse_compression(spec)
+    return parse_compression(compression)
+
+
+def _ef_enabled() -> bool:
+    """``HOROVOD_EF_RESIDUAL`` (default on): whether the EF codecs carry
+    residual state across steps.  Off means the compression error is
+    dropped every step -- useful only for ablations."""
+    from ..core.state import global_state
+    cfg = global_state().config
+    return cfg.ef_residual if cfg is not None else True
+
+
+def _stateless_ef_collective(buf, compression, op, axes,
+                             prescale_factor, postscale_factor):
+    """One EF-codec exchange with no residual (autotune sampling, direct
+    ``allreduce_gradients`` calls, the eager path).  Non-floating buckets
+    fall back to the plain allreduce -- the codecs are float-only."""
+    if not jnp.issubdtype(buf.dtype, jnp.floating):
+        return _ops.allreduce(buf, op, axes=axes,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor)
+    if is_powersgd(compression):
+        out, _ = _ops.powersgd_allreduce(
+            buf, op, rank=compression.rank, axes=axes,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+    else:
+        out, _ = _ops.topk_allreduce(
+            buf, op, fraction=compression.fraction, axes=axes,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+    return out
 
 
 def allreduce_gradients(grads,
@@ -49,8 +95,10 @@ def allreduce_gradients(grads,
     (``HOROVOD_AUTOTUNE_COMPRESSION=1``) -- the compression codec.
     """
     from ..collectives.compression import is_fp8
+    from ..collectives.reduce_op import Adasum as _Adasum
     from ..controller.fusion import exchange_chunk_bytes
     from ..core.state import global_state
+    compression = parse_compression(compression)
     st = global_state()
     chunk_bytes = exchange_chunk_bytes()
     tuner = st.autotuner
@@ -61,6 +109,12 @@ def allreduce_gradients(grads,
             # The tuner's fp8 axis cannot serve subset reductions (the
             # quantized exchange has no masked identity); keep the
             # configured codec for this sample instead of failing it.
+            override = compression
+        if (is_error_feedback(override)
+                and not is_error_feedback(compression)
+                and (process_set is not None or op is _Adasum)):
+            # Same escape hatch for the tuner's EF-codec axis: the factored/
+            # sparse exchanges serve full-mesh Sum/Average only.
             override = compression
         compression = override
         explicit_hier = tuner.hierarchical_explicit()
@@ -74,6 +128,19 @@ def allreduce_gradients(grads,
 
     def collective(buf):
         ax = resolved_axes()
+        if is_error_feedback(compression):
+            # Exchange-level EF codec WITHOUT residual state: the stateful
+            # path lives in the DistributedOptimizer wrap (it owns the
+            # residual carry); this surface serves tuner samples and
+            # direct calls, where dropping the error is acceptable.
+            if process_set is not None:
+                raise NotImplementedError(
+                    "powersgd/topk do not support process-set reductions "
+                    "(no masked identity for a factored/sparse exchange); "
+                    "use fp16/bf16 there")
+            return _stateless_ef_collective(
+                buf, compression, op, axes, prescale_factor,
+                postscale_factor)
         if is_fp8(compression):
             # Exchange-level codec: the collective itself changes (a psum
             # cannot carry fp8 -- compression.py module docstring).
@@ -128,7 +195,11 @@ def allreduce_gradients(grads,
     if world == 1:
         return jax.tree.map(collective, grads)
 
-    return fused_tree_collective(grads, collective, fusion_threshold)
+    # The codec name rides the plan memo key: an EF-codec plan pins the
+    # residual-state shapes, so it must never alias a plain plan of the
+    # same leaf list at the same threshold.
+    return fused_tree_collective(grads, collective, fusion_threshold,
+                                 extra=(compression.__name__,))
 
 
 class _AccumState(NamedTuple):
@@ -137,10 +208,135 @@ class _AccumState(NamedTuple):
     inner: Any                    # wrapped optimizer state
 
 
+class _EFState(NamedTuple):
+    """Optimizer-state carry for the error-feedback codecs.
+
+    ``residuals`` is one flat f32 array PER FUSION BUCKET with a leading
+    world axis (``[world, bucket_size]`` globally, ``[1, bucket_size]``
+    inside the shard-mapped step) -- residuals are PER-RANK state (each
+    rank's compression error differs), so ``make_train_step`` shards them
+    ``P(axes)`` like ZeRO state while ``inner`` stays replicated.
+    """
+    residuals: Any                # tuple of [world, bucket_size] f32
+    inner: Any                    # wrapped optimizer state
+
+
+def _ef_threshold(fusion_threshold: Optional[int]) -> int:
+    """Bucket threshold for EF plans, resolved ONCE and pinned: residual
+    shapes live in the optimizer state, so the autotuner's threshold axis
+    must not re-plan under them (config value, never the tuner's)."""
+    if fusion_threshold is not None:
+        return int(fusion_threshold)
+    from ..core.state import global_state
+    cfg = global_state().config
+    return cfg.fusion_threshold if cfg is not None else 64 * 1024 * 1024
+
+
+def _ef_world() -> int:
+    """Leading residual axis: the FULL mesh size (``make_train_step``
+    shards optimizer state over every mesh axis)."""
+    from ..core.state import global_state
+    mesh = global_state().mesh
+    return int(mesh.devices.size) if mesh is not None else 1
+
+
+def ef_bucket_plan(leaves, fusion_threshold: Optional[int], compression):
+    from ..controller.fusion import plan_buckets
+    return plan_buckets(leaves, _ef_threshold(fusion_threshold),
+                        extra=("ef", compression.__name__))
+
+
+def ef_init_residuals(params, fusion_threshold: Optional[int], compression):
+    """Zero residual carry matching the EF bucket plan of ``params``-shaped
+    gradients: one ``[world, bucket_size]`` f32 array per bucket."""
+    leaves = jax.tree.leaves(params)
+    spec = ef_bucket_plan(leaves, fusion_threshold, compression)
+    world = _ef_world()
+    return tuple(
+        jnp.zeros((world, sum(s.size for s in lspecs)), jnp.float32)
+        for _dt, lspecs in spec.buffers)
+
+
+def _note_compression_ratio(spec, compression) -> None:
+    """Host-side ``compression_ratio`` counter (trace-time: the ratio is a
+    pure function of the static bucket shapes)."""
+    from ..core.state import global_state
+    tl = global_state().timeline
+    if tl is None:
+        return
+    raw = wire = 0
+    for dt, lspecs in spec.buffers:
+        size = sum(s.size for s in lspecs)
+        itemsize = jnp.dtype(dt).itemsize
+        raw += size * itemsize
+        wire += wire_payload_bytes(compression, size, itemsize)
+    if wire > 0:
+        tl.counters({"compression_ratio": raw / wire,
+                     "wire_bytes_per_step": wire,
+                     "uncompressed_bytes_per_step": raw})
+
+
+def ef_exchange(grads, residuals, *, compression, op=Average,
+                fusion_threshold: Optional[int] = None, axes=None,
+                prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Error-feedback fused gradient exchange: the stateful hot path.
+
+    ``residuals`` is the per-bucket tuple of flat f32 arrays from the
+    PREVIOUS step (local view, no leading world axis).  Returns
+    ``(reduced_grads, new_residuals)``.  With ``HOROVOD_EF_RESIDUAL=0``
+    the residual input is ignored (zeros) and the carry is returned
+    unchanged, so the state shape stays stable across the flag.
+    """
+    from ..controller.fusion import pack, unpack
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return grads, residuals
+    spec = ef_bucket_plan(leaves, fusion_threshold, compression)
+    if len(residuals) != len(spec.buffers):
+        raise ValueError(
+            f"EF residual carry has {len(residuals)} buckets but the plan "
+            f"has {len(spec.buffers)} -- optimizer state initialized under "
+            f"a different fusion threshold or codec?")
+    buffers = pack(leaves, spec)
+    feed = _ef_enabled()
+    out_bufs, new_res = [], []
+    for buf, res, (dt, _ls) in zip(buffers, residuals, spec.buffers):
+        if not jnp.issubdtype(buf.dtype, jnp.floating):
+            out_bufs.append(_ops.allreduce(
+                buf, op, axes=axes, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor))
+            new_res.append(res)
+            continue
+        r_in = res if feed else None
+        if is_powersgd(compression):
+            out, r_out = _ops.powersgd_allreduce(
+                buf, op, rank=compression.rank, axes=axes, residual=r_in,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+        else:
+            out, r_out = _ops.topk_allreduce(
+                buf, op, fraction=compression.fraction, axes=axes,
+                residual=r_in, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+        out_bufs.append(out)
+        new_res.append(r_out if feed else res)
+    _note_compression_ratio(spec, compression)
+    return (jax.tree.unflatten(treedef, unpack(out_bufs, spec)),
+            tuple(new_res))
+
+
+def is_ef_optimizer(optimizer) -> bool:
+    """True when ``optimizer`` is a DistributedOptimizer wrap whose codec
+    needs the error-feedback state carry (its state is an :class:`_EFState`
+    and must be sharded ``P(axes)`` on the residual leaves)."""
+    ex = getattr(optimizer.update, "_hvd_exchange", None)
+    return ex is not None and is_error_feedback(ex["compression"])
+
+
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          *,
                          op: ReduceOp = Average,
-                         compression=Compression.none,
+                         compression=None,
                          fusion_threshold: Optional[int] = None,
                          axes=None,
                          process_set=None,
@@ -155,9 +351,64 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
         opt = hvd.DistributedOptimizer(optax.adamw(1e-3),
                                        compression=hvd.Compression.bf16)
+
+    ``compression`` accepts a codec class, a spec string
+    (``"powersgd:2"``, ``"topk:0.01"``, ``"bf16"``, ...), or ``None`` to
+    follow ``HOROVOD_COMPRESSION``.  The error-feedback codecs
+    (``Compression.powersgd(r)`` / ``Compression.topk(f)``) make the
+    optimizer STATEFUL beyond the inner state: ``init`` returns an
+    :class:`_EFState` carrying one per-rank residual array per fusion
+    bucket, and each ``update`` runs the factored/sparse exchange with the
+    residual fed back (``HOROVOD_EF_RESIDUAL``).
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    compression = _resolve_compression(compression)
+
+    if is_error_feedback(compression):
+        if process_set is not None:
+            raise NotImplementedError(
+                "powersgd/topk do not support process-set reductions; use "
+                "fp16/bf16 compression there")
+        from ..collectives.reduce_op import Adasum as _Adasum
+        if op is _Adasum:
+            raise NotImplementedError(
+                "powersgd/topk support Sum/Average reductions only")
+        if backward_passes_per_step != 1:
+            raise NotImplementedError(
+                "error-feedback compression with backward_passes_per_step"
+                " > 1 is not supported; use microbatches=k instead "
+                "(residual applied once per optimizer step)")
+
+        def ef_init(params):
+            return _EFState(
+                residuals=ef_init_residuals(params, fusion_threshold,
+                                            compression),
+                inner=optimizer.init(params))
+
+        def ef_update(grads, state, params=None, **extra):
+            if not isinstance(state, _EFState):
+                # Checkpoint restore may rebuild the carry as a plain
+                # 2-tuple; the layout is positional either way.
+                state = _EFState(*state)
+            local_res = tuple(r[0] for r in state.residuals)
+            reduced, new_res = ef_exchange(
+                grads, local_res, compression=compression, op=op,
+                fusion_threshold=fusion_threshold, axes=axes,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            updates, inner = optimizer.update(reduced, state.inner, params,
+                                              **extra)
+            return updates, _EFState(tuple(r[None] for r in new_res), inner)
+
+        ef_update._hvd_allreduce = True
+        ef_update._hvd_inner = optimizer
+        ef_update._hvd_exchange = dict(
+            op=op, compression=compression, fusion_threshold=fusion_threshold,
+            axes=axes, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+        return optax.GradientTransformation(ef_init, ef_update)
 
     def _reduce(grads):
         return allreduce_gradients(

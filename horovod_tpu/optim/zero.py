@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -51,9 +51,22 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..collectives import ops as _ops
-from ..collectives.compression import (Compression, fp8_quantize, is_fp8)
+from ..collectives.compression import (Compression, fp8_quantize, is_fp8,
+                                       is_error_feedback, is_powersgd,
+                                       parse_compression, powersgd_factor_widths,
+                                       powersgd_matrix_shape, topk_count)
 from ..collectives.reduce_op import Average
 from ..controller.fusion import _LeafSpec
+
+
+class _ZeroEFState(NamedTuple):
+    """ZeRO-1 state carry when ``zero_compression`` is an error-feedback
+    codec: the shard-owner residuals ride NEXT TO the inner state with the
+    same leading ``[n, ...]`` sharded axis ("residuals live on the shard
+    owner" -- each rank's residual covers only the arena slice it
+    allgathers, 1/n of the replicated EF footprint)."""
+    residuals: Any                # tuple of [n, shard] f32, one per arena
+    inner: Any                    # sharded inner optimizer state
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,6 +169,55 @@ def compressed_allgather(x, *, axes, compression=None):
     return comp.decompress(_ops.allgather(wire, axes=axes), ctx)
 
 
+def ef_delta_allgather(delta, *, axes, compression):
+    """Compressed allgather of each shard owner's param DELTA (the EF
+    composition of the ZeRO allgather leg).
+
+    ``delta`` is this rank's flat f32 update (new shard - old shard, plus
+    the fed-back residual).  Each rank compresses its OWN delta locally --
+    PowerSGD here is a plain local low-rank factorization (one
+    orthogonalization round, no inner collective: there is nothing to
+    reduce, each shard has one owner) and top-k keeps the largest
+    magnitudes -- then ONE allgather moves the compressed payloads and
+    EVERY rank reconstructs EVERY shard's delta from the same wire bytes
+    (sender included), so replicas stay bit-identical, exactly the
+    ``compressed_allgather`` fp8 contract.
+
+    Returns ``(full, own)``: ``full`` is the ``[n, shard]`` f32
+    reconstruction of all shards' deltas, ``own`` this rank's row (what
+    the mesh actually applied for it -- the EF residual is
+    ``delta - own``).
+    """
+    n = _ops.axis_size(axes)
+    my = _ops.axis_index(axes)
+    shard = delta.shape[0]
+    if is_powersgd(compression):
+        m, c = powersgd_matrix_shape(shard)
+        pad = m * c - shard
+        flat = jnp.concatenate([delta, jnp.zeros((pad,), jnp.float32)]) \
+            if pad else delta
+        mat = flat.reshape(m, c)
+        r = max(1, min(int(compression.rank), m, c))
+        p = _ops._orthonormalize_columns(mat @ _ops._powersgd_seed_matrix(c, r))
+        q = mat.T @ p                                  # [c, r]
+        wire = jnp.concatenate([p.ravel(), q.ravel()])  # [r*(m+c)]
+        gw = _ops._gather_rows(wire, axes)             # [n, r*(m+c)]
+        ps = gw[:, :r * m].reshape(n, m, r)
+        qs = gw[:, r * m:].reshape(n, c, r)
+        full = jnp.einsum("nmr,ncr->nmc", ps, qs).reshape(n, -1)[:, :shard]
+    else:
+        k = min(topk_count(shard, compression.fraction), shard)
+        _, idx = lax.top_k(jnp.abs(delta), k)
+        vals = jnp.take(delta, idx)
+        gv = _ops._gather_rows(vals, axes)             # [n, k]
+        gi = _ops._gather_rows(idx, axes)              # [n, k]
+        pos = gi + (jnp.arange(n, dtype=gi.dtype) * shard)[:, None]
+        full = jnp.zeros((n * shard,), jnp.float32).at[
+            pos.ravel()].set(gv.ravel()).reshape(n, shard)
+    own = jnp.take(full, my, axis=0)
+    return full, own
+
+
 def _use_reducescatter() -> bool:
     """Trace-time exchange choice.  Default: reduce-scatter.  When the
     autotuner's zero axis is being searched (``HOROVOD_AUTOTUNE_ZERO=1``
@@ -171,11 +233,16 @@ def _use_reducescatter() -> bool:
 
 
 def _resolve_compression(compression):
-    comp = compression or Compression.none
+    comp = parse_compression(compression) if compression else Compression.none
     from ..core.state import global_state
     tuner = global_state().autotuner
     if tuner is not None:
-        comp = tuner.compression_override(comp)
+        override = tuner.compression_override(comp)
+        # The tuner may not flip EF-ness mid-run: the ZeRO state layout
+        # (whether residuals ride next to the inner state) was fixed at
+        # zero_init time.
+        if is_error_feedback(override) == is_error_feedback(comp):
+            comp = override
     return comp
 
 
@@ -187,11 +254,32 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
     (replicated) tree reassembled from the compressed allgather,
     ``new_zero_state`` keeps the leading ``[1, ...]`` local axis that
     shards over the mesh.
+
+    With an error-feedback ``compression`` (powersgd/topk) the allgather
+    leg moves each owner's compressed param DELTA instead of the raw
+    shard (:func:`ef_delta_allgather`); ``zero_state`` must then be the
+    :class:`_ZeroEFState` built by ``zero_init(..., compression=...)``.
     """
     _reject_distributed(optimizer)
     leaves, treedef = jax.tree.flatten(grads)
     if not leaves:
         return params, zero_state
+    comp = _resolve_compression(compression)
+    ef = is_error_feedback(comp)
+    if ef:
+        if not isinstance(zero_state, _ZeroEFState):
+            if (isinstance(zero_state, (tuple, list))
+                    and len(zero_state) == 2):
+                zero_state = _ZeroEFState(*zero_state)  # restored carry
+            else:
+                raise ValueError(
+                    "zero_compression=powersgd/topk needs the residual-"
+                    "carrying state from zero_init(..., compression=...); "
+                    f"got {type(zero_state).__name__}")
+        residuals = tuple(r[0] for r in zero_state.residuals)
+        inner_full = zero_state.inner
+    else:
+        inner_full = zero_state
     p_leaves = jax.tree.leaves(params)
     n = _ops.axis_size(axes)
     spec = plan_arena(leaves, n)
@@ -209,18 +297,43 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
         g_shards.append(gs)
         p_shards.append(
             lax.dynamic_slice_in_dim(p, idx * buf.shard, buf.shard, 0))
-    inner = jax.tree.map(lambda v: v[0], zero_state)
+    inner = jax.tree.map(lambda v: v[0], inner_full)
+    old_shards = p_shards
     updates, inner = optimizer.update(g_shards, inner, p_shards)
     import optax
     p_shards = optax.apply_updates(p_shards, updates)
-    comp = _resolve_compression(compression)
+    if ef:
+        from .distributed import _ef_enabled
+        feed = _ef_enabled()
+        full, new_res = [], []
+        for old, new, res, arena, buf in zip(
+                old_shards, p_shards, residuals, p_arenas, spec.buffers):
+            if (not jnp.issubdtype(buf.dtype, jnp.floating)
+                    or buf.shard < 1):
+                full.append(_ops.allgather(new, axes=axes))
+                new_res.append(res)
+                continue
+            delta = (new.astype(jnp.float32) - old.astype(jnp.float32))
+            if feed:
+                delta = delta + res
+            recon, own = ef_delta_allgather(delta, axes=axes,
+                                            compression=comp)
+            full.append(
+                (arena.astype(jnp.float32) + recon.ravel())
+                .astype(buf.dtype))
+            new_res.append(delta - own if feed else res)
+        new_params = jax.tree.unflatten(treedef, arena_unpack(full, spec))
+        return new_params, _ZeroEFState(
+            tuple(r[None] for r in new_res),
+            jax.tree.map(lambda v: v[None], inner))
     full = [compressed_allgather(s, axes=axes, compression=comp)
             for s in p_shards]
     new_params = jax.tree.unflatten(treedef, arena_unpack(full, spec))
     return new_params, jax.tree.map(lambda v: v[None], inner)
 
 
-def zero_init(optimizer, params, mesh: Optional[Mesh] = None):
+def zero_init(optimizer, params, mesh: Optional[Mesh] = None,
+              compression=None):
     """Build the sharded optimizer state for ``zero_stage=1``.
 
     Each device runs ``optimizer.init`` on its own arena shard; the
@@ -228,9 +341,17 @@ def zero_init(optimizer, params, mesh: Optional[Mesh] = None):
     mesh, so the state occupies 1/n of the replicated state's HBM per
     chip.  Pass the result as the ``opt_state`` of a step built with
     ``make_train_step(..., zero_stage=1)``.
+
+    ``compression`` must name the step's ``zero_compression`` when that is
+    an error-feedback codec (powersgd/topk): the returned carry is then a
+    :class:`_ZeroEFState` with one zero f32 residual per arena shard,
+    sharded like the inner state.  Dtype codecs (fp16/bf16/fp8) carry no
+    state and may be omitted here.
     """
     from ..core import basics as _basics
     _reject_distributed(optimizer)
+    comp = parse_compression(compression) if compression else Compression.none
+    ef = is_error_feedback(comp)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
     world = int(np.prod(mesh.devices.shape))
@@ -243,7 +364,13 @@ def zero_init(optimizer, params, mesh: Optional[Mesh] = None):
         shards = [lax.dynamic_slice_in_dim(a, idx * b.shard, b.shard, 0)
                   for a, b in zip(arenas, spec.buffers)]
         inner = optimizer.init(shards)
-        return jax.tree.map(lambda v: jnp.asarray(v)[None], inner)
+        out = jax.tree.map(lambda v: jnp.asarray(v)[None], inner)
+        if ef:
+            return _ZeroEFState(
+                residuals=tuple(jnp.zeros((1, b.shard), jnp.float32)
+                                for b in spec.buffers),
+                inner=out)
+        return out
 
     fn = jax.shard_map(local_init, mesh=mesh, in_specs=(P(),),
                        out_specs=P(axes), check_vma=False)
@@ -277,7 +404,7 @@ def zero_report(optimizer, params, world: int, compression=None) -> dict:
     """
     leaves = jax.tree.leaves(params)
     spec = plan_arena(leaves, world)
-    comp = compression or Compression.none
+    comp = parse_compression(compression) if compression else Compression.none
 
     def wire_itemsize(dt) -> int:
         dt = jnp.dtype(dt)
@@ -292,10 +419,25 @@ def zero_report(optimizer, params, world: int, compression=None) -> dict:
 
     rs = sum(b.padded * jnp.dtype(b.dtype).itemsize
              for b in spec.buffers) * (world - 1) // max(world, 1)
-    ag = sum(b.padded * wire_itemsize(b.dtype)
-             for b in spec.buffers) * (world - 1) // max(world, 1)
-    if is_fp8(comp):
-        ag += 4 * world * len(spec.buffers)  # one f32 scale per shard
+    if is_error_feedback(comp):
+        # EF delta allgather: each owner's wire is the compressed delta of
+        # its shard (factor pair / top-k value+index pairs), not the shard.
+        ag = 0
+        for b in spec.buffers:
+            if (not jnp.issubdtype(jnp.dtype(b.dtype), jnp.floating)
+                    or b.shard < 1):
+                wire = b.shard * jnp.dtype(b.dtype).itemsize
+            elif is_powersgd(comp):
+                pw, qw = powersgd_factor_widths(b.shard, comp.rank)
+                wire = 4 * (pw + qw)
+            else:
+                wire = 8 * topk_count(b.shard, comp.fraction)
+            ag += wire * world * (world - 1) // max(world, 1)
+    else:
+        ag = sum(b.padded * wire_itemsize(b.dtype)
+                 for b in spec.buffers) * (world - 1) // max(world, 1)
+        if is_fp8(comp):
+            ag += 4 * world * len(spec.buffers)  # one f32 scale per shard
     full_bytes = sum(b.padded * jnp.dtype(b.dtype).itemsize
                      for b in spec.buffers)
     allreduce_eq = 2 * full_bytes * (world - 1) // max(world, 1)

@@ -26,7 +26,23 @@ import math
 from .collectives import ops as _ops
 from .collectives.reduce_op import Average, Sum
 from .core import basics as _basics
+from .optim import distributed as _dist
 from .optim import zero as _zero
+
+
+def _opt_state_spec(optimizer, zero_stage: int, axes):
+    """Partition spec (pytree prefix) for the optimizer-state carry.
+
+    ZeRO-1 state is arena-sharded ``P(axes)``.  An error-feedback wrap's
+    state mixes specs: the per-rank residual leaves (leading world axis)
+    shard ``P(axes)`` while the inner optimizer state stays replicated --
+    expressed as an ``_EFState``-shaped spec prefix.  Everything else is
+    replicated."""
+    if zero_stage:
+        return P(axes)
+    if _dist.is_ef_optimizer(optimizer):
+        return _dist._EFState(residuals=P(axes), inner=P())
+    return P()
 
 
 def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
@@ -217,7 +233,21 @@ def _microbatch_unwrap(optimizer):
         raise NotImplementedError(
             "microbatches > 1 does not support Compression.fp8 (the "
             "quantized exchange owns its own collective); use fp16/bf16")
+    # Error-feedback codecs (powersgd/topk) DO compose: the microbatched
+    # step accumulates sub-batch gradients locally in f32 and runs ONE
+    # residual-fed exchange per step (_build_microbatch_local_step), so
+    # the residual is applied once per optimizer step, never per
+    # microbatch.
     return upd._hvd_inner, exchange
+
+
+def _is_ef_exchange(exchange) -> bool:
+    """True when a microbatch exchange dict carries an error-feedback codec
+    (powersgd/topk): the builders then accumulate locally and run ONE
+    residual-fed exchange per step instead of the per-microbatch
+    reduce-scatter pipe."""
+    from .collectives.compression import is_error_feedback
+    return is_error_feedback(exchange["compression"])
 
 
 def stack_steps(batches) -> Any:
@@ -311,7 +341,7 @@ def make_train_step(
     aux_spec = () if not loss_has_aux else \
         ((P(),) if aux_mode == "averaged" else (P(axes),))
     frozen_spec = (P(),) if with_frozen else ()
-    opt_spec = P(axes) if zero_stage else P()
+    opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
     shard = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), opt_spec, P(axes)) + frozen_spec,
@@ -474,12 +504,21 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
     "stacked"`` gains a leading ``[k]`` axis per device; ``"averaged"``
     averages floating aux leaves over microbatches before the allreduce.
     """
-    accumulate, finalize = _microbatch_grad_pipe(exchange, axes)
+    ef = exchange is not None and _is_ef_exchange(exchange)
+    accumulate, finalize = _microbatch_grad_pipe(
+        None if ef else exchange, axes)
 
     def local_step(params, opt_state, batch, *frozen):
         lf = (lambda p, b: loss_fn(p, frozen[0], b)) if with_frozen \
             else loss_fn
         micro = _split_microbatches(batch, k)
+        if ef:
+            if not isinstance(opt_state, _dist._EFState):
+                opt_state = _dist._EFState(*opt_state)
+            residuals = tuple(r[0] for r in opt_state.residuals)
+            inner_state = opt_state.inner
+        else:
+            inner_state = opt_state
         state, losses, auxes, grads = None, [], [], None
         for i in range(k):
             mb = jax.tree.map(lambda a: a[i], micro)
@@ -492,7 +531,17 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
             losses.append(loss_i)
             state = accumulate(grads, state)
         reduced = finalize(state, k, grads)
-        updates, opt_state = inner.update(reduced, opt_state, params)
+        if ef:
+            reduced, new_res = _dist.ef_exchange(
+                reduced, residuals, compression=exchange["compression"],
+                op=exchange["op"],
+                fusion_threshold=exchange["fusion_threshold"], axes=axes,
+                prescale_factor=exchange["prescale_factor"],
+                postscale_factor=exchange["postscale_factor"])
+        updates, inner_state = inner.update(reduced, inner_state, params)
+        opt_state = _dist._EFState(
+            tuple(r[None] for r in new_res), inner_state) if ef \
+            else inner_state
         params = optax.apply_updates(params, updates)
         loss = _ops.allreduce(jnp.mean(jnp.stack(losses)), Average,
                               axes=axes)
@@ -528,13 +577,22 @@ def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
     if loss_fn is None:
         def loss_fn(logits, y):
             return _softmax_xent(logits, y)
-    accumulate, finalize = _microbatch_grad_pipe(exchange, axes)
+    ef = exchange is not None and _is_ef_exchange(exchange)
+    accumulate, finalize = _microbatch_grad_pipe(
+        None if ef else exchange, axes)
 
     def local_step(params, batch_stats, opt_state, batch):
         x, y = batch
         xs = _split_microbatches(x, k)
         ys = _split_microbatches(y, k)
         stats = batch_stats
+        if ef:
+            if not isinstance(opt_state, _dist._EFState):
+                opt_state = _dist._EFState(*opt_state)
+            residuals = tuple(r[0] for r in opt_state.residuals)
+            inner_state = opt_state.inner
+        else:
+            inner_state = opt_state
         state, losses, grads = None, [], None
         for i in range(k):
             xi = jax.tree.map(lambda a: a[i], xs)
@@ -556,7 +614,17 @@ def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
             losses.append(loss_i)
             state = accumulate(grads, state)
         reduced = finalize(state, k, grads)
-        updates, opt_state = inner.update(reduced, opt_state, params)
+        if ef:
+            reduced, new_res = _dist.ef_exchange(
+                reduced, residuals, compression=exchange["compression"],
+                op=exchange["op"],
+                fusion_threshold=exchange["fusion_threshold"], axes=axes,
+                prescale_factor=exchange["prescale_factor"],
+                postscale_factor=exchange["postscale_factor"])
+        updates, inner_state = inner.update(reduced, inner_state, params)
+        opt_state = _dist._EFState(
+            tuple(r[None] for r in new_res), inner_state) if ef \
+            else inner_state
         params = optax.apply_updates(params, updates)
         new_stats = jax.tree.map(
             lambda v: _ops.allreduce(v, Average, axes=axes), stats)
@@ -649,7 +717,7 @@ def make_train_loop(
     aux_spec = () if not loss_has_aux else \
         ((P(),) if aux_mode == "averaged" else (P(None, axes),))
     frozen_spec = (P(),) if with_frozen else ()
-    opt_spec = P(axes) if zero_stage else P()
+    opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
     shard = jax.shard_map(
         local_loop, mesh=mesh,
         in_specs=(P(), opt_spec, P(None, axes)) + frozen_spec,
@@ -754,7 +822,7 @@ def make_flax_train_step(
                                             axes, zero_stage,
                                             zero_compression)
 
-    opt_spec = P(axes) if zero_stage else P()
+    opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
     shard = jax.shard_map(local_step, mesh=mesh,
                           in_specs=(P(), P(), opt_spec, P(axes)),
                           out_specs=(P(), P(), opt_spec, P()),
@@ -856,7 +924,7 @@ def make_flax_train_loop(
             body, (params, batch_stats, opt_state), batches, length=k)
         return params, batch_stats, opt_state, losses
 
-    opt_spec = P(axes) if zero_stage else P()
+    opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
     shard = jax.shard_map(local_loop, mesh=mesh,
                           in_specs=(P(), P(), opt_spec, P(None, axes)),
                           out_specs=(P(), P(), opt_spec, P()),

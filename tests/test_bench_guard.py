@@ -385,3 +385,168 @@ def test_bench_config_string_gains_microbatch_suffix(monkeypatch):
     b = importlib.reload(bench)
     assert not b.OVERLAP
     assert b._config() == b.BASELINE_CONFIG
+
+
+# -- compression config shape ------------------------------------------------
+# bench.py's compression config (HOROVOD_COMPRESSION=powersgd:<r>|topk:<f>)
+# is cross-config by construction (the config string gains the codec
+# suffix), so its vs_baseline must be null, and it must report a
+# ``compression`` block whose wire accounting is internally consistent and
+# clears the 8x reduction target the EF codecs exist to deliver.
+
+
+def scan_compression_entries(bench_dir):
+    """Return [(path, why), ...] for malformed compression bench entries."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            comp = parsed.get("compression")
+            if not comp:
+                continue
+            codec = str(comp.get("codec", ""))
+            wire = comp.get("wire_bytes_per_step")
+            raw = comp.get("uncompressed_bytes_per_step")
+            ratio = comp.get("ratio")
+            if not all(isinstance(v, (int, float)) and v > 0
+                       for v in (wire, raw, ratio)):
+                bad.append((path, f"bad compression block: {comp!r}"))
+                continue
+            if abs(ratio - raw / wire) > 0.02 * ratio:
+                bad.append((path, f"ratio {ratio} != {raw}/{wire}"))
+            if codec.startswith(("powersgd", "topk")) and ratio < 8.0:
+                bad.append((path, f"{codec} ratio {ratio} below 8x target"))
+    return bad
+
+
+def test_committed_compression_entries_well_formed():
+    assert scan_compression_entries(REPO) == []
+
+
+def test_committed_powersgd_round_reports_8x_reduction():
+    """Acceptance gate: the committed powersgd bench round must exist and
+    report >= 8x wire reduction with a null-or-holding vs_baseline."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            comp = (entry.get("parsed") or {}).get("compression") or {}
+            if str(comp.get("codec", "")).startswith("powersgd"):
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries a powersgd codec"
+    for path, parsed in found:
+        assert parsed["compression"]["ratio"] >= 8.0, (path, parsed)
+        vb = parsed.get("vs_baseline")
+        assert vb is None or vb >= THRESHOLD, (path, vb)
+
+
+def _write_compressed(tmp_path, name, comp):
+    parsed = {"metric": "resnet50_images_per_sec_per_chip", "value": 2400.0,
+              "unit": "images/s/chip", "vs_baseline": None,
+              "config": "batch256_s2d_bf16_powersgd4",
+              "baseline_config": "batch256_s2d_bf16", "compression": comp}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def test_compression_validator_accepts_well_formed_entry(tmp_path):
+    _write_compressed(tmp_path, "BENCH_r60.json",
+                      {"codec": "powersgd:4", "wire_bytes_per_step": 1000,
+                       "uncompressed_bytes_per_step": 100000,
+                       "ratio": 100.0})
+    assert scan_compression_entries(str(tmp_path)) == []
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_compression_validator_trips_on_weak_or_inconsistent(tmp_path):
+    _write_compressed(tmp_path, "BENCH_r61.json",
+                      {"codec": "powersgd:4", "wire_bytes_per_step": 50000,
+                       "uncompressed_bytes_per_step": 100000, "ratio": 2.0})
+    _write_compressed(tmp_path, "BENCH_r62.json",
+                      {"codec": "topk:0.01", "wire_bytes_per_step": 1000,
+                       "uncompressed_bytes_per_step": 100000, "ratio": 9.0})
+    _write_compressed(tmp_path, "BENCH_r63.json",
+                      {"codec": "powersgd:4", "wire_bytes_per_step": 0,
+                       "uncompressed_bytes_per_step": 100000, "ratio": 9.0})
+    bad = dict(scan_compression_entries(str(tmp_path)))
+    assert "below 8x target" in bad[str(tmp_path / "BENCH_r61.json")]
+    assert "ratio 9.0 !=" in bad[str(tmp_path / "BENCH_r62.json")]
+    assert "bad compression block" in bad[str(tmp_path / "BENCH_r63.json")]
+
+
+def test_bench_config_string_gains_codec_suffix(monkeypatch):
+    """HOROVOD_COMPRESSION must mark the config string (that suffix is
+    what makes vs_baseline null via the same_config gate)."""
+    import importlib
+
+    import bench
+    monkeypatch.setenv("HOROVOD_COMPRESSION", "powersgd:4")
+    b = importlib.reload(bench)
+    assert b.COMPRESSION == "powersgd:4"
+    assert b._config().endswith("_powersgd4")
+    assert b._config() != b.BASELINE_CONFIG
+
+    monkeypatch.setenv("HOROVOD_COMPRESSION", "topk:0.01")
+    b = importlib.reload(bench)
+    assert b._config().endswith("_topk0p01")
+
+    monkeypatch.delenv("HOROVOD_COMPRESSION")
+    b = importlib.reload(bench)
+    assert not b.COMPRESSION
+    assert b._config() == b.BASELINE_CONFIG
+
+
+# -- merged trajectory shape -------------------------------------------------
+# bench.py --trajectory folds every committed BENCH_r*.json into one
+# markdown table between the BENCH_TRAJECTORY markers in
+# docs/benchmarks.md.  The merge must be total (one row per round), the
+# rounds strictly increasing, and the rendered table must match
+# TRAJECTORY_COLUMNS -- a silently dropped round would hide a regression
+# from anyone reading the trajectory instead of the raw artifacts.
+
+
+def test_trajectory_rows_cover_every_committed_round():
+    import bench
+    rows = bench.build_trajectory_rows(REPO)
+    files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert len(rows) == len(files) and files
+    rounds = [r["round"] for r in rows]
+    assert rounds == sorted(rounds)
+    assert len(set(rounds)) == len(rounds), f"duplicate rounds: {rounds}"
+    for row in rows:
+        assert set(bench.TRAJECTORY_COLUMNS) <= set(row), row
+
+
+def test_trajectory_table_shape_matches_columns():
+    import bench
+    rows = bench.build_trajectory_rows(REPO)
+    table = bench.render_trajectory_table(rows)
+    lines = [l for l in table.strip().splitlines() if l.startswith("|")]
+    header = [c.strip() for c in lines[0].strip("|").split("|")]
+    assert tuple(header) == bench.TRAJECTORY_COLUMNS
+    assert len(lines) == 2 + len(rows)  # header + separator + one per round
+    for line in lines[2:]:
+        assert len(line.strip("|").split("|")) == len(
+            bench.TRAJECTORY_COLUMNS)
+
+
+def test_committed_benchmarks_doc_carries_merged_trajectory():
+    import bench
+    doc = open(os.path.join(REPO, "docs", "benchmarks.md")).read()
+    assert doc.count(bench._TRAJ_BEGIN) == 1
+    assert doc.count(bench._TRAJ_END) == 1
+    body = doc.split(bench._TRAJ_BEGIN)[1].split(bench._TRAJ_END)[0]
+    data_rows = [l for l in body.strip().splitlines()
+                 if l.startswith("|")][2:]
+    assert len(data_rows) == len(bench.build_trajectory_rows(REPO)), (
+        "docs/benchmarks.md trajectory is stale: re-run "
+        "`python bench.py --trajectory`")

@@ -1,0 +1,417 @@
+"""Error-feedback gradient compression (PR 5 tentpole).
+
+Exchange-level codecs ``powersgd`` (rank-r low-rank factorization per
+fusion bucket) and ``topk`` (magnitude sparsification exchanged by
+allgather), with the compression error carried as residual state in the
+optimizer carry and re-injected next step.  Contracts under test:
+
+* codec algebra: top-k at fraction 1.0 IS the exact allreduce; PowerSGD
+  reconstructs a rank-<=r mean gradient exactly (one orthogonalization
+  round); outputs are replica-consistent bitwise across ranks.
+* error feedback: residual state threads through ``make_train_step`` as
+  an ``_EFState`` carry leaf; compressed+EF training lands within the
+  stated bound of uncompressed after a fixed step budget; the
+  ``HOROVOD_EF_RESIDUAL=0`` escape hatch drops the state re-injection.
+* composition: ``microbatches=k`` applies the residual ONCE per step
+  (k=2 matches k=1 within the documented f32-accumulation tolerance);
+  ``zero_stage=1`` compresses the param-delta allgather with residuals
+  on the shard owner, and every rank reconstructs identical params.
+* satellites: fp8 degenerate axes dequantize to exact zeros (no
+  NaN/inf); wire accounting clears the 8x reduction target on
+  rn50-scale buckets; the autotuner codec axis maps
+  ``HOROVOD_AUTOTUNE_CODEC`` entries onto grid codes.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hv
+from horovod_tpu.collectives import ops as _ops
+from horovod_tpu.collectives.compression import (Compression, fp8_dequantize,
+                                                 fp8_quantize,
+                                                 parse_compression,
+                                                 powersgd_compressor,
+                                                 powersgd_factor_widths,
+                                                 powersgd_matrix_shape,
+                                                 resolve_compressor_name,
+                                                 topk_compressor, topk_count,
+                                                 wire_payload_bytes)
+from horovod_tpu.core.state import global_state
+from horovod_tpu.optim import distributed as _dist
+from horovod_tpu.optim import zero as zmod
+
+RTOL, ATOL = 2e-5, 2e-6  # f32 accumulation tolerance (test_microbatch.py)
+
+
+def _mesh_axes():
+    return tuple(global_state().mesh.axis_names)
+
+
+def _shard_run(fn, *arrays):
+    """Run ``fn(per_rank_rows...)`` under shard_map over the hvd mesh,
+    rank-stacking every output for cross-rank inspection."""
+    mesh = global_state().mesh
+    axes = P(*mesh.axis_names)
+
+    def spmd(*blocks):
+        out = fn(*[b[0] for b in blocks])
+        return jax.tree.map(lambda y: y[None], out)
+
+    return jax.jit(jax.shard_map(
+        spmd, mesh=mesh, in_specs=axes, out_specs=axes))(*arrays)
+
+
+# ---------------------------------------------------------------------------
+# Codec algebra.
+# ---------------------------------------------------------------------------
+
+def test_topk_full_fraction_is_exact_allreduce(hvd):
+    n = hvd.size()
+    x = np.random.RandomState(0).randn(n, 33).astype(np.float32)
+
+    def f(row):
+        out, res = _ops.topk_allreduce(row, hv.Average, fraction=1.0,
+                                       axes=_mesh_axes())
+        return out, res
+
+    out, res = _shard_run(f, x)
+    np.testing.assert_allclose(np.asarray(out)[0], x.mean(axis=0),
+                               rtol=1e-6, atol=1e-6)
+    # k == size: everything went on the wire, residual is exactly zero.
+    np.testing.assert_array_equal(np.asarray(res), 0.0)
+
+
+def test_topk_residual_holds_exactly_the_unsent_mass(hvd):
+    n = hvd.size()
+    x = np.tile(np.arange(1.0, 11.0, dtype=np.float32)[None], (n, 1))
+
+    def f(row):
+        return _ops.topk_allreduce(row, hv.Average, fraction=0.3,
+                                   axes=_mesh_axes())
+
+    out, res = _shard_run(f, x)
+    # k = ceil(10*0.3) = 3 largest magnitudes (8, 9, 10) exchanged; the
+    # rest stays in the residual, and sent coords have zero residual.
+    k = topk_count(10, 0.3)
+    assert k == 3
+    expect = np.zeros(10, np.float32)
+    expect[-k:] = np.arange(8.0, 11.0)
+    np.testing.assert_allclose(np.asarray(out)[0], expect, atol=1e-6)
+    res0 = np.asarray(res)[0]
+    np.testing.assert_allclose(res0[:-k], np.arange(1.0, 8.0), atol=1e-6)
+    np.testing.assert_array_equal(res0[-k:], 0.0)
+
+
+def test_powersgd_reconstructs_low_rank_mean_exactly(hvd):
+    """A well-conditioned rank-2 bucket is inside the rank-2 subspace:
+    P@Q^T recovers the mean gradient to f32 roundoff and the residual is
+    ~zero.  (Exactly rank-1 inputs are the degenerate case -- the spare
+    orthonormalized column is normalized roundoff noise -- which error
+    feedback absorbs rather than the factorization.)"""
+    n = hvd.size()
+    size = 64
+    m, c = powersgd_matrix_shape(size)
+    u1, u2 = np.linspace(1.0, 2.0, m), np.cos(np.arange(m) * 1.3)
+    v1, v2 = np.linspace(-1.0, 1.0, c), np.sin(np.arange(c) * 0.7)
+    mat = (np.outer(u1, v1) + 0.5 * np.outer(u2, v2)) \
+        .ravel()[:size].astype(np.float32)
+    x = np.tile(mat[None], (n, 1))
+
+    def f(row):
+        return _ops.powersgd_allreduce(row, hv.Average, rank=2,
+                                       axes=_mesh_axes())
+
+    out, res = _shard_run(f, x)
+    np.testing.assert_allclose(np.asarray(out)[0], mat, rtol=1e-4,
+                               atol=1e-4)
+    assert float(np.abs(np.asarray(res)).max()) < 1e-4 * np.abs(mat).max()
+
+
+def test_powersgd_output_replica_consistent_bitwise(hvd):
+    n = hvd.size()
+    x = np.random.RandomState(1).randn(n, 50).astype(np.float32)
+
+    def f(row):
+        out, _ = _ops.powersgd_allreduce(row, hv.Average, rank=3,
+                                         axes=_mesh_axes())
+        return out
+
+    out = np.asarray(_shard_run(f, x))
+    for i in range(1, n):
+        np.testing.assert_array_equal(out[0], out[i])
+
+
+def test_eager_allreduce_with_ef_codecs(hvd):
+    """Stateless eager form: replica-consistent, Adasum rejected."""
+    n = hvd.size()
+    x = hv.replicated_stack(np.linspace(0.0, 5.0, 40).astype(np.float32))
+    for codec in (powersgd_compressor(2), topk_compressor(0.2)):
+        out = np.asarray(hv.allreduce(x, hv.Average, compression=codec))
+        assert out.shape == (n, 40)
+        for i in range(1, n):
+            np.testing.assert_array_equal(out[0], out[i])
+    from horovod_tpu.collectives.reduce_op import Adasum
+    with pytest.raises(NotImplementedError, match="Adasum"):
+        hv.allreduce(x, Adasum, compression=powersgd_compressor(2))
+
+
+# ---------------------------------------------------------------------------
+# Error-feedback training: parity and state threading.
+# ---------------------------------------------------------------------------
+
+_W = np.random.RandomState(7).randn(20, 5).astype(np.float32)
+
+
+def _linreg_params():
+    r = np.random.RandomState(42)
+    return {"w": jnp.asarray(r.randn(20, 5) * 0.1, jnp.float32),
+            "b": jnp.zeros((5,), jnp.float32)}
+
+
+def _linreg_loss(params, batch):
+    x, y = batch
+    return jnp.mean((x @ params["w"] + params["b"] - y) ** 2)
+
+
+def _linreg_batch(i, rows=64):
+    r = np.random.RandomState(100 + i)
+    x = r.randn(rows, 20).astype(np.float32)
+    y = x @ _W + 0.01 * r.randn(rows, 5).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _train(compression=None, steps=40, microbatches=None, zero=False):
+    params = hv.replicate(_linreg_params())
+    if zero:
+        opt = optax.sgd(0.05, momentum=0.9)
+        opt_state = hv.zero_init(opt, params, compression=compression)
+        step = hv.make_train_step(_linreg_loss, opt, zero_stage=1,
+                                  zero_compression=compression)
+    else:
+        opt = hv.DistributedOptimizer(optax.sgd(0.05, momentum=0.9),
+                                      compression=compression)
+        opt_state = hv.replicate(opt.init(jax.device_get(_linreg_params())))
+        step = hv.make_train_step(_linreg_loss, opt,
+                                  microbatches=microbatches)
+    for i in range(steps):
+        batch = hv.shard_batch(_linreg_batch(i))
+        params, opt_state, loss = step(params, opt_state, batch)
+    return jax.tree.map(np.asarray, params), float(loss), opt_state
+
+
+# Stated parity bound (ISSUE acceptance): after the 40-step budget on the
+# regression task, the compressed+EF loss must land within 10x of the
+# uncompressed loss AND far below the untrained loss (~27) -- compression
+# slows the tail but must not stall optimization.  Measured on this seed:
+# uncompressed 0.63, powersgd:4 ~3.3, topk:0.1 ~4.6.
+PARITY_FACTOR = 10.0
+
+
+@pytest.mark.parametrize("spec", ["powersgd:4", "topk:0.1"])
+def test_ef_training_parity_with_uncompressed(hvd, spec):
+    _, base, _ = _train(None)
+    _, comp, state = _train(spec)
+    untrained = float(_linreg_loss(
+        _linreg_params(), jax.tree.map(np.asarray, _linreg_batch(0))))
+    assert comp <= PARITY_FACTOR * base, (comp, base)
+    assert comp < 0.25 * untrained, (comp, untrained)
+    # The residual state survived the loop as the _EFState carry leaf and
+    # holds the (nonzero) unsent mass.
+    assert isinstance(state, _dist._EFState)
+    assert all(float(jnp.abs(r).max()) > 0 for r in state.residuals)
+
+
+def test_ef_residual_disabled_drops_state_reinjection(hvd):
+    """HOROVOD_EF_RESIDUAL=0: the codec still runs but residuals stay
+    exactly at init (zero) -- the stateless one-shot semantics."""
+    st = global_state()
+    st.config = dataclasses.replace(st.config, ef_residual=False)
+    _, loss, state = _train("powersgd:2", steps=5)
+    assert np.isfinite(loss)
+    assert all(float(jnp.abs(r).max()) == 0.0 for r in state.residuals)
+
+
+@pytest.mark.parametrize("spec", ["powersgd:4", "topk:0.25"])
+def test_ef_microbatch_applies_residual_once_per_step(hvd, spec):
+    """microbatches=2 with an EF codec matches k=1 within the f32
+    accumulation tolerance: gradients are locally accumulated across
+    microbatches and the residual enters ONE exchange per step."""
+    p1, l1, s1 = _train(spec, steps=6, microbatches=1)
+    p2, l2, s2 = _train(spec, steps=6, microbatches=2)
+    assert np.isclose(l1, l2, rtol=RTOL, atol=ATOL)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(a, b, rtol=RTOL, atol=1e-4)
+    for a, b in zip(s1.residuals, s2.residuals):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=RTOL, atol=1e-4)
+
+
+def test_ef_zero1_training_converges_with_sharded_residuals(hvd):
+    """zero_stage=1 + EF codec: residuals live on the shard owner
+    (leading-axis sharded _ZeroEFState) and training still converges."""
+    _, base, _ = _train(None, zero=True)
+    _, comp, state = _train("powersgd:4", zero=True)
+    assert comp <= PARITY_FACTOR * max(base, 1e-3), (comp, base)
+    assert isinstance(state, zmod._ZeroEFState)
+
+
+def test_zero_ef_delta_allgather_replica_consistent(hvd):
+    """Every rank reconstructs the SAME [n, shard] delta block from the
+    compressed wire (the invariant that keeps ZeRO params replicated),
+    and ``own`` is this rank's row of it."""
+    n = hvd.size()
+    shard = 24
+    deltas = np.random.RandomState(3).randn(n, shard).astype(np.float32)
+
+    def f(row):
+        return zmod.ef_delta_allgather(row, axes=_mesh_axes(),
+                                       compression=powersgd_compressor(2))
+
+    full, own = _shard_run(f, deltas)
+    full = np.asarray(full)   # [n_ranks, n, shard]
+    own = np.asarray(own)     # [n_ranks, shard]
+    for i in range(1, n):
+        np.testing.assert_array_equal(full[0], full[i])
+    for i in range(n):
+        np.testing.assert_array_equal(own[i], full[0][i])
+
+
+def test_ef_rejects_unsupported_compositions(hvd):
+    opt = optax.sgd(0.1)
+    with pytest.raises(NotImplementedError, match="Sum/Average"):
+        hv.DistributedAdasumOptimizer(opt, compression="powersgd:2")
+    with pytest.raises(NotImplementedError,
+                       match="backward_passes_per_step"):
+        hv.DistributedOptimizer(opt, compression="powersgd:2",
+                                backward_passes_per_step=2)
+
+
+def test_ef_exchange_emits_compression_ratio_counter(hvd, monkeypatch):
+    recorded = []
+
+    class _TL:
+        def counters(self, values, track="counters"):
+            recorded.append(dict(values))
+
+        def counter(self, name, value, track="counters"):
+            recorded.append({name: value})
+
+        def range(self, tensor, phase):
+            import contextlib
+            return contextlib.nullcontext()
+
+    monkeypatch.setattr(global_state(), "timeline", _TL())
+    _train("powersgd:2", steps=1)
+    snaps = [r for r in recorded if "compression_ratio" in r]
+    assert snaps, recorded
+    s = snaps[0]
+    assert s["uncompressed_bytes_per_step"] == 105 * 4  # 20*5 w + 5 b
+    assert s["wire_bytes_per_step"] == \
+        4 * sum(powersgd_factor_widths(105, 2))
+    assert s["compression_ratio"] == pytest.approx(
+        s["uncompressed_bytes_per_step"] / s["wire_bytes_per_step"])
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: fp8 degenerate axes.
+# ---------------------------------------------------------------------------
+
+def test_fp8_all_zero_rows_dequantize_to_exact_zeros(hvd):
+    x = np.zeros((4, 16), np.float32)
+    x[1] = np.linspace(-3.0, 3.0, 16)
+    q, scale = fp8_quantize(jnp.asarray(x), axis=1)
+    out = np.asarray(fp8_dequantize(q, scale, jnp.float32))
+    assert np.isfinite(out).all()
+    np.testing.assert_array_equal(out[0], 0.0)
+    np.testing.assert_array_equal(out[2:], 0.0)
+    np.testing.assert_allclose(out[1], x[1], rtol=0.07, atol=1e-6)
+
+
+def test_fp8_zero_size_axis_and_scalar_zero(hvd):
+    q, scale = fp8_quantize(jnp.zeros((0, 8), jnp.float32), axis=1)
+    out = np.asarray(fp8_dequantize(q, scale, jnp.float32))
+    assert out.shape == (0, 8) and np.isfinite(scale).all()
+    q, scale = fp8_quantize(jnp.zeros((), jnp.float32))
+    out = np.asarray(fp8_dequantize(q, scale, jnp.float32))
+    assert out == 0.0 and np.isfinite(out)
+
+
+# ---------------------------------------------------------------------------
+# Wire accounting, spec parsing, autotuner axis.
+# ---------------------------------------------------------------------------
+
+def test_wire_payload_clears_8x_on_rn50_scale_buckets(hvd):
+    for size in (64 * 1024 * 1024 // 4, 25_557_032):  # 64MiB bucket, rn50
+        for comp in (powersgd_compressor(4), topk_compressor(0.01)):
+            wire = wire_payload_bytes(comp, size, 4, 8)
+            assert wire * 8 <= size * 4, (comp.__name__, size, wire)
+    pw, qw = powersgd_factor_widths(100, 4)
+    assert (pw, qw) == (4 * 10, 4 * 10)
+    assert wire_payload_bytes(powersgd_compressor(4), 100) == 4 * (pw + qw)
+    k = topk_count(1000, 0.05)
+    assert wire_payload_bytes(topk_compressor(0.05), 1000) == 8 * k // 2
+
+
+def test_parse_compression_and_name_resolution(hvd):
+    assert parse_compression("powersgd:3").rank == 3
+    assert parse_compression("topk:0.05").fraction == pytest.approx(0.05)
+    assert parse_compression("bf16") is Compression.bf16
+    assert parse_compression(None) is Compression.none
+    with pytest.raises(ValueError):
+        parse_compression("powersgd")      # missing rank
+    with pytest.raises(ValueError):
+        parse_compression("topk:1.5")      # fraction out of range
+    with pytest.raises(KeyError):
+        resolve_compressor_name("NoSuchCompressor")
+    # Parameterized classes resolve by name even in a namespace where the
+    # factory never ran (the drained-rank replay path).
+    for attr in list(vars(Compression)):
+        if attr.startswith(("PowerSGD", "TopK")):
+            delattr(Compression, attr)
+    assert resolve_compressor_name("PowerSGD5Compressor").rank == 5
+    assert resolve_compressor_name("TopK0p2Compressor").fraction == \
+        pytest.approx(0.2)
+
+
+def test_autotune_codec_axis(hvd, monkeypatch):
+    from horovod_tpu.autotune import COMP_CODEC_BASE, Autotuner
+    from horovod_tpu.core.config import load_config
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_CODEC", "powersgd:2,topk:0.01")
+    tuner = Autotuner(load_config(), steps_per_sample=1)
+    codes = {g[3] for g in tuner.grid}
+    assert {COMP_CODEC_BASE, COMP_CODEC_BASE + 1} <= codes
+    assert tuner._codec_axis[COMP_CODEC_BASE].rank == 2
+    assert tuner._codec_axis[COMP_CODEC_BASE + 1].fraction == \
+        pytest.approx(0.01)
+    for idx, g in enumerate(tuner.grid):
+        if g[3] == COMP_CODEC_BASE:
+            tuner._idx, tuner._best = idx, None
+            break
+    assert tuner.compression_override(Compression.none).rank == 2
+
+
+def test_ef_plan_is_pinned_and_keyed_by_codec(hvd):
+    """The EF bucket plan ignores the autotuner (residual shapes live in
+    optimizer state) and never aliases a plain plan of the same leaves."""
+    leaves = [jnp.zeros((10, 10), jnp.float32), jnp.zeros((7,), jnp.float32)]
+    comp = powersgd_compressor(2)
+    plan_ef = _dist.ef_bucket_plan(leaves, None, comp)
+    from horovod_tpu.controller.fusion import plan_buckets
+    plan_plain = plan_buckets(leaves)
+    assert plan_ef is not plan_plain
+    assert [tuple(s.size for s in l) for _, l in plan_ef.buffers] == \
+        [tuple(s.size for s in l) for _, l in plan_plain.buffers]
+    res = _dist.ef_init_residuals({"a": leaves[0], "b": leaves[1]},
+                                  None, comp)
+    assert [r.shape for r in res] == [(hv.size(), 107)]
+    # Mismatched residual list vs plan is a hard error, not silent reuse.
+    with pytest.raises(ValueError, match="residual"):
+        _dist.ef_exchange({"a": leaves[0], "b": leaves[1]}, (),
+                          compression=comp)

@@ -244,6 +244,75 @@ def test_replay_validates_fused_widths(hvd):
         joinop._replay(bad)
 
 
+def test_fused_nondefault_codec_publishes_and_replays_bitwise(
+        hvd, monkeypatch):
+    """PR-5 satellite: a deferred-fused bucket carrying a non-default
+    codec (PowerSGD) publishes codec name + factor widths with the fused
+    layout, and a drained rank -- whose process never ran the codec
+    factory -- resolves the codec from the name alone and replays the
+    bucket collective bitwise (same shape, same codec program)."""
+    import horovod_tpu.collectives.compression as comp_mod
+    from horovod_tpu.collectives.compression import (
+        Compression, powersgd_compressor, powersgd_factor_widths)
+    _force_defer(monkeypatch)
+    n = hvd.size()
+    codec = powersgd_compressor(2)
+
+    class _KV:
+        def __init__(self):
+            self.store = {}
+
+        def key_value_set(self, k, v, allow_overwrite=False):
+            self.store[k] = v
+    kv = _KV()
+    mask = np.ones((n,), np.int32)
+    mask[-1] = 0
+    monkeypatch.setattr(joinop, "client", lambda: kv)
+    monkeypatch.setattr(joinop, "sync", lambda ps: mask.copy())
+    h1 = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((3,), 1.0, np.float32)), hvd.Sum,
+        compression=codec)
+    h2 = hvd.allreduce_async(
+        hvd.replicated_stack(np.full((4,), 2.0, np.float32)), hvd.Sum,
+        compression=codec)
+    out1 = hvd.synchronize(h1)
+    out2 = hvd.synchronize(h2)
+    assert eager.deferred_fuse_stats()["fused_buckets"] == 1
+    ops = {k: json.loads(v) for k, v in kv.store.items() if "/op/" in k}
+    assert len(ops) == 1, kv.store
+    meta = next(iter(ops.values()))
+    assert meta["compression"] == "PowerSGD2Compressor"
+    assert meta["fused_widths"] == [3, 4]
+    assert meta["factor_widths"] == \
+        list(powersgd_factor_widths(7, 2))
+
+    # Drained-rank side: wipe the parameterized-codec registry so the
+    # replay must re-derive the class from the published name, then
+    # replay the record -- the dispatched program is keyed on the same
+    # (shape, codec) signature the active ranks compiled, so a cache hit
+    # here IS the bitwise-identity evidence.
+    for attr in list(vars(Compression)):
+        if attr.startswith(("PowerSGD", "TopK")):
+            delattr(Compression, attr)
+    monkeypatch.setattr(joinop, "_replaying", False)
+    st = global_state()
+    hits_before = st.cache.hits
+    joinop._replay(meta)
+    assert st.cache.hits == hits_before + 1
+    assert hasattr(Compression, "PowerSGD2Compressor")
+    # Active-side outputs themselves are replica-consistent and the
+    # low-rank program preserved the unfused slicing.
+    assert np.asarray(out1).shape == (n, 3)
+    assert np.asarray(out2).shape == (n, 4)
+
+    # A corrupt record (factor widths disagreeing with shape + rank)
+    # must be rejected, not silently replayed against a diverging
+    # program.
+    bad = dict(meta, factor_widths=[5, 5])
+    with pytest.raises(RuntimeError, match="low-rank replay metadata"):
+        joinop._replay(bad)
+
+
 def test_flush_plan_reuses_shared_plan_cache(hvd, monkeypatch):
     """Identical async batches hit the memoized eager-flush plan (the
     shared controller.fusion ExecutableCache), not a fresh plan."""

@@ -1,0 +1,70 @@
+"""Static check: every ``HOROVOD_*`` environment variable the library
+reads must be documented in ``docs/api.md`` (PR 5 satellite).
+
+The scan is grep-based over ``horovod_tpu/``: any ``_env(...)`` /
+``_env_bool(...)`` / ``_env_int(...)`` / ``_env_float(...)`` call site
+and any literal ``os.environ`` access of a ``HOROVOD_``/``HVD_TPU_``
+name contributes a variable; each must appear (with its ``HOROVOD_``
+spelling) somewhere in docs/api.md.  An env knob nobody can discover is
+a support burden, and this test makes adding one without a doc row a
+loud failure instead of a review nit.
+"""
+
+import glob
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ENV_CALL = re.compile(
+    r'_env(?:_bool|_int|_float)?\(\s*"([A-Z][A-Z0-9_]*)"')
+# Literal os.environ reads of a fully-prefixed name.  Writes (launcher
+# code exporting identity to children) count too: the variable is part
+# of the public surface either way.
+_ENV_LITERAL = re.compile(
+    r'(?:os\.environ(?:\.get)?[\[(]\s*|getenv\(\s*)"'
+    r'(?:HOROVOD_|HVD_TPU_)([A-Z][A-Z0-9_]*)"')
+
+
+def read_env_vars(pkg_dir):
+    """Return {canonical_name: [file, ...]} for every HOROVOD_* env var
+    read in the package (canonical = without prefix)."""
+    hits = {}
+    for path in sorted(glob.glob(os.path.join(pkg_dir, "**", "*.py"),
+                                 recursive=True)):
+        src = open(path).read()
+        names = set(_ENV_CALL.findall(src)) | set(_ENV_LITERAL.findall(src))
+        for name in names:
+            hits.setdefault(name, []).append(os.path.relpath(path, REPO))
+    return hits
+
+
+def test_every_env_read_is_documented_in_api_md():
+    doc = open(os.path.join(REPO, "docs", "api.md")).read()
+    hits = read_env_vars(os.path.join(REPO, "horovod_tpu"))
+    assert hits, "scanner found no env reads -- the regex rotted"
+    undocumented = {name: files for name, files in sorted(hits.items())
+                    if "HOROVOD_" + name not in doc}
+    assert not undocumented, (
+        "HOROVOD_* env vars read in horovod_tpu/ but absent from "
+        f"docs/api.md: {undocumented}")
+
+
+def test_pr5_compression_vars_are_read_and_documented():
+    """The PR 5 knobs exist on both sides of the contract."""
+    doc = open(os.path.join(REPO, "docs", "api.md")).read()
+    hits = read_env_vars(os.path.join(REPO, "horovod_tpu"))
+    for name in ("COMPRESSION", "EF_RESIDUAL", "AUTOTUNE_CODEC"):
+        assert name in hits, f"{name} is no longer read anywhere"
+        assert "HOROVOD_" + name in doc
+
+
+def test_scanner_catches_both_read_styles(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'x = _env_int("SOME_KNOB", 3)\n'
+        'y = os.environ.get("HOROVOD_OTHER_KNOB")\n'
+        'z = os.environ["HVD_TPU_THIRD_KNOB"]\n')
+    hits = read_env_vars(str(pkg))
+    assert set(hits) == {"SOME_KNOB", "OTHER_KNOB", "THIRD_KNOB"}
