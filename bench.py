@@ -481,6 +481,11 @@ def main():
         result["microbatches"] = MICRO_K
     if comp_stats is not None:
         result["compression"] = comp_stats
+    try:
+        from horovod_tpu.timeline.metrics import bench_block
+        result["metrics"] = bench_block()
+    except Exception as e:  # snapshot failure must not void the run
+        result["metrics"] = {"error": f"{type(e).__name__}: {e}"}
     print(json.dumps(result), flush=True)
     os._exit(0)  # skip slow atexit teardown; result is already printed
 

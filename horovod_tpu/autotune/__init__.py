@@ -302,6 +302,13 @@ class Autotuner:
         score = self._accum_bytes / max(self._accum_s, 1e-9)  # bytes/s
         self._opt.observe(self._idx, score)
         self._samples.append(self.grid[self._idx] + (score,))
+        from ..timeline import metrics as _metrics
+        reg = _metrics.registry()
+        reg.counter("horovod_autotune_samples_total",
+                    "Autotuner samples scored (one per sample window)"
+                    ).inc()
+        reg.gauge("horovod_autotune_score_bytes_per_second",
+                  "Most recent autotuner sample score").set(score)
         self._step = 0
         self._accum_s = 0.0
         self._accum_bytes = 0

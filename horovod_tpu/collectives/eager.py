@@ -158,6 +158,8 @@ def _run(kind: str, x, name: Optional[str], ps, per_rank_fn, op_label: str,
         if publish_meta is not None:
             _publish_abort(e)
         raise
+    with _eager_stats_lock:
+        _eager_stats["ops"] += 1
     _eager_fence(mesh, out)
     return out
 
@@ -207,6 +209,21 @@ def _eager_fence(mesh: Mesh, out) -> None:
 _fence_lock = threading.Lock()
 _fence_seq: Dict[tuple, int] = {}
 
+_eager_stats_lock = threading.Lock()
+_eager_stats = {"ops": 0}
+
+
+def eager_op_stats() -> dict:
+    """Cumulative eager-plane accounting since the last reset:
+    ``ops`` = collective dispatches through the shared ``_run`` path,
+    ``fences`` = coordination-fence sequence advances summed over every
+    participant set.  Feeds the ``horovod_eager_*`` metric families."""
+    with _eager_stats_lock:
+        ops = _eager_stats["ops"]
+    with _fence_lock:
+        fences = sum(_fence_seq.values())
+    return {"ops": ops, "fences": fences}
+
 
 def reset_fences() -> None:
     """Reset barrier sequence numbers.  Called by ``hvd.shutdown()``: after
@@ -217,6 +234,8 @@ def reset_fences() -> None:
     reset_deferred()
     with _fence_lock:
         _fence_seq.clear()
+    with _eager_stats_lock:
+        _eager_stats["ops"] = 0
     _join.reset()
 
 

@@ -249,3 +249,117 @@ def fused_tree_collective(tree, collective_fn,
     buffers = pack(leaves, spec)
     reduced = [collective_fn(b) for b in buffers]
     return jax.tree.unflatten(treedef, unpack(reduced, spec))
+
+
+# -- plan introspection ----------------------------------------------------
+
+def _fence_policy() -> str:
+    """Human-readable fence policy the eager plane would apply to a
+    collective dispatched right now (compiled steps never fence: XLA
+    schedules their collectives)."""
+    st = global_state()
+    if st.mesh is None:
+        return "unfenced(no-mesh)"
+    from ..collectives.eager import _mesh_platform, _transport_needs_fence
+    platform = _mesh_platform(st.mesh)
+    if _transport_needs_fence(st.mesh):
+        return f"barrier+block({platform})"
+    return f"compiler-scheduled({platform})"
+
+
+def explain_plan(params, threshold_bytes: Optional[int] = None,
+                 compression=None, reverse: bool = False,
+                 extra: Tuple = (), register: bool = True) -> List[dict]:
+    """Render the planner's decision for ``params`` as structured rows.
+
+    One dict per bucket: ``bucket`` index, ``dtype``, ``leaves`` count,
+    ``elements``, raw ``bytes``, ``wire_bytes`` under ``compression``
+    (a spec string or codec class; None = uncompressed), the ``codec``
+    name, the eager ``fence`` policy, and the ``fuse_key`` the plan
+    memoizes under.  The rows come from the SAME :func:`plan_buckets`
+    call the exchange makes -- error-feedback codecs fold the
+    ``("ef", codec)`` context exactly like ``ef_bucket_plan`` -- so
+    bucket count and per-bucket bytes match the emitted exchange by
+    construction (asserted in tests/test_metrics.py).
+
+    ``register=True`` also publishes the rows as ``horovod_plan_*``
+    gauges so ``/metrics`` exposes the current plan.  Printable via
+    ``python -m horovod_tpu.run --explain-plan`` (:func:`render_plan`).
+    """
+    from ..collectives.compression import (is_error_feedback,
+                                           parse_compression,
+                                           wire_payload_bytes)
+    leaves = jax.tree.leaves(params)
+    comp = parse_compression(compression) if compression is not None \
+        else None
+    if threshold_bytes is None:
+        threshold_bytes = _threshold()
+    plan_extra = tuple(extra)
+    if comp is not None and is_error_feedback(comp):
+        # Mirror optim.distributed.ef_bucket_plan's memo context so the
+        # explained plan IS the exchange's plan (same cache entry).
+        plan_extra = ("ef", comp.__name__) + plan_extra
+    spec = plan_buckets(leaves, threshold_bytes, reverse=reverse,
+                        extra=plan_extra)
+    codec = comp.__name__ if comp is not None else "none"
+    fence = _fence_policy()
+    rows = []
+    for i, (dt, lspecs) in enumerate(spec.buffers):
+        dtype = str(jnp.dtype(dt))
+        size = sum(s.size for s in lspecs)
+        itemsize = jnp.dtype(dt).itemsize
+        raw = size * itemsize
+        wire = wire_payload_bytes(comp, size, itemsize) \
+            if comp is not None else raw
+        rows.append({
+            "bucket": i, "dtype": dtype, "leaves": len(lspecs),
+            "elements": int(size), "bytes": int(raw),
+            "wire_bytes": int(wire), "codec": codec, "fence": fence,
+            "fuse_key": "|".join(
+                [dtype, f"thr={int(threshold_bytes)}", codec]
+                + (["rev"] if reverse else [])),
+        })
+    if register:
+        register_plan_gauges(rows)
+    return rows
+
+
+def register_plan_gauges(rows: List[dict]) -> None:
+    """Publish explain_plan rows into the metrics registry."""
+    from ..timeline import metrics as _metrics
+    reg = _metrics.registry()
+    reg.gauge("horovod_plan_buckets",
+              "Bucket count of the most recently explained exchange plan"
+              ).set(len(rows))
+    by_bytes = reg.gauge(
+        "horovod_plan_bucket_bytes",
+        "Raw bytes per bucket of the explained plan",
+        labelnames=("bucket", "dtype"))
+    by_wire = reg.gauge(
+        "horovod_plan_bucket_wire_bytes",
+        "Wire bytes per bucket of the explained plan",
+        labelnames=("bucket", "dtype"))
+    for r in rows:
+        labels = {"bucket": str(r["bucket"]), "dtype": r["dtype"]}
+        by_bytes.labels(**labels).set(r["bytes"])
+        by_wire.labels(**labels).set(r["wire_bytes"])
+
+
+def render_plan(rows: List[dict]) -> str:
+    """Fixed-width table rendering of :func:`explain_plan` rows."""
+    if not rows:
+        return "(empty plan: no leaves)"
+    cols = ("bucket", "dtype", "leaves", "elements", "bytes",
+            "wire_bytes", "codec", "fence", "fuse_key")
+    table = [cols] + [tuple(str(r[c]) for c in cols) for r in rows]
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    lines = ["  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+             for row in table]
+    lines.insert(1, "  ".join("-" * w for w in widths))
+    total_raw = sum(r["bytes"] for r in rows)
+    total_wire = sum(r["wire_bytes"] for r in rows)
+    ratio = f" (ratio {total_raw / total_wire:.1f}x)" \
+        if 0 < total_wire < total_raw else ""
+    lines.append(f"total: {len(rows)} bucket(s), {total_raw} bytes raw, "
+                 f"{total_wire} bytes wire{ratio}")
+    return "\n".join(lines)

@@ -136,6 +136,17 @@ def init(
         if cfg.autotune:
             from ..autotune import Autotuner
             st.autotuner = Autotuner(cfg)
+        if cfg.metrics_enabled:
+            from ..timeline import metrics as _metrics
+            _metrics.install_default_metrics()
+            if cfg.metrics_port >= 0:
+                from ..run.metrics_server import MetricsServer
+                st.metrics_server = MetricsServer(port=cfg.metrics_port)
+                logger.info("Prometheus /metrics on port %d",
+                            st.metrics_server.port)
+        elif cfg.metrics_port >= 0:
+            logger.warning("HOROVOD_METRICS_PORT set but HOROVOD_METRICS=0; "
+                           "not starting the metrics endpoint")
         from . import stall as _stall
         _stall.configure(cfg)
         global _atexit_registered

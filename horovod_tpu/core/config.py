@@ -170,6 +170,15 @@ class Config:
     # --cpu test mode; the Gloo-CPU-backend analogue).
     force_cpu: bool = False
 
+    # Metrics plane (timeline/metrics.py).  HOROVOD_METRICS=0 disables the
+    # registry entirely (family accessors hand back a shared no-op object
+    # and the train-step StepReport instrumentation unwraps -- zero
+    # overhead).  HOROVOD_METRICS_PORT >= 0 serves Prometheus text on
+    # that port at hvd.init() (0 = ephemeral; read the bound port from
+    # global_state().metrics_server.port); -1 = no HTTP endpoint.
+    metrics_enabled: bool = True
+    metrics_port: int = -1
+
     # Persistent XLA compilation cache directory (HOROVOD_COMPILE_CACHE /
     # HVD_TPU_COMPILE_CACHE).  Big-model compiles through the tunnelled
     # runtime take tens of minutes (BERT-Large: ~35 min); the cache pays
@@ -290,4 +299,6 @@ def load_config() -> Config:
         desync_max_retries=_env_int("DESYNC_MAX_RETRIES", 3),
         heartbeat_timeout=_env_float("HEARTBEAT_TIMEOUT", 0.0),
         force_cpu=_env_bool("FORCE_CPU"),
+        metrics_enabled=_env_bool("METRICS", True),
+        metrics_port=_env_int("METRICS_PORT", -1),
     )

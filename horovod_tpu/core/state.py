@@ -38,6 +38,9 @@ class GlobalState:
         self.cache: Optional["ExecutableCache"] = None
         self.timeline: Optional["Timeline"] = None
         self.autotuner: Optional["Autotuner"] = None
+        # Prometheus /metrics endpoint (run/metrics_server.py), started by
+        # init() when HOROVOD_METRICS_PORT >= 0.
+        self.metrics_server = None
         # True when this process called jax.distributed.initialize and owns
         # a shutdown obligation.
         self.owns_distributed: bool = False
@@ -52,6 +55,9 @@ class GlobalState:
             self.timeline.close()
         self.timeline = None
         self.autotuner = None
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+        self.metrics_server = None
         self.owns_distributed = False
 
 

@@ -34,11 +34,19 @@ class State:
         self._reset_callbacks.extend(callbacks)
 
     def on_reset(self) -> None:
+        from ..timeline import metrics as _metrics
+        _metrics.registry().counter(
+            "horovod_elastic_reset_total",
+            "Elastic state resets (rank-change recoveries)").inc()
         for cb in self._reset_callbacks:
             cb()
 
     def on_hosts_updated(self, timestamp=None, update_res=None) -> None:
         """Hook invoked when the driver announces a topology change."""
+        from ..timeline import metrics as _metrics
+        _metrics.registry().counter(
+            "horovod_elastic_host_updates_total",
+            "Elastic host-set update notifications").inc()
 
     def _check_host_updates(self) -> None:
         """Raise HostsUpdatedInterrupt at the commit boundary if the driver

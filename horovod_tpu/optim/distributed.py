@@ -258,22 +258,36 @@ def ef_init_residuals(params, fusion_threshold: Optional[int], compression):
 
 
 def _note_compression_ratio(spec, compression) -> None:
-    """Host-side ``compression_ratio`` counter (trace-time: the ratio is a
-    pure function of the static bucket shapes)."""
+    """Host-side ``compression_ratio`` accounting (trace-time: the ratio
+    is a pure function of the static bucket shapes).  Feeds the timeline
+    counter track when one is active AND the metrics-registry gauges
+    unconditionally -- the gauges are set (not incremented) because this
+    fires once per trace, not per step; per-step totals come from the
+    StepReport instrumentation."""
     from ..core.state import global_state
-    tl = global_state().timeline
-    if tl is None:
-        return
     raw = wire = 0
     for dt, lspecs in spec.buffers:
         size = sum(s.size for s in lspecs)
         itemsize = jnp.dtype(dt).itemsize
         raw += size * itemsize
         wire += wire_payload_bytes(compression, size, itemsize)
-    if wire > 0:
+    if wire <= 0:
+        return
+    tl = global_state().timeline
+    if tl is not None:
         tl.counters({"compression_ratio": raw / wire,
                      "wire_bytes_per_step": wire,
                      "uncompressed_bytes_per_step": raw})
+    from ..timeline import metrics as _metrics
+    reg = _metrics.registry()
+    reg.gauge("horovod_compression_ratio",
+              "uncompressed / wire bytes of the gradient exchange"
+              ).set(raw / wire)
+    reg.gauge("horovod_wire_bytes_per_step",
+              "Per-chip exchange wire bytes per optimizer step").set(wire)
+    reg.gauge("horovod_uncompressed_bytes_per_step",
+              "Equivalent uncompressed exchange bytes per optimizer step"
+              ).set(raw)
 
 
 def ef_exchange(grads, residuals, *, compression, op=Average,

@@ -75,6 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="worker HOROVOD_LOG_LEVEL (overrides -v mapping)")
     p.add_argument("--check-build", action="store_true",
                    help="print build capabilities and exit")
+    p.add_argument("--explain-plan", action="store_true",
+                   help="render the exchange planner's bucket decision "
+                        "for a synthetic parameter set (honours "
+                        "HOROVOD_FUSION_THRESHOLD / HOROVOD_COMPRESSION) "
+                        "and exit")
     p.add_argument("--no-tag-output", action="store_true",
                    help="do not prefix worker output with [rank]<stream>")
     p.add_argument("--probe", action="store_true",
@@ -133,11 +138,40 @@ def check_build() -> str:
     return "\n".join(lines)
 
 
+def explain_plan_cli() -> str:
+    """``--explain-plan``: render the planner's decision for a synthetic
+    ResNet-ish parameter mix (a few big f32 matrices plus small bias
+    vectors) under the CONFIGURED threshold and codec -- no ``hvd.init``
+    needed, ``plan_buckets`` works uninitialized.  Gives operators a
+    zero-setup view of what the exchange stack would decide; pointed at a
+    real job, ``fusion.explain_plan(params)`` does the same in-process.
+    """
+    import jax
+    from ..controller import fusion
+    from ..core.config import load_config
+
+    cfg = load_config()
+    shapes = [(1000, 1000), (512, 512), (4096, 256), (256,), (1000,),
+              (64, 3, 7, 7), (512,)]
+    leaves = [jax.ShapeDtypeStruct(s, "float32") for s in shapes]
+    rows = fusion.explain_plan(leaves,
+                               threshold_bytes=cfg.fusion_threshold,
+                               compression=cfg.compression,
+                               register=False)
+    header = (f"# exchange plan: {len(leaves)} synthetic f32 leaves, "
+              f"threshold {cfg.fusion_threshold} bytes, "
+              f"codec {cfg.compression or 'none'}")
+    return header + "\n" + fusion.render_plan(rows)
+
+
 def run_command(args: Optional[List[str]] = None) -> int:
     parser = build_parser()
     opts = parser.parse_args(args)
     if opts.check_build:
         print(check_build())
+        return 0
+    if opts.explain_plan:
+        print(explain_plan_cli())
         return 0
 
     if opts.timeline_mark_cycles and not (

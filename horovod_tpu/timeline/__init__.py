@@ -38,6 +38,8 @@ class Timeline:
         self._next_pid = 1
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._close_lock = threading.Lock()
+        self._closed = False
         self._t0 = time.perf_counter()
         self._file = open(path, "w")
         self._file.write("[\n")
@@ -143,14 +145,28 @@ class Timeline:
                 return
 
     def close(self) -> None:
-        if self._file.closed:
-            return
+        """Idempotent and exception-safe: ``hvd.shutdown()`` closes the
+        timeline AND atexit fires the registration made in ``__init__``,
+        so the double-close path is the normal path.  The writer thread
+        is joined exactly once and the file closed exactly once, even if
+        draining or the closing ``]`` write raises (e.g. a full disk) --
+        a failed close must never wedge interpreter shutdown."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop.set()
         self._writer.join(timeout=5)
-        self._drain()
-        self._file.write("\n]\n")
-        self._file.close()
-        atexit.unregister(self.close)
+        try:
+            if not self._file.closed:
+                self._drain()
+                self._file.write("\n]\n")
+        finally:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            atexit.unregister(self.close)
 
 
 class DispatchGapMonitor:
@@ -198,6 +214,11 @@ class DispatchGapMonitor:
         self._t0 = None
         if self.timeline is not None:
             self.timeline.counter("host_dispatch_gap", gap)
+        from . import metrics as _metrics
+        _metrics.registry().gauge(
+            "horovod_dispatch_gap_fraction",
+            "Last DispatchGapMonitor window: host time NOT spent "
+            "dispatching (0 = devices never starved)").set(gap)
         return gap
 
     @property
@@ -264,6 +285,11 @@ class OverlapMonitor:
         self.windows.append(frac)
         if self.timeline is not None:
             self.timeline.counter("exchange_overlap", frac)
+        from . import metrics as _metrics
+        _metrics.registry().gauge(
+            "horovod_exchange_overlap_fraction",
+            "Last OverlapMonitor window: fraction of the exchange "
+            "hidden behind backward compute").set(frac)
         return frac
 
     @property

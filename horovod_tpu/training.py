@@ -349,7 +349,12 @@ def make_train_step(
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
-    return _maybe_tuned(shard, donate_argnums, loss_index=2)
+    return _maybe_tuned(shard, donate_argnums, loss_index=2,
+                        meta={"optimizer": optimizer,
+                              "zero_stage": zero_stage,
+                              "zero_compression": zero_compression,
+                              "microbatches": k_micro,
+                              "world": int(mesh.devices.size)})
 
 
 def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
@@ -725,10 +730,16 @@ def make_train_loop(
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
-    return _maybe_tuned(shard, donate_argnums, loss_index=2, steps=k)
+    return _maybe_tuned(shard, donate_argnums, loss_index=2, steps=k,
+                        meta={"optimizer": optimizer,
+                              "zero_stage": zero_stage,
+                              "zero_compression": zero_compression,
+                              "microbatches": k_micro,
+                              "world": int(mesh.devices.size)})
 
 
-def _maybe_tuned(shard, donate_argnums, loss_index: int, steps: int = 1):
+def _maybe_tuned(shard, donate_argnums, loss_index: int, steps: int = 1,
+                 meta: Optional[dict] = None):
     """jit the sharded step; under HOROVOD_AUTOTUNE=1 wrap it in the
     ParameterManager score loop.
 
@@ -743,35 +754,157 @@ def _maybe_tuned(shard, donate_argnums, loss_index: int, steps: int = 1):
     ``steps`` is the scan-loop steps-per-execution: one call of a k-step
     loop moves k steps' worth of gradient bytes, so the bytes/sec score
     stays comparable across loop shapes.
+
+    ``meta`` is the builder's exchange description consumed by the
+    StepReport instrumentation (optimizer, zero stage/codec, microbatch
+    count, mesh size); the jitted step comes back wrapped in
+    :class:`_InstrumentedStep` unless metrics are disabled.
     """
     from .core.state import global_state
+    from .timeline import metrics as _metrics
     tuner = global_state().autotuner
     if tuner is None:
-        return jax.jit(shard, donate_argnums=donate_argnums)
+        fn = jax.jit(shard, donate_argnums=donate_argnums)
+    else:
+        import time as _time
+        compiled = {}
+        grad_nbytes = [0]
 
-    import time as _time
-    compiled = {}
-    grad_nbytes = [0]
+        def tuned_step(params, *rest):
+            key = tuner.trace_key()  # every trace-time knob of this sample
+            fn = compiled.get(key)
+            if fn is None:
+                fn = jax.jit(shard, donate_argnums=donate_argnums)
+                compiled[key] = fn
+            if tuner.done:
+                return fn(params, *rest)
+            if not grad_nbytes[0]:
+                grad_nbytes[0] = sum(
+                    x.size * x.dtype.itemsize
+                    for x in jax.tree.leaves(params))
+            t0 = _time.perf_counter()
+            out = fn(params, *rest)
+            float(jnp.asarray(out[loss_index]).ravel()[0])  # honest fence
+            tuner.record_step(_time.perf_counter() - t0,
+                              grad_nbytes[0] * steps)
+            return out
 
-    def tuned_step(params, *rest):
-        key = tuner.trace_key()  # every trace-time knob of this sample
-        fn = compiled.get(key)
-        if fn is None:
-            fn = jax.jit(shard, donate_argnums=donate_argnums)
-            compiled[key] = fn
-        if tuner.done:
-            return fn(params, *rest)
-        if not grad_nbytes[0]:
-            grad_nbytes[0] = sum(
-                x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+        fn = tuned_step
+
+    if not _metrics.registry().enabled:
+        return fn
+    return _InstrumentedStep(fn, steps, meta or {})
+
+
+class _InstrumentedStep:
+    """Host-side StepReport sampler around the jitted step.
+
+    Times the DISPATCH of the underlying callable (no extra fence, no
+    device work) and feeds the process-wide metrics registry a
+    :class:`~horovod_tpu.timeline.metrics.StepReport` per call.  Every
+    other attribute (``.lower``, AOT paths) delegates to the wrapped
+    ``jax.jit`` object, and nothing is added INSIDE the traced program,
+    so buffer donation and scan-loop bitwise parity are untouched.
+
+    Exchange accounting is computed lazily from the first call's params
+    (shape/dtype reads only -- before the donated buffers are consumed)
+    and must match the existing bookkeeping byte-for-byte: the ZeRO-1
+    path reuses ``zero_report`` and the compressed path reuses
+    ``wire_payload_bytes`` over the exchange's own bucket plan, exactly
+    as ``bench.py`` prices them.  A failure in the accounting degrades to
+    zeros -- it must never break training.
+    """
+
+    def __init__(self, fn, steps: int, meta: dict):
+        self._fn = fn
+        self._steps = max(int(steps), 1)
+        self._meta = meta
+        self._accounting: Optional[Tuple[str, int, int]] = None
+        self._step_count = 0
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def _account(self, params) -> Tuple[str, int, int]:
+        if self._accounting is None:
+            try:
+                self._accounting = _step_exchange_accounting(
+                    params, self._meta)
+            except Exception:
+                self._accounting = ("unknown", 0, 0)
+        return self._accounting
+
+    def __call__(self, params, *rest):
+        from .timeline import metrics as _metrics
+        import time as _time
+        reg = _metrics.registry()
+        if not reg.enabled:
+            return self._fn(params, *rest)
+        codec, wire, raw = self._account(params)
         t0 = _time.perf_counter()
-        out = fn(params, *rest)
-        float(jnp.asarray(out[loss_index]).ravel()[0])  # honest fence
-        tuner.record_step(_time.perf_counter() - t0,
-                          grad_nbytes[0] * steps)
+        out = self._fn(params, *rest)
+        wall = _time.perf_counter() - t0
+        self._step_count += self._steps
+        try:
+            _metrics.record_step_report(_metrics.StepReport(
+                step=self._step_count,
+                wall_time_s=wall,
+                steps_per_exec=self._steps,
+                microbatches=int(self._meta.get("microbatches", 1)),
+                zero_stage=int(self._meta.get("zero_stage", 0)),
+                codec=codec,
+                exchanged_bytes=wire,
+                uncompressed_bytes=raw))
+        except Exception:
+            pass
         return out
 
-    return tuned_step
+
+def _step_exchange_accounting(params, meta) -> Tuple[str, int, int]:
+    """``(codec, wire_bytes_per_step, uncompressed_bytes_per_step)`` for
+    the exchange a step built with ``meta`` emits, per chip per optimizer
+    step.
+
+    ZeRO-1: ``zero_report``'s ``zero1_exchanged_bytes_per_chip`` against
+    its ``replicated_allreduce_bytes_per_chip`` equivalent (so the
+    implied ratio matches bench.py's zero compression entry).
+    DistributedOptimizer wrap: ``wire_payload_bytes`` summed over the
+    exchange's own bucket plan (``ef_bucket_plan`` for error-feedback
+    codecs, ``plan_buckets`` otherwise) against the raw gradient bytes.
+    Bare optimizer: no collective, wire 0.  The microbatch overlap factor
+    is NOT folded in -- the figure is the equivalent single-exchange
+    payload (see :class:`~horovod_tpu.timeline.metrics.StepReport`).
+    """
+    leaves = jax.tree.leaves(params)
+    raw = sum(int(x.size) * jnp.dtype(x.dtype).itemsize for x in leaves)
+    optimizer = meta.get("optimizer")
+    if meta.get("zero_stage"):
+        rep = _zero.zero_report(optimizer, params,
+                                int(meta.get("world", 1)),
+                                compression=meta.get("zero_compression"))
+        comp = meta.get("zero_compression")
+        codec = getattr(comp, "__name__", None) or \
+            (str(comp) if comp else "none")
+        return (codec, int(rep["zero1_exchanged_bytes_per_chip"]),
+                int(rep["replicated_allreduce_bytes_per_chip"]))
+    exchange = getattr(getattr(optimizer, "update", None),
+                       "_hvd_exchange", None)
+    if exchange is None:
+        return ("none", 0, raw)
+    from .collectives.compression import (is_error_feedback,
+                                          wire_payload_bytes)
+    comp = exchange["compression"]
+    if is_error_feedback(comp):
+        spec = _dist.ef_bucket_plan(leaves, exchange["fusion_threshold"],
+                                    comp)
+    else:
+        from .controller.fusion import plan_buckets
+        spec = plan_buckets(leaves, exchange["fusion_threshold"])
+    wire = 0
+    for dt, lspecs in spec.buffers:
+        size = sum(s.size for s in lspecs)
+        wire += wire_payload_bytes(comp, size, jnp.dtype(dt).itemsize)
+    return (getattr(comp, "__name__", type(comp).__name__), int(wire), raw)
 
 
 def make_flax_train_step(
@@ -829,7 +962,12 @@ def make_flax_train_step(
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
     # Autotune applies here too (HOROVOD_AUTOTUNE=1): loss is element 3.
-    return _maybe_tuned(shard, donate_argnums, loss_index=3)
+    return _maybe_tuned(shard, donate_argnums, loss_index=3,
+                        meta={"optimizer": optimizer,
+                              "zero_stage": zero_stage,
+                              "zero_compression": zero_compression,
+                              "microbatches": k_micro,
+                              "world": int(mesh.devices.size)})
 
 
 def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
@@ -930,7 +1068,12 @@ def make_flax_train_loop(
                           out_specs=(P(), P(), opt_spec, P()),
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
-    return _maybe_tuned(shard, donate_argnums, loss_index=3, steps=k)
+    return _maybe_tuned(shard, donate_argnums, loss_index=3, steps=k,
+                        meta={"optimizer": optimizer,
+                              "zero_stage": zero_stage,
+                              "zero_compression": zero_compression,
+                              "microbatches": k_micro,
+                              "world": int(mesh.devices.size)})
 
 
 def _softmax_xent(logits, y):

@@ -505,6 +505,120 @@ def test_bench_config_string_gains_codec_suffix(monkeypatch):
     assert b._config() == b.BASELINE_CONFIG
 
 
+# -- metrics snapshot block --------------------------------------------------
+# PR 6: bench.py records a horovod_tpu.metrics_snapshot() block under
+# "metrics" in each BENCH_*.json.  The validator only fires on entries
+# that carry the block (earlier committed rounds predate it), checking
+# the required keys, counter non-negativity, and that the wire-bytes
+# gauges agree with the compression entry's ratio when both describe the
+# same exchange.
+
+_METRICS_REQUIRED = ("families", "step_total", "wire_bytes_total",
+                     "wire_bytes_per_step", "uncompressed_bytes_per_step",
+                     "plan_cache_hits", "plan_cache_misses")
+
+
+def scan_metrics_snapshot_entries(bench_dir):
+    """Return [(path, why), ...] for malformed metrics-snapshot blocks."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            block = parsed.get("metrics")
+            if not block or "error" in block:
+                continue  # absent or degraded-with-reason: both fine
+            missing = [k for k in _METRICS_REQUIRED if k not in block]
+            if missing:
+                bad.append((path, f"metrics block missing {missing}"))
+                continue
+            negative = [k for k in _METRICS_REQUIRED
+                        if not isinstance(block[k], (int, float))
+                        or block[k] < 0]
+            if negative:
+                bad.append((path, f"negative/non-numeric metrics: "
+                                  f"{negative}"))
+                continue
+            comp = parsed.get("compression") or {}
+            wire = block["wire_bytes_per_step"]
+            raw = block["uncompressed_bytes_per_step"]
+            if (comp.get("wire_bytes_per_step") == wire and wire > 0
+                    and isinstance(comp.get("ratio"), (int, float))):
+                ratio = comp["ratio"]
+                if abs(ratio - raw / wire) > 0.02 * ratio:
+                    bad.append((path, f"metrics gauges {raw}/{wire} "
+                                      f"disagree with compression ratio "
+                                      f"{ratio}"))
+    return bad
+
+
+def test_committed_metrics_snapshot_entries_well_formed():
+    assert scan_metrics_snapshot_entries(REPO) == []
+
+
+def _write_metrics_entry(tmp_path, name, metrics, comp=None):
+    parsed = {"metric": "resnet50_images_per_sec_per_chip", "value": 2400.0,
+              "unit": "images/s/chip", "vs_baseline": None,
+              "config": "batch256_s2d_bf16_powersgd4",
+              "baseline_config": "batch256_s2d_bf16", "metrics": metrics}
+    if comp is not None:
+        parsed["compression"] = comp
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def _metrics_block(**over):
+    block = {"families": 14, "step_total": 40, "step_time_count": 40,
+             "step_time_sum_s": 1.25, "wire_bytes_total": 40000,
+             "wire_bytes_per_step": 1000,
+             "uncompressed_bytes_per_step": 100000,
+             "compression_ratio": 100.0, "plan_cache_hits": 39,
+             "plan_cache_misses": 1}
+    block.update(over)
+    return block
+
+
+def test_metrics_validator_accepts_well_formed_entry(tmp_path):
+    _write_metrics_entry(
+        tmp_path, "BENCH_r70.json", _metrics_block(),
+        comp={"codec": "powersgd:4", "wire_bytes_per_step": 1000,
+              "uncompressed_bytes_per_step": 100000, "ratio": 100.0})
+    # Block-free and degraded entries pass vacuously.
+    _write_metrics_entry(tmp_path, "BENCH_r71.json",
+                         {"error": "RuntimeError: snapshot failed"})
+    assert scan_metrics_snapshot_entries(str(tmp_path)) == []
+    assert scan_compression_entries(str(tmp_path)) == []
+
+
+def test_metrics_validator_trips_on_malformed(tmp_path):
+    block = _metrics_block()
+    del block["wire_bytes_total"]
+    _write_metrics_entry(tmp_path, "BENCH_r72.json", block)
+    _write_metrics_entry(tmp_path, "BENCH_r73.json",
+                         _metrics_block(step_total=-3))
+    _write_metrics_entry(
+        tmp_path, "BENCH_r74.json", _metrics_block(),
+        comp={"codec": "powersgd:4", "wire_bytes_per_step": 1000,
+              "uncompressed_bytes_per_step": 100000, "ratio": 50.0})
+    bad = dict(scan_metrics_snapshot_entries(str(tmp_path)))
+    assert "missing" in bad[str(tmp_path / "BENCH_r72.json")]
+    assert "negative" in bad[str(tmp_path / "BENCH_r73.json")]
+    assert "disagree" in bad[str(tmp_path / "BENCH_r74.json")]
+
+
+def test_bench_main_records_metrics_block():
+    """bench.py's result assembly must attach the metrics block (static
+    check: the wiring sits between comp_stats and the final print)."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert "bench_block" in src
+    assert 'result["metrics"]' in src
+
+
 # -- merged trajectory shape -------------------------------------------------
 # bench.py --trajectory folds every committed BENCH_r*.json into one
 # markdown table between the BENCH_TRAJECTORY markers in

@@ -70,6 +70,15 @@ def test_long_context_example_packed_cpu():
 
 
 @pytest.mark.integration
+def test_metrics_probe_example_cpu():
+    out = _run([os.path.join(REPO, "examples", "metrics_probe.py"),
+                "--cpu-devices", "2", "--steps", "3"])
+    assert "metrics probe OK" in out
+    assert "horovod_step_total 3" in out
+    assert "exchange plan" in out
+
+
+@pytest.mark.integration
 def test_torch_resnet50_example_cpu():
     out = _run([os.path.join(REPO, "examples", "torch_resnet50.py"),
                 "--cpu-devices", "2", "--image-size", "64",
