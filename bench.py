@@ -92,6 +92,16 @@ TINY = _env_on("BENCH_TINY")
 # reference).  Latency metric, no throughput baseline -> vs_baseline null.
 EAGER = _env_on("BENCH_EAGER")
 EAGER_NP = int(os.environ.get("BENCH_EAGER_NP", "2"))
+# BENCH_CHAOS=1 runs the elastic recovery drill instead of throughput: a
+# deterministic HOROVOD_CHAOS comm fault kills half the world mid-run
+# (8 -> 4 virtual CPU devices), the run recovers checkpointlessly via
+# JaxState.resize (ZeRO shards re-laid out, EF residual mass carried) and
+# reports steps-to-recover plus the 30-step convergence-proxy parity
+# against the uninterrupted run.  Never throughput-comparable ->
+# vs_baseline null.
+CHAOS_BENCH = _env_on("BENCH_CHAOS")
+CHAOS_SPEC = os.environ.get("BENCH_CHAOS_SPEC",
+                            "seed=7;comm@step=11,rank=0")
 
 
 def _config() -> str:
@@ -113,6 +123,121 @@ def _watchdog():
                       "error": f"watchdog: no result in {WATCHDOG_S}s "
                                "(TPU tunnel wedged?)"}), flush=True)
     os._exit(2)
+
+
+def _main_chaos():
+    """BENCH_CHAOS=1: deterministic kill-half-the-world recovery drill."""
+    from horovod_tpu.utils.platform import force_host_device_count
+    force_host_device_count(8, cpu=True)  # before jax touches the backend
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+    from horovod_tpu.elastic import chaos
+    from horovod_tpu.elastic.run_loop import _looks_like_comm_failure
+    from horovod_tpu.timeline import metrics as tm
+
+    comp = "topk:0.25"
+    steps, commit_every = 30, 3
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(16, 4).astype(np.float32)
+    x = rng.randn(64, 16).astype(np.float32)
+    data = (x, x @ w_true)
+    params0 = {"w1": rng.randn(16, 32).astype(np.float32) * 0.3,
+               "b1": np.zeros((32,), np.float32),
+               "w2": rng.randn(32, 4).astype(np.float32) * 0.3,
+               "b2": np.zeros((4,), np.float32)}
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        h = jnp.tanh(bx @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] + p["b2"] - by) ** 2)
+
+    def build():
+        p = hvd.replicate(params0)
+        st = hvd.zero_init(optax.adam(0.05), p, compression=comp)
+        step = hvd.make_train_step(loss_fn, optax.adam(0.05), zero_stage=1,
+                                   zero_compression=comp)
+        return p, st, step, hvd.shard_batch(data)
+
+    hvd.init()
+
+    # Uninterrupted reference run (world 8).
+    p, st, step, batch = build()
+    for _ in range(steps):
+        p, st, loss = step(p, st, batch)
+    base_loss = float(loss)
+
+    # Chaos run: same problem, injected comm fault, 8 -> 4 recovery.
+    hvd.shutdown()
+    hvd.init()
+    world_before = hvd.size()
+    p, st, step, batch = build()
+    state = elastic.JaxState(params=p, opt_state=st, batch=0)
+    chaos.install(CHAOS_SPEC, rank=0, size=1)
+    inj = chaos.injector()
+    recovery = None
+    batch_at_fault = None
+    while state.batch < steps:
+        try:
+            inj.on_step(state.batch + 1)
+            state.params, state.opt_state, loss = step(
+                state.params, state.opt_state, batch)
+            state.batch += 1
+            if state.batch % commit_every == 0:
+                state.commit()
+        except chaos.ChaosCommError as e:
+            if not _looks_like_comm_failure(e) or recovery is not None:
+                raise
+            batch_at_fault = state.batch
+            state.restore()
+            hvd.shutdown()
+            hvd.init(devices=jax.devices()[:4])
+            recovery = state.resize(world_before, 4)
+            tm.registry().counter(
+                "horovod_elastic_ranks_lost",
+                "Ranks lost across elastic recoveries").inc(
+                    world_before - 4)
+            step = hvd.make_train_step(loss_fn, optax.adam(0.05),
+                                       zero_stage=1, zero_compression=comp)
+            batch = hvd.shard_batch(data)
+
+    if recovery is None:
+        print(json.dumps({"metric": "elastic_chaos_recovery", "value": 0.0,
+                          "unit": "loss_ratio", "vs_baseline": None,
+                          "error": f"chaos fault never fired "
+                                   f"({CHAOS_SPEC!r})"}), flush=True)
+        os._exit(2)
+    ratio = float(loss) / base_loss
+    result = {
+        "metric": "elastic_chaos_recovery",
+        "value": round(ratio, 4),
+        "unit": "loss_ratio",
+        "vs_baseline": None,  # a CPU recovery drill has no throughput peer
+        "config": _config() + "_chaos",
+        "baseline_config": _config() + "_chaos",
+        "chaos": {
+            "spec": CHAOS_SPEC,
+            "steps_to_recover": batch_at_fault - state_batch_after_restore(
+                batch_at_fault, commit_every),
+            "parity_ratio": round(ratio, 4),
+            "ranks_lost": world_before - 4,
+            "world_before": world_before,
+            "world_after": 4,
+            "ef_residual_recovered_bytes": int(tm.registry().counter(
+                "horovod_ef_residual_recovered_bytes").value),
+            "recovery_report": {k: v for k, v in recovery.items()},
+        },
+    }
+    print(json.dumps(result), flush=True)
+    os._exit(0)
+
+
+def state_batch_after_restore(batch_at_fault: int, commit_every: int) -> int:
+    """The batch counter the restore rolled back to (last commit)."""
+    return (batch_at_fault // commit_every) * commit_every
 
 
 def _main_eager():
@@ -242,6 +367,8 @@ def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     if EAGER:
         _main_eager()
+    if CHAOS_BENCH:
+        _main_chaos()
     if OVERLAP and ZERO:
         sys.exit("BENCH_OVERLAP / HOROVOD_MICROBATCHES>1 is incompatible "
                  "with HOROVOD_ZERO=1 (the ZeRO arena exchange is already "

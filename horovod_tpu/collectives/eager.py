@@ -339,6 +339,8 @@ def synchronize(handle: int):
     if isinstance(value, BaseException):
         raise value
     with _stall.watched(f"synchronize(handle={handle})"):
+        from ..elastic import chaos as _chaos
+        _chaos.raise_if_armed()  # injected at=sync comm fault
         return jax.block_until_ready(value)
 
 
@@ -1439,6 +1441,8 @@ def barrier(*, process_set=None) -> None:
                lambda t: _ops.barrier(axes=(HVD_AXIS,)) * t, "barrier",
                publish_meta=jmeta)
     with _stall.watched("barrier"):
+        from ..elastic import chaos as _chaos
+        _chaos.raise_if_armed()  # injected at=sync comm fault
         jax.block_until_ready(out)
 
 

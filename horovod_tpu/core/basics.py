@@ -149,6 +149,12 @@ def init(
                            "not starting the metrics endpoint")
         from . import stall as _stall
         _stall.configure(cfg)
+        # Deterministic fault injection (HOROVOD_CHAOS): installed once
+        # per process, keyed to the process rank so every worker resolves
+        # the same schedule.  No-op without the env var.
+        from ..elastic import chaos as _chaos
+        _chaos.maybe_install(rank=jax.process_index(),
+                             size=jax.process_count())
         global _atexit_registered
         if not _atexit_registered:
             atexit.register(_atexit_shutdown)

@@ -135,6 +135,9 @@ class Config:
     stall_check_disable: bool = False
     stall_check_time: float = 60.0
     stall_shutdown_time: float = 0.0
+    # Waits older than this latch the elastic preemption notice (a
+    # wedged collective becomes an elastic reset, not a hang); 0 = off.
+    stall_reset_time: float = 0.0
 
     # Elastic.
     elastic_timeout: float = 600.0
@@ -283,6 +286,9 @@ def load_config() -> Config:
         stall_shutdown_time=_env_float(
             "STALL_SHUTDOWN_TIME_SECONDS",
             _env_float("STALL_SHUTDOWN_TIME", 0.0)),
+        stall_reset_time=_env_float(
+            "STALL_RESET_TIME_SECONDS",
+            _env_float("STALL_RESET_TIME", 0.0)),
         elastic_timeout=_env_float("ELASTIC_TIMEOUT", 600.0),
         log_level=_env("LOG_LEVEL", "warning") or "warning",
         log_hide_timestamp=_env_bool("LOG_HIDE_TIMESTAMP"),

@@ -39,9 +39,18 @@ class StallInspector:
     def __init__(self, warn_time_s: float = 60.0,
                  shutdown_time_s: float = 0.0,
                  check_interval_s: Optional[float] = None,
-                 on_shutdown: Optional[Callable[[List[str]], None]] = None):
+                 on_shutdown: Optional[Callable[[List[str]], None]] = None,
+                 reset_time_s: float = 0.0,
+                 on_reset: Optional[Callable[[List[str]], None]] = None):
         self.warn_time_s = warn_time_s
         self.shutdown_time_s = shutdown_time_s
+        # HOROVOD_STALL_RESET_TIME: waits older than this latch the
+        # elastic preemption notice, turning a wedged collective into a
+        # graceful elastic reset instead of a hang (or the harder
+        # os._exit of the shutdown threshold).
+        self.reset_time_s = reset_time_s
+        self._on_reset = on_reset or self._default_reset
+        self._reset_fired = False
         self.check_interval_s = check_interval_s or max(
             min(warn_time_s / 4.0, 10.0), 0.01)
         self._on_shutdown = on_shutdown or self._default_shutdown
@@ -96,6 +105,7 @@ class StallInspector:
         now = time.monotonic()
         stalled: List[str] = []
         doomed: List[str] = []
+        resettable: List[str] = []
         with self._lock:
             for token, (name, start) in self._inflight.items():
                 age = now - start
@@ -109,8 +119,13 @@ class StallInspector:
                         "%.1fs (> %.1fs). One or more peer processes may "
                         "have died or a device grant may be wedged.",
                         name, age, self.warn_time_s)
+                if self.reset_time_s > 0 and age > self.reset_time_s:
+                    resettable.append(name)
                 if self.shutdown_time_s > 0 and age > self.shutdown_time_s:
                     doomed.append(name)
+        if resettable and not self._reset_fired:
+            self._reset_fired = True
+            self._on_reset(resettable)
         if doomed:
             self._on_shutdown(doomed)
         return stalled
@@ -122,6 +137,18 @@ class StallInspector:
             "threshold; aborting the process (HOROVOD_STALL_SHUTDOWN_TIME "
             "semantics).", names)
         os._exit(17)
+
+    @staticmethod
+    def _default_reset(names: List[str]) -> None:
+        logger.warning(
+            "stall inspector: operations %s exceeded "
+            "HOROVOD_STALL_RESET_TIME; latching the elastic preemption "
+            "notice so the run loop resets instead of hanging.", names)
+        try:
+            from ..elastic import preemption
+            preemption.trigger(f"stall: {', '.join(names)}")
+        except ImportError:  # pragma: no cover - partial install
+            pass
 
     def _ensure_thread(self) -> None:
         if self._thread is not None and self._thread.is_alive():
@@ -167,7 +194,8 @@ def configure(config) -> Optional[StallInspector]:
         if not config.stall_check_disable and config.stall_check_time > 0:
             _inspector = StallInspector(
                 warn_time_s=config.stall_check_time,
-                shutdown_time_s=config.stall_shutdown_time)
+                shutdown_time_s=config.stall_shutdown_time,
+                reset_time_s=getattr(config, "stall_reset_time", 0.0))
         return _inspector
 
 
@@ -238,6 +266,13 @@ class HeartbeatWriter:
         self._thread.start()
 
     def beat(self, force: bool = False) -> None:
+        if not force:
+            try:
+                from ..elastic import chaos as _chaos
+                if _chaos.heartbeat_drop_active():
+                    return  # injected heartbeat loss (fault testing)
+            except ImportError:  # pragma: no cover - partial install
+                pass
         if not force and self._gate is not None:
             try:
                 if not self._gate():

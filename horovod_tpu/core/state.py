@@ -59,6 +59,15 @@ class GlobalState:
             self.metrics_server.stop()
         self.metrics_server = None
         self.owns_distributed = False
+        # Preemption machinery is keyed to the runtime lifecycle: stop
+        # the GCE poll thread and forget the handler-installed latch so
+        # repeated init/reset cycles don't leak pollers (the pending
+        # notice, if any, survives -- see preemption.on_runtime_reset).
+        try:
+            from ..elastic import preemption
+            preemption.on_runtime_reset()
+        except ImportError:  # pragma: no cover - partial install
+            pass
 
 
 _state = GlobalState()

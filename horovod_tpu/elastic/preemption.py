@@ -38,6 +38,7 @@ _announced = threading.Event()
 _reason: str = ""
 _installed = False
 _poller: threading.Thread = None
+_poll_stop = threading.Event()
 
 
 def notice_received() -> bool:
@@ -76,6 +77,34 @@ def reset() -> None:
     _reason = ""
 
 
+def stop_gce_poll(timeout: float = 6.0) -> None:
+    """Stop a running metadata poll thread (idempotent)."""
+    global _poller
+    p = _poller
+    if p is None:
+        return
+    _poll_stop.set()
+    if p.is_alive():
+        p.join(timeout=timeout)
+    _poller = None
+    _poll_stop.clear()
+
+
+def on_runtime_reset() -> None:
+    """Hook for ``core.state.GlobalState.reset`` (shutdown / re-init).
+
+    Stops the metadata poll thread so repeated init/reset cycles don't
+    leak pollers, and forgets the installed-handler latch so the next
+    ``elastic.run`` re-installs cleanly.  The OS-level SIGTERM handler
+    and a pending preemption NOTICE are deliberately left alone:
+    ``_reinitialize`` resets the runtime mid-recovery, and clearing the
+    latch there would drop a real preemption warning.
+    """
+    global _installed
+    stop_gce_poll()
+    _installed = False
+
+
 def _handler(signum, frame):  # pragma: no cover - exercised in live test
     trigger(f"signal {signum}")
     # Re-arm the default action: the first SIGTERM is a notice, a second
@@ -112,12 +141,13 @@ def start_gce_poll(interval_s: float = 5.0,
     global _poller
     if _poller is not None and _poller.is_alive():
         return _poller
+    _poll_stop.clear()
 
     def poll():
         import urllib.request
 
         failures = 0
-        while not _notice.is_set():
+        while not (_notice.is_set() or _poll_stop.is_set()):
             try:
                 req = urllib.request.Request(
                     GCE_PREEMPTED_URL,
@@ -133,7 +163,7 @@ def start_gce_poll(interval_s: float = 5.0,
                     logger.info("GCE metadata server unreachable %d times;"
                                 " stopping the preemption poll", failures)
                     return
-            _notice.wait(interval_s)
+            _poll_stop.wait(interval_s)
 
     _poller = threading.Thread(target=poll, name="hvd-preempt-poll",
                                daemon=True)

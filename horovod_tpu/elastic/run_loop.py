@@ -85,11 +85,30 @@ def _looks_like_comm_failure(err: BaseException) -> bool:
     """
     if isinstance(err, HorovodInternalError):
         return True
+    from . import chaos
+    if isinstance(err, chaos.ChaosCommError):
+        return True  # injected faults are comm failures by construction
+    # A rejected signature is a configuration bug (wrong per-job secret /
+    # clock skew), not a transport failure: it subclasses RuntimeError and
+    # its message mentions "rendezvous", so both gates would pass -- rule
+    # it out explicitly before they run.
+    try:
+        from ..run.http_kv import RendezvousAuthError
+        if isinstance(err, RendezvousAuthError):
+            return False
+    except ImportError:  # pragma: no cover - partial install
+        pass
     text = f"{type(err).__name__}: {err}"
+    # "rendezvous"/"urlopen error"/"timed out" cover KV-plane failures:
+    # http_kv normalizes urllib's URLError (an OSError subclass, so gate
+    # 1 already passes) into ConnectionError("rendezvous GET /kv/...:
+    # <urlopen error ...>"), and socket timeouts surface as plain
+    # "timed out" with no other signature.
     needles = ("DEADLINE_EXCEEDED", "UNAVAILABLE", "connection",
                "Connection", "gloo", "Gloo", "distributed", "heartbeat",
                "coordinator", "barrier timed out", "preempt",
-               "Socket closed", "recv", "peer")
+               "Socket closed", "recv", "peer", "rendezvous",
+               "urlopen error", "timed out", "chaos")
     if isinstance(err, _comm_error_types()):
         return any(n in text for n in needles)
     if str(err).startswith(_STATUS_PREFIXES):
@@ -246,7 +265,35 @@ def _elastic_loop(func, state, notifier, args, kwargs):
             print("preempted: exiting gracefully after commit", flush=True)
             return None
         if reset_required:
+            from ..core.config import _env_bool
+            old_size = _basics.size() if _basics.is_initialized() else None
             _reinitialize(notifier)
+            if _env_bool("ELASTIC_PREEMPT_POLL"):
+                # GlobalState.reset (inside _reinitialize's shutdown)
+                # stopped the metadata poll; re-arm it for the new life.
+                preemption.start_gce_poll()
+            new_size = _basics.size()
+            if old_size is not None and new_size != old_size:
+                from ..timeline import metrics as _metrics
+                if new_size < old_size:
+                    _metrics.registry().counter(
+                        "horovod_elastic_ranks_lost",
+                        "Ranks lost across elastic recoveries").inc(
+                            old_size - new_size)
+                if hasattr(state, "resize"):
+                    try:
+                        report = state.resize(old_size, new_size)
+                        logger.info(
+                            "checkpointless resize %d -> %d: %s",
+                            old_size, new_size, report)
+                    except Exception:
+                        # sync() still rebroadcasts whatever rank 0
+                        # holds; worst case the optimizer state is
+                        # re-derived instead of carried.
+                        logger.exception(
+                            "checkpointless resize %d -> %d failed; "
+                            "falling back to plain sync", old_size,
+                            new_size)
             state.on_reset()
             reset_required = False
         try:

@@ -21,6 +21,16 @@ import jax
 import jax.numpy as jnp
 
 
+def _tree_is_sharded(tree, world: int) -> bool:
+    """True when every array leaf carries a leading ``[world, ...]``
+    shard axis (the ZeRO flat-arena layout)."""
+    leaves = [x for x in jax.tree.leaves(tree)
+              if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1]
+    if not leaves:
+        return False
+    return all(x.shape[0] == world for x in leaves)
+
+
 class State:
     """Base elastic state: commit/restore/sync + reset listeners."""
 
@@ -54,6 +64,12 @@ class State:
         point).  The snapshot is taken before the check, so no progress is
         lost."""
         self._commit_count += 1  # snapshot is already saved at this point
+        # Chaos clock ticks at the commit boundary: the snapshot is
+        # already saved, so an injected failure here costs no progress
+        # beyond the replayed partial step -- same contract as
+        # HostsUpdatedInterrupt.
+        from . import chaos
+        chaos.on_commit()
         from .run_loop import check_for_host_updates
         check_for_host_updates(self)
 
@@ -146,10 +162,98 @@ class JaxState(State):
         self._check_host_updates()
 
     def restore(self) -> None:
+        # Steps rolled back = the recovery replay cost; exported as
+        # horovod_elastic_steps_to_recover on the metrics plane.  Use the
+        # largest positive regression over integer counters (batch,
+        # step, ...) -- bools are ints, skip them.
+        lost = 0
+        for k, saved in self._saved_scalars.items():
+            cur = getattr(self, k, None)
+            if (isinstance(cur, int) and not isinstance(cur, bool)
+                    and isinstance(saved, int)
+                    and not isinstance(saved, bool)):
+                lost = max(lost, cur - saved)
+        if lost > 0:
+            from ..timeline import metrics as _metrics
+            _metrics.registry().gauge(
+                "horovod_elastic_steps_to_recover",
+                "Steps rolled back to the last commit during the most "
+                "recent elastic recovery").set(float(lost))
         for k, v in self._saved_trees.items():
             setattr(self, k, jax.tree.map(jnp.asarray, v))
         for k, v in self._saved_scalars.items():
             setattr(self, k, copy.deepcopy(v))
+
+    def resize(self, old_size: int, new_size: int, *,
+               zero_keys: Optional[List[str]] = None,
+               fusion_threshold: Optional[int] = None,
+               compression=None) -> Dict[str, Any]:
+        """Checkpointless carry-state reconstruction after a world-size
+        change (``old_size`` -> ``new_size`` processes/shards).
+
+        Registered trees are rewritten in place:
+
+        - ``_EFState`` wrappers (error-feedback residual carries from
+          :class:`~horovod_tpu.optim.distributed.DistributedOptimizer`)
+          are re-bucketed for the new world size, carrying the unsent
+          residual mass instead of zeroing it.
+        - ``_ZeroEFState`` wrappers and ZeRO-sharded optimizer trees
+          (leaves with a leading ``[old_size, ...]`` shard axis; by
+          default any registered key named ``opt_state``, override with
+          ``zero_keys``) are re-laid out over the new arena plan from
+          :func:`~horovod_tpu.optim.zero.plan_arena`.
+        - Everything else (replicated params, scalars) is untouched --
+          ``sync()`` re-broadcasts those from rank 0.
+
+        Returns a report dict and refreshes the committed snapshot for
+        the resized keys so an intermediate ``restore()`` stays
+        consistent.
+        """
+        from ..optim import distributed as _dist
+        from ..optim import zero as _zero
+        report: Dict[str, Any] = {
+            "old_size": int(old_size), "new_size": int(new_size),
+            "resized": [], "carried_bytes": 0, "zeroed_buckets": 0,
+        }
+        if int(old_size) == int(new_size):
+            return report
+        params = getattr(self, "params", None) if hasattr(self, "params") \
+            else None
+        zkeys = set(zero_keys) if zero_keys is not None else {"opt_state"}
+        for k in self._tree_keys:
+            v = getattr(self, k)
+            if isinstance(v, _dist._EFState):
+                new_res, rep = _dist.ef_resize_residuals(
+                    v.residuals, params, old_size, new_size,
+                    fusion_threshold=fusion_threshold,
+                    compression=compression)
+                inner = v.inner
+                if _tree_is_sharded(inner, old_size):
+                    inner, zrep = _zero.zero_resize(
+                        inner, params, old_size, new_size)
+                    report["carried_bytes"] += zrep["carried_bytes"]
+                setattr(self, k, _dist._EFState(new_res, inner))
+                report["resized"].append(k)
+                report["carried_bytes"] += rep["carried_bytes"]
+                report["zeroed_buckets"] += rep["zeroed_buckets"]
+            elif isinstance(v, _zero._ZeroEFState) or (
+                    k in zkeys and _tree_is_sharded(v, old_size)):
+                new_v, rep = _zero.zero_resize(
+                    v, params, old_size, new_size)
+                setattr(self, k, new_v)
+                report["resized"].append(k)
+                report["carried_bytes"] += rep["carried_bytes"]
+                report["zeroed_buckets"] += rep["zeroed_buckets"]
+        for k in report["resized"]:
+            self._saved_trees[k] = jax.device_get(getattr(self, k))
+        if report["resized"]:
+            from ..timeline import metrics as _metrics
+            _metrics.registry().counter(
+                "horovod_ef_residual_recovered_bytes",
+                "Bytes of optimizer/EF carry state reconstructed "
+                "checkpointlessly across elastic resizes").inc(
+                    report["carried_bytes"])
+        return report
 
     def sync(self) -> None:
         from ..optim.functions import broadcast_, broadcast_object

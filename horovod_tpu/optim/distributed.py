@@ -492,3 +492,78 @@ def DistributedAdasumOptimizer(optimizer: optax.GradientTransformation,
     from ..collectives.reduce_op import Adasum
     kwargs["op"] = Adasum
     return DistributedOptimizer(optimizer, **kwargs)
+
+
+# --- elastic resize -------------------------------------------------------
+
+def ef_resize_residuals(residuals, params, old_world: int, new_world: int,
+                        *, fusion_threshold: Optional[int] = None,
+                        compression=None):
+    """Re-bucket an ``_EFState`` residual carry for a new world size.
+
+    EF bucket shapes depend only on the fusion threshold (world-
+    independent), so a rank change only changes the leading world axis.
+    The dropped ranks' pending correction mass is NOT lost: with the
+    exchange averaging over ``world``, the carried quantity is
+    ``sum(residuals) / world``, so the kept rows are rescaled by
+    ``new/old`` and each dropped row's mass is spread uniformly::
+
+        res'_i = (new/old) * res_i + sum(dropped) / old
+
+    which preserves ``sum(res') / new == sum(res) / old`` exactly (same
+    algebra when growing: the existing rows are rescaled and new rows
+    start at zero).  Residuals are zeroed -- with a counted warning --
+    only when the bucket plan itself is irreconcilable (different bucket
+    count or sizes, e.g. the fusion threshold changed across the
+    restart).
+
+    Returns ``(new_residuals, report)``.
+    """
+    import logging
+    import numpy as np
+    logger = logging.getLogger("horovod_tpu.optim")
+    old_world, new_world = int(old_world), int(new_world)
+    report = {"carried_bytes": 0, "zeroed_buckets": 0}
+    expected = None
+    if params is not None:
+        comp = _resolve_compression(compression)
+        spec = ef_bucket_plan(jax.tree.leaves(params), fusion_threshold,
+                              comp)
+        expected = [sum(s.size for s in lspecs)
+                    for _dt, lspecs in spec.buffers]
+
+    def _zeroed(size: int):
+        from ..optim.zero import _count_zeroed_residual
+        _count_zeroed_residual()
+        report["zeroed_buckets"] += 1
+        return jnp.zeros((new_world, size), jnp.float32)
+
+    res_list = list(residuals)
+    if expected is not None and len(res_list) != len(expected):
+        logger.warning(
+            "ef_resize_residuals: carry has %d bucket(s) but the plan "
+            "for the new world has %d -- zeroing all residuals",
+            len(res_list), len(expected))
+        return tuple(_zeroed(s) for s in expected), report
+
+    out = []
+    for i, r in enumerate(res_list):
+        arr = np.asarray(jax.device_get(r), dtype=np.float32)
+        size = expected[i] if expected is not None else (
+            arr.shape[1] if arr.ndim == 2 else -1)
+        if arr.ndim != 2 or arr.shape[1] != size:
+            logger.warning(
+                "ef_resize_residuals: bucket %d shape %s irreconcilable "
+                "with planned size %d -- zeroing it", i,
+                getattr(arr, "shape", None), size)
+            out.append(_zeroed(max(size, 0)))
+            continue
+        rows = arr.shape[0]
+        keep = min(rows, new_world)
+        newr = np.zeros((new_world, size), np.float32)
+        newr[:keep] = arr[:keep] * (new_world / rows)
+        if rows > new_world:
+            newr += arr[new_world:].sum(axis=0) / rows
+        out.append(jnp.asarray(newr))
+        report["carried_bytes"] += int(arr.nbytes)
+    return tuple(out), report

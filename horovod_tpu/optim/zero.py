@@ -463,3 +463,127 @@ def zero_report(optimizer, params, world: int, compression=None) -> dict:
         "opt_state_bytes_per_chip_zero1": int(opt_shard_bytes),
         "opt_state_bytes_per_chip_replicated": int(opt_full_bytes),
     }
+
+
+# --- elastic resize -------------------------------------------------------
+
+def zero_resize(state, params, old_world: int, new_world: int):
+    """Re-lay a ZeRO-1 optimizer state out for a new world size.
+
+    Checkpointless elastic recovery: after a rank loss (or join), the
+    flat arenas are re-planned for ``new_world`` and every sharded leaf
+    (leading ``[old_world, ...]`` axis) is re-sliced so each survivor
+    owns the correct 1/``new_world`` of the SAME flat content -- nothing
+    is re-derived, the bytes just move.  ``_ZeroEFState`` residual
+    carries index flat arena positions, so re-slicing carries the unsent
+    compression mass exactly (only the arena *padding* region, zero for
+    top-k and near-zero for powersgd, is dropped when the pad width
+    changes).  Per-shard replicated leaves (e.g. an adam step count of
+    shape ``[old_world]``) are broadcast from row 0.
+
+    Returns ``(new_state, report)`` with
+    ``report = {"carried_bytes", "zeroed_buckets", "resharded",
+    "replicated"}``.  Raises ``ValueError`` when a sharded leaf cannot
+    be matched to any arena (caller falls back to a full re-derivation).
+    """
+    import logging
+    logger = logging.getLogger("horovod_tpu.optim")
+    if params is None:
+        raise ValueError("zero_resize needs the params tree to re-plan "
+                         "the flat arenas")
+    old_world, new_world = int(old_world), int(new_world)
+    leaves = jax.tree.leaves(params)
+    old_spec = plan_arena(leaves, old_world)
+    new_spec = plan_arena(leaves, new_world)
+    report = {"carried_bytes": 0, "zeroed_buckets": 0, "resharded": 0,
+              "replicated": 0}
+
+    def relayout(arr: np.ndarray, ob: _ArenaBuffer, nb: _ArenaBuffer
+                 ) -> np.ndarray:
+        flat = arr.reshape(-1)[:ob.size]
+        pad = nb.padded - ob.size
+        if pad:
+            flat = np.concatenate(
+                [flat, np.zeros((pad,), dtype=arr.dtype)])
+        return flat.reshape(new_world, nb.shard)
+
+    def match_buffer(arr: np.ndarray) -> Optional[int]:
+        cands = [i for i, b in enumerate(old_spec.buffers)
+                 if b.shard == arr.shape[1]]
+        if len(cands) > 1:
+            same_dt = [i for i in cands
+                       if jnp.dtype(old_spec.buffers[i].dtype)
+                       == arr.dtype]
+            cands = same_dt or cands
+        return cands[0] if len(cands) == 1 else None
+
+    residuals = None
+    inner = state
+    if isinstance(state, _ZeroEFState):
+        inner = state.inner
+        res_out = []
+        for r, ob, nb in zip(state.residuals, old_spec.buffers,
+                             new_spec.buffers):
+            arr = np.asarray(jax.device_get(r), dtype=np.float32)
+            if arr.ndim == 2 and arr.shape == (old_world, ob.shard):
+                res_out.append(jnp.asarray(relayout(arr, ob, nb)))
+                report["carried_bytes"] += int(ob.size * 4)
+            else:
+                logger.warning(
+                    "zero_resize: residual carry of shape %s is "
+                    "irreconcilable with arena %s/%s -- zeroing it",
+                    getattr(arr, "shape", None), ob, nb)
+                _count_zeroed_residual()
+                res_out.append(
+                    jnp.zeros((new_world, nb.shard), jnp.float32))
+                report["zeroed_buckets"] += 1
+        if len(res_out) < len(new_spec.buffers):
+            for nb in new_spec.buffers[len(res_out):]:
+                _count_zeroed_residual()
+                res_out.append(
+                    jnp.zeros((new_world, nb.shard), jnp.float32))
+                report["zeroed_buckets"] += 1
+        residuals = tuple(res_out)
+
+    def fix_leaf(x):
+        arr = np.asarray(jax.device_get(x))
+        if arr.ndim >= 1 and arr.shape[0] == old_world:
+            if arr.ndim >= 2:
+                i = match_buffer(arr)
+                if i is not None:
+                    report["resharded"] += 1
+                    out = relayout(arr, old_spec.buffers[i],
+                                   new_spec.buffers[i])
+                    report["carried_bytes"] += int(
+                        old_spec.buffers[i].size * arr.dtype.itemsize)
+                    return jnp.asarray(out)
+                raise ValueError(
+                    f"zero_resize: sharded leaf of shape {arr.shape} "
+                    f"dtype {arr.dtype} matches no arena of the "
+                    f"old plan")
+            # [old_world] leaf: per-shard replicated content (e.g. the
+            # adam step count) -- broadcast row 0 to the new world.
+            if not np.all(arr == arr[0]):
+                logger.warning(
+                    "zero_resize: per-shard scalar rows disagree "
+                    "(%s); adopting shard 0's value", arr)
+            report["replicated"] += 1
+            return jnp.asarray(
+                np.repeat(arr[:1], new_world, axis=0))
+        return x  # replicated leaf: untouched
+
+    new_inner = jax.tree.map(fix_leaf, inner)
+    if residuals is not None:
+        return _ZeroEFState(residuals, new_inner), report
+    return new_inner, report
+
+
+def _count_zeroed_residual() -> None:
+    try:
+        from ..timeline import metrics as _metrics
+        _metrics.registry().counter(
+            "horovod_ef_residual_zeroed_total",
+            "EF residual buckets dropped (zeroed) during an elastic "
+            "resize because shapes were irreconcilable").inc()
+    except Exception:
+        pass

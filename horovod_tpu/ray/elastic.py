@@ -64,7 +64,8 @@ class ElasticRayExecutor:
                  slots_per_worker: int = 1, cpu: bool = False,
                  host_file: Optional[str] = None,
                  heartbeat_timeout_s: float = 0.0,
-                 network_rendezvous: bool = False):
+                 network_rendezvous: bool = False,
+                 chaos: Optional[str] = None):
         self.min_workers = min_workers
         self.max_workers = max_workers
         self.slots = slots_per_worker
@@ -72,6 +73,9 @@ class ElasticRayExecutor:
         self.host_file = host_file
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.network_rendezvous = network_rendezvous
+        # HOROVOD_CHAOS spec shipped to every worker (deterministic
+        # fault-injection runs; see horovod_tpu/elastic/chaos.py).
+        self.chaos = chaos
         self.workdir = tempfile.mkdtemp(prefix="hvd_tpu_ray_elastic_")
 
     def close(self) -> None:
@@ -120,11 +124,14 @@ class ElasticRayExecutor:
             [p for p in sys.path if p] +
             [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
              if p])
+        extra_env = {"PYTHONPATH": pypath}
+        if self.chaos:
+            extra_env["HOROVOD_CHAOS"] = self.chaos
         driver = ElasticDriver(
             command=[sys.executable, "-m",
                      "horovod_tpu.ray._elastic_worker", payload,
                      results_dir],
-            extra_env={"PYTHONPATH": pypath},
+            extra_env=extra_env,
             discovery_script=discovery,
             discovery_timeout_s=30.0 if self.host_file is None else 10.0,
             min_np=self.min_workers,

@@ -26,6 +26,7 @@ from typing import Dict, Optional, Tuple
 from urllib.request import Request, urlopen
 from urllib.error import HTTPError, URLError
 
+from .retry import RetryPolicy, call_with_retries
 from .secret import check_digest, compute_digest
 
 SIG_HEADER = "X-Hvd-Sig"
@@ -51,11 +52,20 @@ class RendezvousServer:
         # Multi-host deployments pass host="0.0.0.0" explicitly.
         self._store: Dict[str, bytes] = {}
         self._lock = threading.Lock()
+        # Monotonic deadline before which every request gets 503: the
+        # chaos harness uses this to simulate a driver outage that the
+        # client-side retry policy must ride out.
+        self._blackout_until = 0.0
+        server = self
         store, lock, secret = self._store, self._lock, secret_key
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
+
+            def _blacked_out(self) -> bool:
+                import time
+                return time.monotonic() < server._blackout_until
 
             def _verify(self, body: bytes) -> bool:
                 import time
@@ -78,6 +88,8 @@ class RendezvousServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self._blacked_out():
+                    return self._reply(503)
                 if not self._verify(b""):
                     return self._reply(403)
                 with lock:
@@ -87,6 +99,8 @@ class RendezvousServer:
             def do_PUT(self):
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
+                if self._blacked_out():
+                    return self._reply(503)
                 if not self._verify(body):
                     return self._reply(403)
                 with lock:
@@ -94,6 +108,8 @@ class RendezvousServer:
                 self._reply(200)
 
             def do_DELETE(self):
+                if self._blacked_out():
+                    return self._reply(503)
                 if not self._verify(b""):
                     return self._reply(403)
                 with lock:
@@ -107,6 +123,12 @@ class RendezvousServer:
                                         name="hvd-tpu-rendezvous")
         self._thread.start()
 
+    def blackout(self, secs: float) -> None:
+        """Refuse every request with 503 for ``secs`` seconds (fault
+        injection: simulated driver outage)."""
+        import time
+        self._blackout_until = time.monotonic() + max(0.0, secs)
+
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -117,22 +139,36 @@ class KVClient:
     """Signing client for :class:`RendezvousServer`."""
 
     def __init__(self, addr: str, port: int, secret_key: str,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.base = f"http://{addr}:{port}"
         self.secret_key = secret_key
         self.timeout_s = timeout_s
+        # One env-tuned policy for every KV caller (workers, driver
+        # heartbeats, notify): HOROVOD_KV_RETRIES / HOROVOD_KV_BACKOFF_MS.
+        self.retry_policy = (retry_policy if retry_policy is not None
+                             else RetryPolicy.from_env())
 
     @classmethod
     def from_url(cls, url: str, secret_key: str,
-                 timeout_s: float = 10.0) -> "KVClient":
+                 timeout_s: float = 10.0,
+                 retry_policy: Optional[RetryPolicy] = None) -> "KVClient":
         """``http://host:port`` -> client."""
         hostport = url.split("//", 1)[1].rstrip("/")
         host, _, port = hostport.rpartition(":")
-        return cls(host, int(port), secret_key, timeout_s)
+        return cls(host, int(port), secret_key, timeout_s,
+                   retry_policy=retry_policy)
 
     def _request(self, method: str, path: str,
                  body: bytes = b"") -> Tuple[int, bytes]:
         import time
+        try:
+            from ..elastic import chaos as _chaos
+        except ImportError:  # partial install without the elastic package
+            _chaos = None
+        if _chaos is not None and _chaos.kv_blackout_active():
+            raise ConnectionError(
+                f"rendezvous {method} {path}: chaos KV blackout")
         ts = repr(time.time())
         sig = compute_digest(self.secret_key,
                              _signable(method, path, ts, body))
@@ -161,18 +197,34 @@ class KVClient:
         if code != 200:
             raise ConnectionError(f"rendezvous {op} -> HTTP {code}")
 
+    def _retrying(self, fn, describe: str):
+        # RendezvousAuthError subclasses RuntimeError, not
+        # ConnectionError, so a bad secret surfaces on the first attempt;
+        # transport failures (already normalized to ConnectionError by
+        # _request) and non-200 statuses burn the backoff budget.
+        return call_with_retries(fn, policy=self.retry_policy,
+                                 retry_on=(ConnectionError,),
+                                 no_retry=(RendezvousAuthError,),
+                                 describe=describe)
+
     def put(self, scope: str, key: str, value: bytes) -> None:
-        code, _ = self._request("PUT", f"/kv/{scope}/{key}", value)
-        self._check(f"PUT {scope}/{key}", code)
+        def _once() -> None:
+            code, _ = self._request("PUT", f"/kv/{scope}/{key}", value)
+            self._check(f"PUT {scope}/{key}", code)
+        self._retrying(_once, f"kv PUT {scope}/{key}")
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        code, body = self._request("GET", f"/kv/{scope}/{key}")
-        if code == 200:
-            return body
-        if code == 404:
-            return None
-        self._check(f"GET {scope}/{key}", code)
+        def _once() -> Optional[bytes]:
+            code, body = self._request("GET", f"/kv/{scope}/{key}")
+            if code == 200:
+                return body
+            if code == 404:
+                return None
+            self._check(f"GET {scope}/{key}", code)
+        return self._retrying(_once, f"kv GET {scope}/{key}")
 
     def delete(self, scope: str, key: str) -> None:
-        code, _ = self._request("DELETE", f"/kv/{scope}/{key}")
-        self._check(f"DELETE {scope}/{key}", code)
+        def _once() -> None:
+            code, _ = self._request("DELETE", f"/kv/{scope}/{key}")
+            self._check(f"DELETE {scope}/{key}", code)
+        self._retrying(_once, f"kv DELETE {scope}/{key}")

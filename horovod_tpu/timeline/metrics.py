@@ -588,6 +588,22 @@ def install_default_metrics() -> None:
                 "Elastic state resets (rank-change recoveries)")
     reg.counter("horovod_elastic_host_updates_total",
                 "Elastic host-set update notifications")
+    reg.counter("horovod_elastic_ranks_lost",
+                "Ranks lost across elastic recoveries")
+    reg.gauge("horovod_elastic_steps_to_recover",
+              "Steps rolled back to the last commit during the most "
+              "recent elastic recovery")
+    reg.counter("horovod_ef_residual_recovered_bytes",
+                "Bytes of optimizer/EF carry state reconstructed "
+                "checkpointlessly across elastic resizes")
+    reg.counter("horovod_ef_residual_zeroed_total",
+                "EF residual buckets dropped (zeroed) during an elastic "
+                "resize because shapes were irreconcilable")
+    reg.counter("horovod_chaos_faults_total",
+                "Faults fired by the chaos injector")
+    reg.counter("horovod_kv_retries_total",
+                "Control-plane requests retried after a transport "
+                "failure")
     reg.counter("horovod_autotune_samples_total",
                 "Autotuner samples scored (one per sample window)")
     reg.add_collector(_collect_plan_cache)

@@ -664,3 +664,121 @@ def test_committed_benchmarks_doc_carries_merged_trajectory():
     assert len(data_rows) == len(bench.build_trajectory_rows(REPO)), (
         "docs/benchmarks.md trajectory is stale: re-run "
         "`python bench.py --trajectory`")
+
+
+# ---------------------------------------------------------------------------
+# Chaos-recovery entries (PR 7)
+# ---------------------------------------------------------------------------
+
+def scan_chaos_entries(bench_dir):
+    """Return [(path, why), ...] for malformed chaos-recovery entries.
+
+    A chaos entry records a mid-run rank kill and the checkpointless
+    recovery that followed: it must report at least one lost rank, a
+    positive rollback (steps_to_recover >= 1), a convergence-proxy
+    parity ratio inside the 1.25 acceptance bound, and a null
+    vs_baseline (a CPU recovery drill is never throughput-comparable)."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            ch = parsed.get("chaos")
+            if not ch:
+                continue
+            steps = ch.get("steps_to_recover")
+            if not isinstance(steps, int) or steps < 1:
+                bad.append((path, f"steps_to_recover must be an int >= 1, "
+                                  f"got {steps!r}"))
+            ratio = ch.get("parity_ratio")
+            if not (isinstance(ratio, (int, float)) and 0 < ratio <= 1.25):
+                bad.append((path, f"parity_ratio {ratio!r} outside "
+                                  f"(0, 1.25]"))
+            lost = ch.get("ranks_lost")
+            if not isinstance(lost, int) or lost < 1:
+                bad.append((path, f"ranks_lost must be an int >= 1, "
+                                  f"got {lost!r}"))
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "chaos entries must carry a null "
+                                  "vs_baseline"))
+    return bad
+
+
+def test_committed_chaos_entries_well_formed():
+    assert scan_chaos_entries(REPO) == []
+
+
+def test_committed_chaos_round_exists_and_recovers():
+    """Acceptance gate: a committed bench round must record the chaos
+    recovery drill -- a rank kill survived within the parity bound."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            ch = (entry.get("parsed") or {}).get("chaos")
+            if ch:
+                found.append((path, ch))
+    assert found, "no committed bench round carries a chaos block"
+    for path, ch in found:
+        assert ch["steps_to_recover"] >= 1, (path, ch)
+        assert ch["parity_ratio"] <= 1.25, (path, ch)
+        assert ch["world_after"] < ch["world_before"], (path, ch)
+
+
+def _write_chaos(tmp_path, name, ch, vs_baseline=None):
+    parsed = {"metric": "elastic_chaos_recovery", "value":
+              ch.get("parity_ratio"), "unit": "loss_ratio",
+              "vs_baseline": vs_baseline, "config": "chaos_zero1_topk4",
+              "baseline_config": "chaos_zero1_topk4", "chaos": ch}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def test_chaos_guard_accepts_good_entry(tmp_path):
+    _write_chaos(tmp_path, "BENCH_r91.json", {
+        "spec": "seed=7;comm@step=11,rank=0", "steps_to_recover": 1,
+        "parity_ratio": 1.002, "ranks_lost": 4, "world_before": 8,
+        "world_after": 4, "ef_residual_recovered_bytes": 816})
+    assert scan_chaos_entries(str(tmp_path)) == []
+
+
+def test_chaos_guard_trips_on_bad_entries(tmp_path):
+    _write_chaos(tmp_path, "BENCH_r92.json", {
+        "steps_to_recover": 0,            # no rollback measured
+        "parity_ratio": 2.0,              # outside the acceptance bound
+        "ranks_lost": 0})                 # nothing was actually killed
+    _write_chaos(tmp_path, "BENCH_r93.json", {
+        "steps_to_recover": 2, "parity_ratio": 1.1, "ranks_lost": 1},
+        vs_baseline=1.0)                  # must be null on a CPU drill
+    why = " ".join(w for _, w in scan_chaos_entries(str(tmp_path)))
+    assert "steps_to_recover" in why
+    assert "parity_ratio" in why
+    assert "ranks_lost" in why
+    assert "vs_baseline" in why
+
+
+def test_bench_chaos_mode_flags(monkeypatch):
+    """BENCH_CHAOS=1 selects the recovery drill; BENCH_CHAOS_SPEC
+    overrides the injected fault schedule."""
+    import importlib
+
+    import bench
+    monkeypatch.setenv("BENCH_CHAOS", "1")
+    b = importlib.reload(bench)
+    assert b.CHAOS_BENCH
+    assert "comm@step=" in b.CHAOS_SPEC  # deterministic default schedule
+    monkeypatch.setenv("BENCH_CHAOS_SPEC", "seed=9;comm@step=4,rank=0")
+    b = importlib.reload(bench)
+    assert b.CHAOS_SPEC == "seed=9;comm@step=4,rank=0"
+    monkeypatch.delenv("BENCH_CHAOS")
+    monkeypatch.delenv("BENCH_CHAOS_SPEC")
+    b = importlib.reload(bench)
+    assert not b.CHAOS_BENCH

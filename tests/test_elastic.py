@@ -356,3 +356,84 @@ def test_elastic_resnet50_variant(tmp_path):
                       env_extra={"ELASTIC_MODEL": "resnet50",
                                  "ELASTIC_IMAGE_SIZE": "32"},
                       delay="0.05")
+
+
+def test_elastic_sampler_state_roundtrip_across_resize():
+    """Mid-epoch rank/size change: the processed set survives a
+    state_dict JSON roundtrip into a NEW world, and the survivors split
+    the remainder with no sample dropped or duplicated."""
+    n = 23
+    world0 = [elastic.ElasticSampler(n, shuffle=True, seed=5)
+              for _ in range(4)]
+    for r, s in enumerate(world0):
+        s.set_epoch(2)
+        s.set_rank_and_size(r, 4)
+    # Every rank consumes its first 3 samples, then rank 3 dies.  As in
+    # the training loop, each rank records the GLOBAL batch (its own
+    # shard allgathered with everyone else's) so any survivor's state
+    # carries the full progress.
+    shards = [list(s)[:3] for s in world0]
+    processed = set()
+    for shard in shards:
+        assert not processed & set(shard)  # ranks were already disjoint
+        processed |= set(shard)
+    for s in world0:
+        s.record_batch(sorted(processed))
+    blob = json.dumps(world0[0].state_dict())  # what commit() would ship
+    world1 = [elastic.ElasticSampler(n, shuffle=True, seed=5)
+              for _ in range(2)]
+    remainder = []
+    for r, s in enumerate(world1):
+        s.load_state_dict(json.loads(blob))
+        s.set_rank_and_size(r, 2)
+        part = list(s)
+        assert not set(part) & processed      # nothing replayed
+        assert not set(part) & set(remainder)  # no cross-rank duplicate
+        remainder.extend(part)
+    assert set(remainder) | processed == set(range(n))
+    assert len(remainder) + len(processed) == n
+
+
+def test_gce_poll_stop_idempotent_and_reset_stops_it(monkeypatch):
+    """start_gce_poll must be idempotent while alive, stoppable, safe to
+    stop twice, and torn down by a global runtime reset -- a leaked
+    poller from a previous epoch would latch a stale preemption notice
+    into the next one."""
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.elastic import preemption
+    # An unroutable metadata server: the poll thread idles on failures
+    # (max_failures keeps it alive) without ever latching a notice.
+    monkeypatch.setattr(preemption, "GCE_PREEMPTED_URL",
+                        "http://127.0.0.1:9/preempted")
+    try:
+        t1 = preemption.start_gce_poll(interval_s=30.0,
+                                       max_failures=10**6)
+        assert t1 is not None and t1.is_alive()
+        assert preemption.start_gce_poll(interval_s=30.0,
+                                         max_failures=10**6) is t1
+        preemption.stop_gce_poll()
+        assert not t1.is_alive()
+        preemption.stop_gce_poll()  # idempotent: no poller, no error
+        t2 = preemption.start_gce_poll(interval_s=30.0,
+                                       max_failures=10**6)
+        assert t2 is not t1 and t2.is_alive()
+        global_state().reset()  # runtime teardown stops the poller too
+        t2.join(timeout=7.0)
+        assert not t2.is_alive()
+        assert not preemption.notice_received()
+    finally:
+        preemption.stop_gce_poll()
+        preemption.reset()
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+@_requires_multiprocess
+def test_chaos_kill_rank_live(tmp_path):
+    """Deterministic chaos kill: HOROVOD_CHAOS SIGKILLs rank 1 at step
+    5; the driver evicts the dead worker and the survivors finish at
+    size 2 through the same rollback/rendezvous path a real rank loss
+    takes."""
+    _run_elastic_live(
+        tmp_path, "a\nb\nc\n", None, expect_final=2, target=40,
+        env_extra={"HOROVOD_CHAOS": "seed=1;kill@step=5,rank=1"})
