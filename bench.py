@@ -609,6 +609,21 @@ def main():
     if comp_stats is not None:
         result["compression"] = comp_stats
     try:
+        # Static collective-consistency audit of the step ACTUALLY
+        # benchmarked: a retrace (never a run), cross-checked against the
+        # fusion/arena plan.  bench_guard gates on this block, so a bench
+        # number can't ship from a step whose exchange drifted off-plan.
+        from horovod_tpu.analysis import audit_step as _audit_step
+        target = loop if SCANLOOP else step
+        report = _audit_step(target, params, batch_stats, opt_state, batch,
+                             batch_stats=batch_stats, name="bench:step")
+        result["audit"] = dict(report.summary, ok=report.ok(),
+                               findings=[f.render() for f in
+                                         report.findings])
+        print(f"# {report.render().splitlines()[0]}", file=sys.stderr)
+    except Exception as e:  # audit failure must not void the run
+        result["audit"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
         from horovod_tpu.timeline.metrics import bench_block
         result["metrics"] = bench_block()
     except Exception as e:  # snapshot failure must not void the run

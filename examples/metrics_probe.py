@@ -100,6 +100,18 @@ def main():
                                    compression=args.compression)
         print("\nexchange plan (fusion.explain_plan):")
         print(fusion.render_plan(rows))
+
+        # The static auditor proves the trained step EMITS that plan:
+        # re-trace it (no execution) and cross-check every collective leg.
+        from horovod_tpu.analysis import audit_step
+        x = jnp.asarray(rng.randn(4 * hvd.size(), 32), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 8, 4 * hvd.size()), jnp.int32)
+        report = audit_step(step, params, opt_state,
+                            hvd.shard_batch((x, y)),
+                            donate_argnums=(0, 1), name="probe:step")
+        print("\nstatic audit (analysis.audit_step):")
+        print(report.render())
+        assert report.ok(), "audited step diverged from its exchange plan"
         assert len(families) >= 8, families
         print("\nmetrics probe OK")
 

@@ -1,0 +1,37 @@
+"""Static collective-consistency analysis for horovod_tpu.
+
+Two layers, one CLI:
+
+- **trace audit** (:mod:`.trace_audit`): trace a train step without
+  executing it, extract its collective graph from the jaxpr, and
+  cross-check it against the fusion/arena plan -- plus desync
+  (rank-dependent control flow around collectives), donation safety,
+  and per-backend fence policy;
+- **repo lints** (:mod:`.lints`): AST rules over the package source
+  (unlocked shared state in threaded modules, host nondeterminism in
+  traced step bodies, raw collectives outside the exchange layer, the
+  env-var documentation registry).
+
+CLI: ``python -m horovod_tpu.analysis [--step-audit|--lint|--all]``;
+exit code 1 when unsuppressed error findings remain (the CI gate).
+Accepted findings live in ``analysis_baseline.txt`` at the repo root,
+one justified entry per line.
+"""
+
+from .findings import (ERROR, WARNING, Finding, apply_baseline,
+                       default_baseline_path, errors, load_baseline,
+                       render_findings)
+from .lints import read_env_vars, rule_catalogue, run_lints
+from .stepmodel import ExpectedExchange, ExpectedOp, expected_exchange
+from .trace_audit import (STANDARD_CONFIGS, AuditReport,
+                          audit_standard_configs, audit_step,
+                          build_standard_config)
+
+__all__ = [
+    "ERROR", "WARNING", "Finding", "apply_baseline",
+    "default_baseline_path", "errors", "load_baseline", "render_findings",
+    "read_env_vars", "rule_catalogue", "run_lints",
+    "ExpectedExchange", "ExpectedOp", "expected_exchange",
+    "STANDARD_CONFIGS", "AuditReport", "audit_standard_configs",
+    "audit_step", "build_standard_config",
+]

@@ -1,0 +1,114 @@
+"""CLI / CI gate: ``python -m horovod_tpu.analysis [mode] [options]``.
+
+Modes (default ``--all``):
+
+- ``--lint``: AST rules over the ``horovod_tpu/`` source tree;
+- ``--step-audit``: trace-audit the four reference step configurations
+  (plain DP, ZeRO-1, powersgd+EF, microbatches=2) on a virtual CPU mesh
+  and cross-check emitted collectives against their plans;
+- ``--all``: both.
+
+Findings matching ``analysis_baseline.txt`` (``--baseline`` to override)
+are suppressed; exit status is 1 when unsuppressed ERROR findings remain
+and 0 otherwise, so the tier-1 gate is just the exit code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="Static collective-consistency analysis "
+                    "(trace audit + repo lints).")
+    parser.add_argument("--lint", action="store_true",
+                        help="run the AST repo lints")
+    parser.add_argument("--step-audit", action="store_true",
+                        help="trace-audit the reference step configs")
+    parser.add_argument("--all", action="store_true",
+                        help="run both layers (default)")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="baseline suppression file (default: "
+                             "analysis_baseline.txt at the repo root)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as JSON instead of text")
+    parser.add_argument("--devices", type=int, default=8,
+                        help="virtual CPU device count for the step "
+                             "audit mesh (default 8)")
+    args = parser.parse_args(argv)
+    if not (args.lint or args.step_audit or args.all):
+        args.all = True
+    if args.all:
+        args.lint = args.step_audit = True
+    return args
+
+
+def _run_step_audit(devices: int):
+    """Audit the reference configs on a forced-CPU virtual mesh.  Must
+    run before any jax backend initialization in this process."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ..utils.platform import force_host_device_count
+    force_host_device_count(devices, cpu=True)
+    import horovod_tpu as hvd
+    hvd.init()
+    from .trace_audit import audit_standard_configs
+    try:
+        return audit_standard_configs()
+    finally:
+        hvd.shutdown()
+
+
+def main(argv=None) -> int:
+    args = _parse_args(sys.argv[1:] if argv is None else argv)
+    from .findings import (ERROR, Finding, apply_baseline, errors,
+                           load_baseline, render_findings)
+
+    findings: List[Finding] = []
+    summaries = {}
+    if args.step_audit:
+        reports = _run_step_audit(args.devices)
+        for config, report in reports.items():
+            findings.extend(report.findings)
+            summaries[config] = report.summary
+            if not args.as_json:
+                print(report.render())
+    if args.lint:
+        from .lints import run_lints
+        findings.extend(run_lints())
+
+    baseline_path = args.baseline
+    try:
+        baseline = load_baseline(baseline_path)
+    except ValueError as exc:
+        print(f"baseline error: {exc}", file=sys.stderr)
+        return 2
+    from .findings import default_baseline_path
+    kept, suppressed = apply_baseline(
+        findings, baseline,
+        baseline_path=os.path.relpath(
+            baseline_path or default_baseline_path()))
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in kept],
+            "suppressed": [f.__dict__ for f in suppressed],
+            "step_audit": summaries,
+        }, indent=2, sort_keys=True))
+    else:
+        if kept:
+            print(render_findings(kept))
+        n_err = len(errors(kept))
+        print(f"analysis: {n_err} error(s), "
+              f"{len(kept) - n_err} warning(s), "
+              f"{len(suppressed)} baseline-suppressed")
+    return 1 if errors(kept) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,306 @@
+"""Jaxpr mechanics for the step auditor: collective extraction, the
+axis-index taint walk, and donation aval matching.
+
+Everything here is pure jaxpr traversal -- no planner knowledge.  The
+policy layer (:mod:`horovod_tpu.analysis.trace_audit`) turns the records
+produced here into findings by cross-checking them against the fusion
+plan.
+
+The walker recurses through every higher-order primitive this codebase
+emits (``pjit``, ``shard_map``, ``scan``, ``cond``, ``while``, custom
+jvp/vjp, ``remat``) and, as a safety net, through any ``params`` value
+that holds a jaxpr -- an UNRECOGNISED nesting primitive therefore still
+has its collectives counted rather than silently skipped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+try:  # jax 0.4.x private-but-stable core types
+    from jax._src.core import ClosedJaxpr, Jaxpr, Literal, Var
+except ImportError:  # pragma: no cover - future jax relocations
+    from jax.core import ClosedJaxpr, Jaxpr, Literal, Var  # type: ignore
+
+# Collective primitives we account for.  pmean lowers through psum; pmax /
+# pmin are collectives too (used by elastic join / metrics reductions).
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "all_gather", "reduce_scatter", "ppermute", "all_to_all",
+    "pmax", "pmin", "psum_invariant",
+})
+
+# The taint source: a per-rank value.  Anything data-derived from it may
+# diverge across ranks.
+TAINT_SOURCES = frozenset({"axis_index"})
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveRecord:
+    """One collective equation found in the traced step.
+
+    ``elements`` is the element count of the first operand (the payload
+    the wire moves per leg); ``dtype`` its dtype string.  ``path`` is the
+    nesting address, e.g. ``pjit/shard_map/scan[body]/eqn12``, and
+    ``in_loop`` marks records inside a ``scan``/``while`` body (their
+    static count is per-iteration, not per-trace).
+    """
+    kind: str
+    dtype: str
+    elements: int
+    path: str
+    axes: Tuple[str, ...]
+    in_loop: bool = False
+
+    def sig(self) -> Tuple[str, str, int]:
+        return (self.kind, self.dtype, self.elements)
+
+
+def _eqn_axes(eqn) -> Tuple[str, ...]:
+    names = eqn.params.get("axes") or eqn.params.get("axis_name") or ()
+    if isinstance(names, (str, int)):
+        names = (names,)
+    return tuple(str(n) for n in names)
+
+
+def _collective_record(eqn, path: str, in_loop: bool) -> CollectiveRecord:
+    aval = eqn.invars[0].aval
+    return CollectiveRecord(
+        kind=eqn.primitive.name,
+        dtype=str(np.dtype(aval.dtype)) if hasattr(aval, "dtype") else "?",
+        elements=int(np.prod(aval.shape, dtype=np.int64))
+        if hasattr(aval, "shape") else 0,
+        path=path,
+        axes=_eqn_axes(eqn),
+        in_loop=in_loop)
+
+
+def _as_jaxpr(v) -> Optional[Jaxpr]:
+    if isinstance(v, ClosedJaxpr):
+        return v.jaxpr
+    if isinstance(v, Jaxpr):
+        return v
+    return None
+
+
+def _param_jaxprs(eqn) -> List[Tuple[str, Jaxpr]]:
+    """Every jaxpr hiding in an equation's params (tuples included)."""
+    found = []
+    for key, val in eqn.params.items():
+        j = _as_jaxpr(val)
+        if j is not None:
+            found.append((key, j))
+            continue
+        if isinstance(val, (tuple, list)):
+            for i, item in enumerate(val):
+                j = _as_jaxpr(item)
+                if j is not None:
+                    found.append((f"{key}[{i}]", j))
+    return found
+
+
+def contains_collective(jaxpr: Jaxpr) -> bool:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            return True
+        for _, sub in _param_jaxprs(eqn):
+            if contains_collective(sub):
+                return True
+    return False
+
+
+def collect_collectives(closed: ClosedJaxpr) -> List[CollectiveRecord]:
+    """Flatten the collective graph of a traced function: one record per
+    collective equation, recursing through all nesting primitives."""
+    records: List[CollectiveRecord] = []
+
+    def walk(jaxpr: Jaxpr, path: str, in_loop: bool) -> None:
+        for i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                records.append(
+                    _collective_record(eqn, f"{path}/eqn{i}:{name}",
+                                       in_loop))
+                continue
+            loop = in_loop or name in ("scan", "while")
+            for key, sub in _param_jaxprs(eqn):
+                walk(sub, f"{path}/{name}.{key}", loop)
+
+    walk(closed.jaxpr, "", False)
+    return records
+
+
+# -- taint (desync) analysis ------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DesyncRecord:
+    """A control-flow equation whose predicate is data-dependent on
+    ``axis_index`` AND whose body contains a collective: ranks can take
+    different branches, so some ranks reach the collective and others do
+    not -- the static form of Horovod's runtime mismatch stall."""
+    primitive: str
+    path: str
+    collectives: Tuple[str, ...]
+
+
+def _branch_collectives(jaxpr: Jaxpr) -> Tuple[str, ...]:
+    names = []
+
+    def walk(j: Jaxpr) -> None:
+        for eqn in j.eqns:
+            if eqn.primitive.name in COLLECTIVE_PRIMS:
+                names.append(eqn.primitive.name)
+            for _, sub in _param_jaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return tuple(names)
+
+
+def find_rank_dependent_branches(closed: ClosedJaxpr) -> List[DesyncRecord]:
+    """Propagate axis_index taint through the jaxpr and flag ``cond`` /
+    ``while`` equations with a tainted predicate guarding a collective.
+
+    Taint is value-level: an ``axis_index`` output taints every value
+    computed from it.  Feeding a tainted value as DATA into a collective
+    is fine (rank masks, arena slicing); only divergent CONTROL around a
+    collective is a desync hazard, so ``cond`` branches and ``while``
+    bodies are what get checked.
+    """
+    records: List[DesyncRecord] = []
+
+    def read(env: Dict[Var, bool], v) -> bool:
+        return False if isinstance(v, Literal) else env.get(v, False)
+
+    def walk(jaxpr: Jaxpr, in_taints: Sequence[bool], path: str
+             ) -> List[bool]:
+        env: Dict[Var, bool] = {}
+        for var, t in zip(jaxpr.invars, in_taints):
+            env[var] = bool(t)
+        for var in jaxpr.constvars:
+            env[var] = False
+
+        for i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            in_t = [read(env, v) for v in eqn.invars]
+            here = f"{path}/eqn{i}:{name}"
+
+            if name in TAINT_SOURCES:
+                out_t = [True] * len(eqn.outvars)
+            elif name == "cond":
+                pred_t = in_t[0]
+                branches = [b.jaxpr for b in eqn.params["branches"]]
+                if pred_t:
+                    guarded = tuple(n for b in branches
+                                    for n in _branch_collectives(b))
+                    if guarded:
+                        records.append(DesyncRecord("cond", here, guarded))
+                outs = [walk(b, in_t[1:], f"{here}.branch{k}")
+                        for k, b in enumerate(branches)]
+                out_t = [pred_t or any(o[j] for o in outs)
+                         for j in range(len(eqn.outvars))]
+            elif name == "while":
+                nc, nb = (eqn.params["cond_nconsts"],
+                          eqn.params["body_nconsts"])
+                cond_j = eqn.params["cond_jaxpr"].jaxpr
+                body_j = eqn.params["body_jaxpr"].jaxpr
+                carry_t = list(in_t[nc + nb:])
+                # One extra pass so taint the body introduces into the
+                # carry reaches the cond check (fixpoint for this depth-1
+                # lattice: a second pass cannot add taint a first+rerun
+                # did not).
+                for _ in range(2):
+                    body_out = walk(body_j, in_t[nc:nc + nb] + carry_t,
+                                    f"{here}.body")
+                    carry_t = [a or b for a, b in zip(carry_t, body_out)]
+                cond_out = walk(cond_j, in_t[:nc] + carry_t, f"{here}.cond")
+                if any(cond_out) and contains_collective(body_j):
+                    records.append(DesyncRecord(
+                        "while", here, _branch_collectives(body_j)))
+                out_t = carry_t
+            elif name == "scan":
+                nc, ncar = eqn.params["num_consts"], eqn.params["num_carry"]
+                body = eqn.params["jaxpr"].jaxpr
+                carry_t = list(in_t[nc:nc + ncar])
+                xs_t = in_t[nc + ncar:]
+                for _ in range(2):
+                    outs = walk(body, in_t[:nc] + carry_t + xs_t,
+                                f"{here}.body")
+                    carry_t = [a or b
+                               for a, b in zip(carry_t, outs[:ncar])]
+                out_t = carry_t + outs[ncar:]
+            else:
+                subs = _param_jaxprs(eqn)
+                if subs and len(subs[0][1].invars) == len(eqn.invars):
+                    # pjit / shard_map / remat / custom_*_call: operands
+                    # map positionally onto the inner jaxpr.
+                    outs = walk(subs[0][1], in_t, f"{here}.{subs[0][0]}")
+                    out_t = (outs + [any(in_t)] *
+                             (len(eqn.outvars) - len(outs)))[
+                                 :len(eqn.outvars)]
+                else:
+                    # Element-wise default: any tainted input taints every
+                    # output.  Conservative but exact enough -- false
+                    # positives only matter at cond/while predicates.
+                    out_t = [any(in_t)] * len(eqn.outvars)
+
+            for var, t in zip(eqn.outvars, out_t):
+                if isinstance(var, Var):
+                    env[var] = t
+        return [read(env, v) for v in jaxpr.outvars]
+
+    j = closed.jaxpr
+    walk(j, [False] * len(j.invars), "")
+    return records
+
+
+# -- donation aval matching -------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DonationRecord:
+    """A donated input leaf whose (shape, dtype) matches NO remaining
+    output: the donated buffer cannot alias any result, so the caller's
+    array is consumed without a successor -- reading it after the step
+    (the usual ``params, opt_state, loss = step(params, ...)`` contract
+    relies on every donated leaf having a same-aval output) is a
+    use-after-free."""
+    argnum: int
+    leaf_index: int
+    shape: Tuple[int, ...]
+    dtype: str
+
+
+def check_donation(closed: ClosedJaxpr, args: Sequence[Any],
+                   donate_argnums: Sequence[int]) -> List[DonationRecord]:
+    """Multiset-match donated input leaf avals against output avals.
+
+    Mirrors XLA's aliasing rule: a donated buffer can only be reused by
+    an output of identical shape+dtype, and each output absorbs at most
+    one donation.  Non-donated inputs are not considered (they never
+    donate), so spare outputs remain available for donated leaves.
+    """
+    flat_counts = [len(jax.tree.leaves(a)) for a in args]
+    offsets = np.cumsum([0] + flat_counts)
+    in_avals = list(closed.in_avals)
+    out_pool: Dict[Tuple[Tuple[int, ...], str], int] = {}
+    for aval in closed.out_avals:
+        key = (tuple(aval.shape), str(np.dtype(aval.dtype)))
+        out_pool[key] = out_pool.get(key, 0) + 1
+
+    records = []
+    for argnum in donate_argnums:
+        if argnum >= len(flat_counts):
+            continue
+        for li, aval in enumerate(
+                in_avals[offsets[argnum]:offsets[argnum + 1]]):
+            key = (tuple(aval.shape), str(np.dtype(aval.dtype)))
+            if out_pool.get(key, 0) > 0:
+                out_pool[key] -= 1
+            else:
+                records.append(DonationRecord(
+                    argnum=argnum, leaf_index=li,
+                    shape=tuple(aval.shape), dtype=key[1]))
+    return records

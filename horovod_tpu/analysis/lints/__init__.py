@@ -1,0 +1,15 @@
+"""AST repo lints (layer 2 of the static-analysis plane)."""
+
+from .base import (LintContext, LintRule, all_rules, rule_catalogue,
+                   run_lints)
+from .envreg import EnvRegistryRule, read_env_vars, scan_env_vars
+from .locks import UnlockedSharedStateRule
+from .nondeterminism import NondeterminismInStepRule
+from .planner import CollectiveOutsidePlannerRule
+
+__all__ = [
+    "LintContext", "LintRule", "all_rules", "rule_catalogue", "run_lints",
+    "EnvRegistryRule", "read_env_vars", "scan_env_vars",
+    "UnlockedSharedStateRule", "NondeterminismInStepRule",
+    "CollectiveOutsidePlannerRule",
+]

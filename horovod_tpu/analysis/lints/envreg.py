@@ -1,0 +1,74 @@
+"""``lint-undocumented-env``: the env-var registry rule.
+
+Single source of truth for the "every ``HOROVOD_*`` knob the library
+reads must have a row in ``docs/api.md``" contract (previously a grep
+inside ``tests/test_env_docs.py``; that test now calls this rule).  Any
+``_env(...)`` / ``_env_bool/int/float(...)`` call site and any literal
+``os.environ`` access of a ``HOROVOD_`` / ``HVD_TPU_`` name contributes
+a variable; each must appear with its ``HOROVOD_`` spelling somewhere in
+the docs.  An env knob nobody can discover is a support burden.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional
+
+from ..findings import Finding
+from .base import LintContext, LintRule
+
+_ENV_CALL = re.compile(
+    r'_env(?:_bool|_int|_float)?\(\s*"([A-Z][A-Z0-9_]*)"')
+# Literal os.environ reads of a fully-prefixed name.  Writes (launcher
+# code exporting identity to children) count too: the variable is part
+# of the public surface either way.
+_ENV_LITERAL = re.compile(
+    r'(?:os\.environ(?:\.get)?[\[(]\s*|getenv\(\s*)"'
+    r'(?:HOROVOD_|HVD_TPU_)([A-Z][A-Z0-9_]*)"')
+
+DOC_PATH = "docs/api.md"
+
+
+def scan_env_vars(ctx: LintContext) -> Dict[str, List[str]]:
+    """``{canonical_name: [repo-relative file, ...]}`` for every
+    HOROVOD_* env var read in the package (canonical = prefix-less)."""
+    hits: Dict[str, List[str]] = {}
+    for sf in ctx.files:
+        names = set(_ENV_CALL.findall(sf.source)) \
+            | set(_ENV_LITERAL.findall(sf.source))
+        for name in sorted(names):
+            hits.setdefault(name, []).append(sf.relpath)
+    return hits
+
+
+def read_env_vars(pkg_dir: str,
+                  repo_root: Optional[str] = None) -> Dict[str, List[str]]:
+    """Standalone scan over an arbitrary package dir (test fixtures)."""
+    return scan_env_vars(LintContext(pkg_dir=pkg_dir, repo_root=repo_root))
+
+
+class EnvRegistryRule(LintRule):
+    id = "lint-undocumented-env"
+    severity = "error"
+    description = ("HOROVOD_* env var read in the package but absent "
+                   "from the docs/api.md registry")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        doc = ctx.read_doc(DOC_PATH)
+        if doc is None:
+            return [self.finding(DOC_PATH, "missing",
+                                 f"{DOC_PATH} not found; the env registry "
+                                 "has nowhere to live")]
+        hits = scan_env_vars(ctx)
+        if not hits:
+            return [self.finding("horovod_tpu", "empty-scan",
+                                 "scanner found no env reads -- the regex "
+                                 "rotted")]
+        findings = []
+        for name, files in sorted(hits.items()):
+            if "HOROVOD_" + name not in doc:
+                findings.append(self.finding(
+                    files[0], name,
+                    f"HOROVOD_{name} is read in {', '.join(files)} but "
+                    f"has no row in {DOC_PATH}"))
+        return findings

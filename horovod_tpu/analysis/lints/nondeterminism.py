@@ -1,0 +1,123 @@
+"""``lint-nondeterminism-in-step``: wall-clock / host-RNG reads inside
+traced step bodies.
+
+A function handed to ``jax.jit`` / ``jax.shard_map`` / ``lax.scan`` is
+traced ONCE; a ``time.time()`` or ``random.random()`` inside it bakes
+one host value into the compiled program -- and if ranks trace
+independently, a DIFFERENT value per rank, which desyncs every numeric
+path downstream.  The rule collects function names passed to tracing
+entry points in each module and scans those functions' bodies for host
+nondeterminism calls (``time.*``, ``datetime.now``, ``random.*``,
+``np.random.*``).  ``jax.random`` is explicitly fine (keyed, traced).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..findings import Finding
+from .base import LintContext, LintRule, SourceFile
+
+# Entry points whose first (or func=) argument gets traced.
+_TRACE_ENTRY_ATTRS = {"jit", "shard_map", "scan", "while_loop", "cond",
+                      "pmap", "checkpoint", "remat", "fori_loop", "switch"}
+
+_TIME_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+               "time_ns", "perf_counter_ns", "monotonic_ns"}
+_DATETIME_ATTRS = {"now", "utcnow", "today"}
+
+
+def _root_name(node: ast.AST) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts[::-1]
+
+
+def _nondeterminism(call: ast.Call) -> str:
+    """Non-empty description when ``call`` reads host time/RNG."""
+    chain = _attr_chain(call.func)
+    if len(chain) < 2:
+        return ""
+    root, attrs = chain[0], chain[1:]
+    root_l = root.lower().lstrip("_")
+    leaf = attrs[-1]
+    if root_l in ("time",) and leaf in _TIME_ATTRS:
+        return f"wall-clock read {'.'.join(chain)}()"
+    if root_l in ("datetime",) and leaf in _DATETIME_ATTRS:
+        return f"wall-clock read {'.'.join(chain)}()"
+    if root_l in ("random",):
+        return f"host RNG {'.'.join(chain)}()"
+    if root_l in ("np", "numpy") and len(attrs) >= 2 \
+            and attrs[0] == "random":
+        return f"host RNG {'.'.join(chain)}()"
+    return ""
+
+
+def _traced_names(tree: ast.AST) -> Set[str]:
+    """Function NAMES passed to tracing entry points in this module."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        entry = (isinstance(fn, ast.Attribute)
+                 and fn.attr in _TRACE_ENTRY_ATTRS) or \
+                (isinstance(fn, ast.Name) and fn.id in _TRACE_ENTRY_ATTRS)
+        if not entry:
+            continue
+        cands = list(node.args[:2])
+        cands += [kw.value for kw in node.keywords
+                  if kw.arg in ("f", "fun", "body_fun", "cond_fun")]
+        for arg in cands:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif (isinstance(arg, ast.Call)
+                  and isinstance(arg.func, ast.Name)
+                  and arg.func.id == "partial" and arg.args
+                  and isinstance(arg.args[0], ast.Name)):
+                names.add(arg.args[0].id)
+    return names
+
+
+class NondeterminismInStepRule(LintRule):
+    id = "lint-nondeterminism-in-step"
+    severity = "error"
+    description = ("wall-clock or host-RNG call inside a function traced "
+                   "by jit/shard_map/scan (bakes a per-rank host value "
+                   "into the compiled step)")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for sf in ctx.files:
+            traced = _traced_names(sf.tree)
+            if not traced:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name not in traced:
+                    continue
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    why = _nondeterminism(sub)
+                    if why:
+                        findings.append(self.finding(
+                            sf, f"{node.name}:{sub.lineno}",
+                            f"{why} inside traced function "
+                            f"{node.name}(); thread the value in as an "
+                            "argument or use jax.random",
+                            line=sub.lineno))
+        return findings

@@ -1,0 +1,77 @@
+"""``lint-collective-outside-planner``: raw ``lax`` collectives in
+library code.
+
+Every cross-rank collective in library code must route through
+``horovod_tpu.collectives.ops`` (which resolves axes/process sets,
+applies wire codecs and keeps the plan accountable) -- a raw
+``jax.lax.psum`` in a feature module bypasses reduce-op semantics,
+process-set masking, AND the step auditor's plan model.  The exchange
+layer itself (``collectives/``, ``adasum/``) is exempt: it is where the
+raw primitives are supposed to live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..findings import Finding
+from .base import LintContext, LintRule
+
+_COLLECTIVE_ATTRS = {"psum", "psum_scatter", "all_gather", "all_to_all",
+                     "ppermute", "pmean", "pmax", "pmin", "pshuffle"}
+
+# Directories (repo-relative prefixes) owning the raw primitives.
+_EXCHANGE_LAYER = ("horovod_tpu/collectives/", "horovod_tpu/adasum/")
+
+
+class CollectiveOutsidePlannerRule(LintRule):
+    id = "lint-collective-outside-planner"
+    severity = "error"
+    description = ("raw jax.lax collective invoked outside the exchange "
+                   "layer (bypasses ops-layer axis/codec/process-set "
+                   "resolution and the plan audit)")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for sf in ctx.files:
+            if sf.relpath.startswith(_EXCHANGE_LAYER):
+                continue
+            for node in ast.walk(sf.tree):
+                call = None
+                if isinstance(node, ast.Call):
+                    call = node.func
+                elif isinstance(node, ast.Attribute):
+                    # Bare references too (partial(lax.ppermute, ...)).
+                    call = node
+                if not isinstance(call, ast.Attribute):
+                    continue
+                if call.attr not in _COLLECTIVE_ATTRS:
+                    continue
+                base = call.value
+                is_lax = (isinstance(base, ast.Name)
+                          and base.id in ("lax", "plax")) or \
+                         (isinstance(base, ast.Attribute)
+                          and base.attr == "lax")
+                if not is_lax:
+                    continue
+                if not isinstance(node, ast.Call):
+                    # Count the reference site once; the Call branch
+                    # reports invocations, this catches partial() use.
+                    if isinstance(getattr(node, "ctx", None), ast.Store):
+                        continue
+                findings.append(self.finding(
+                    sf, f"lax.{call.attr}:{call.lineno}",
+                    f"direct lax.{call.attr} outside the exchange layer; "
+                    "route through horovod_tpu.collectives.ops",
+                    line=call.lineno))
+        # A Call's func Attribute is also walked as an Attribute node;
+        # dedupe per (path, line, attr).
+        seen = set()
+        unique = []
+        for f in findings:
+            if f.key() + (f.line,) in seen:
+                continue
+            seen.add(f.key() + (f.line,))
+            unique.append(f)
+        return unique

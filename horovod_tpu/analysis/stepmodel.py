@@ -1,0 +1,289 @@
+"""Expected-collective model: what a train step SHOULD emit.
+
+Given the builder metadata a :func:`horovod_tpu.make_train_step` /
+``make_flax_train_step`` step carries (optimizer wrap, zero stage,
+microbatch count, world size), derive the exact multiset of collectives
+the exchange is contracted to put on the wire -- op kind, dtype, and
+element count per leg -- from the SAME planner calls the exchange makes
+(``fusion.plan_buckets`` / ``ef_bucket_plan`` / ``zero.plan_arena``), so
+the expectation and the emission can only diverge if the exchange code
+itself diverges from its plan.
+
+Width references:
+
+- cast codecs: one ``psum`` per bucket, full bucket elements at the wire
+  dtype (f32 buckets cast down, narrow/int buckets ride as-is);
+- powersgd(r): two f32 ``psum`` legs per floating bucket of
+  ``powersgd_factor_widths(size, r)`` elements -- the P/Q factor widths
+  ``joinop._replay`` replays bitwise;
+- topk(f): two ``all_gather`` legs per floating bucket of
+  ``k = min(topk_count(size, f), size)`` elements (f32 values + int32
+  indices);
+- ZeRO-1: per dtype arena, one ``reduce_scatter`` of the padded arena
+  plus one ``all_gather`` of the shard at the allgather codec's wire
+  dtype;
+- microbatches=k: per reverse-planned bucket, k ``reduce_scatter`` legs
+  of the ``lcm(256, n)``-padded bucket plus one closing ``all_gather``
+  of ``padded / n`` elements, all at the wire dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..collectives import ops as _ops
+from ..collectives.compression import (Compression, is_error_feedback,
+                                       is_fp8, is_powersgd,
+                                       parse_compression,
+                                       powersgd_factor_widths, topk_count)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpectedOp:
+    """One collective leg the exchange contract requires."""
+    kind: str
+    dtype: str
+    elements: int
+    label: str    # e.g. "bucket0(f32)/psum-P"
+
+    def sig(self) -> Tuple[str, str, int]:
+        return (self.kind, self.dtype, self.elements)
+
+
+@dataclasses.dataclass
+class ExpectedExchange:
+    """The derived contract plus the plan rows it was derived from.
+
+    ``supported=False`` means the config uses an exchange the model does
+    not price (chunked/hierarchical/fp8/process-set/Adasum paths); the
+    auditor then skips plan matching and reports a warning instead of
+    guessing."""
+    ops: List[ExpectedOp]
+    plan_rows: List[dict]
+    supported: bool = True
+    notes: Tuple[str, ...] = ()
+
+
+def _wire_dtype(comp, dtype) -> str:
+    """Dtype a cast codec puts on the wire for a ``dtype`` bucket."""
+    dt = jnp.dtype(dtype)
+    wd = getattr(comp, "wire_dtype", None)
+    if (wd is not None and jnp.issubdtype(dt, jnp.floating)
+            and dt.itemsize > jnp.dtype(wd).itemsize):
+        return str(jnp.dtype(wd))
+    return str(dt)
+
+
+def _unsupported(notes) -> ExpectedExchange:
+    return ExpectedExchange(ops=[], plan_rows=[], supported=False,
+                            notes=tuple(notes))
+
+
+def _expected_world1(params, meta: dict) -> ExpectedExchange:
+    """The single-device exchange: ``allreduce_gradients`` skips the
+    fusion planner at ``axis_size == 1`` and maps the collective over the
+    leaves -- one identity psum per leaf, at the codec's wire dtype
+    (compress/decompress still wrap the size-1 psum).  ZeRO / microbatch /
+    EF configurations never hit this path in practice; at world=1 their
+    degenerate shapes are not worth modeling."""
+    if meta.get("zero_stage") or int(meta.get("microbatches", 1)) > 1:
+        return _unsupported(("world=1 zero/microbatch step: unmodeled "
+                             "degenerate exchange",))
+    optimizer = meta.get("optimizer")
+    exchange = getattr(getattr(optimizer, "update", None),
+                       "_hvd_exchange", None)
+    if exchange is None:
+        return ExpectedExchange(ops=[], plan_rows=[], notes=(
+            "bare optimizer at world=1: no gradient exchange",))
+    comp = parse_compression(exchange["compression"])
+    if is_error_feedback(comp) or is_fp8(comp):
+        return _unsupported((f"world=1 {comp.__name__} exchange: unmodeled "
+                             "degenerate codec path",))
+    from ..controller.fusion import exchange_chunk_bytes
+    from ..core.state import global_state
+    st = global_state()
+    if (st.config and st.config.hierarchical_allreduce) \
+            or exchange_chunk_bytes() > 0:
+        return _unsupported(("world=1 chunked/hierarchical exchange: "
+                             "unmodeled degenerate decomposition",))
+    leaves = jax.tree.leaves(params)
+    ops = [ExpectedOp("psum", _wire_dtype(comp, leaf.dtype),
+                      int(leaf.size),
+                      f"leaf{i}({jnp.dtype(leaf.dtype)})")
+           for i, leaf in enumerate(leaves)]
+    rows = [{"bucket": 0, "dtype": "per-leaf", "leaves": len(leaves),
+             "elements": sum(int(l.size) for l in leaves),
+             "kind": "leafwise-world1"}]
+    return ExpectedExchange(ops=ops, plan_rows=rows, notes=(
+        "world=1: leaf-wise identity psums (planner bypassed)",))
+
+
+def meta_from_step(step) -> Optional[dict]:
+    """The builder metadata riding an ``_InstrumentedStep`` wrapper (None
+    for a bare jitted step -- pass ``meta=`` to ``audit_step`` then)."""
+    meta = getattr(step, "_meta", None)
+    return dict(meta) if isinstance(meta, dict) else None
+
+
+def expected_exchange(params, meta: dict) -> ExpectedExchange:
+    """Derive the collective contract for a step built with ``meta``."""
+    from ..controller.fusion import exchange_chunk_bytes, explain_plan
+    from ..core.state import global_state
+    from ..optim import distributed as _dist
+    from ..optim import zero as _zero
+
+    world = int(meta.get("world", 1))
+    if world <= 1:
+        return _expected_world1(params, meta)
+    leaves = jax.tree.leaves(params)
+    if not leaves:
+        return ExpectedExchange(ops=[], plan_rows=[])
+
+    if meta.get("zero_stage"):
+        return _expected_zero(leaves, meta, world)
+
+    optimizer = meta.get("optimizer")
+    exchange = getattr(getattr(optimizer, "update", None),
+                       "_hvd_exchange", None)
+    k_micro = int(meta.get("microbatches", 1))
+    if k_micro > 1:
+        # Mirror _microbatch_unwrap: the wrapped exchange dict moves into
+        # the microbatch pipe (or EF-once), the wrap's own allreduce is
+        # never traced.
+        return _expected_microbatch(leaves, exchange, k_micro, world)
+    if exchange is None:
+        return ExpectedExchange(ops=[], plan_rows=[], notes=(
+            "bare optimizer: no gradient exchange",))
+
+    comp = parse_compression(exchange["compression"])
+    notes = []
+    if exchange.get("process_set") is not None:
+        notes.append("process-set reduction")
+    from ..collectives.reduce_op import Adasum
+    if exchange.get("op") is Adasum:
+        notes.append("Adasum exchange")
+    if is_fp8(comp):
+        notes.append("fp8 exchange")
+    st = global_state()
+    if (st.config and st.config.hierarchical_allreduce
+            and not is_error_feedback(comp)):
+        notes.append("hierarchical allreduce")
+    if exchange_chunk_bytes() > 0 and not is_error_feedback(comp):
+        notes.append("chunked exchange")
+    if notes:
+        return _unsupported(f"unmodeled exchange path: {n}" for n in notes)
+
+    thr = exchange["fusion_threshold"]
+    if is_error_feedback(comp):
+        rows = explain_plan(params, threshold_bytes=_dist._ef_threshold(thr),
+                            compression=comp, register=False)
+        return ExpectedExchange(ops=_ef_ops(rows, comp), plan_rows=rows)
+    rows = explain_plan(params, threshold_bytes=thr, compression=comp,
+                        register=False)
+    ops = [ExpectedOp("psum", _wire_dtype(comp, r["dtype"]),
+                      r["elements"],
+                      f"bucket{r['bucket']}({r['dtype']})/allreduce")
+           for r in rows]
+    return ExpectedExchange(ops=ops, plan_rows=rows)
+
+
+def _ef_ops(rows: List[dict], comp) -> List[ExpectedOp]:
+    """The two-leg EF exchange per floating bucket (ef_exchange)."""
+    ops = []
+    for r in rows:
+        tag = f"bucket{r['bucket']}({r['dtype']})"
+        if not jnp.issubdtype(jnp.dtype(r["dtype"]), jnp.floating):
+            ops.append(ExpectedOp("psum", r["dtype"], r["elements"],
+                                  f"{tag}/allreduce"))
+            continue
+        size = r["elements"]
+        if is_powersgd(comp):
+            pw, qw = powersgd_factor_widths(size, comp.rank)
+            ops.append(ExpectedOp("psum", "float32", pw, f"{tag}/psum-P"))
+            ops.append(ExpectedOp("psum", "float32", qw, f"{tag}/psum-Q"))
+        else:
+            k = min(topk_count(size, comp.fraction), size)
+            ops.append(ExpectedOp("all_gather", "float32", k,
+                                  f"{tag}/gather-values"))
+            ops.append(ExpectedOp("all_gather", "int32", k,
+                                  f"{tag}/gather-indices"))
+    return ops
+
+
+def _expected_microbatch(leaves, exchange, k: int, world: int
+                         ) -> ExpectedExchange:
+    """The backward-overlap pipe: k reduce-scatters + 1 allgather per
+    reverse-planned bucket (or the EF-once path for powersgd/topk)."""
+    from ..controller.fusion import explain_plan, plan_buckets
+    from ..optim import distributed as _dist
+
+    if exchange is None:
+        return ExpectedExchange(ops=[], plan_rows=[], notes=(
+            "bare optimizer: local microbatch accumulation only",))
+    comp = parse_compression(exchange["compression"])
+    if is_error_feedback(comp):
+        # EF composes as ONE residual-fed exchange per step over the
+        # NON-reversed ef plan (_build_microbatch_local_step).
+        params_like = leaves
+        rows = explain_plan(
+            params_like,
+            threshold_bytes=_dist._ef_threshold(
+                exchange["fusion_threshold"]),
+            compression=comp, register=False)
+        return ExpectedExchange(ops=_ef_ops(rows, comp), plan_rows=rows,
+                                notes=("EF-once-per-step microbatch pipe",))
+
+    spec = plan_buckets(leaves, exchange["fusion_threshold"], reverse=True)
+    q = _ops.microbatch_pad_quantum(world)
+    ops, rows = [], []
+    for i, (dt, lspecs) in enumerate(spec.buffers):
+        size = sum(s.size for s in lspecs)
+        padded = size + (-size) % q
+        wire = _wire_dtype(comp, dt)
+        tag = f"bucket{i}({jnp.dtype(dt)})"
+        for j in range(k):
+            ops.append(ExpectedOp("reduce_scatter", wire, padded,
+                                  f"{tag}/scatter-mb{j}"))
+        ops.append(ExpectedOp("all_gather", wire, padded // world,
+                              f"{tag}/allgather"))
+        rows.append({"bucket": i, "dtype": str(jnp.dtype(dt)),
+                     "leaves": len(lspecs), "elements": size,
+                     "padded": padded, "wire_dtype": wire,
+                     "codec": comp.__name__, "kind": "microbatch-pipe"})
+    return ExpectedExchange(ops=ops, plan_rows=rows)
+
+
+def _expected_zero(leaves, meta: dict, world: int) -> ExpectedExchange:
+    """ZeRO-1 arena exchange: reduce-scatter + compressed allgather."""
+    from ..optim import zero as _zero
+
+    comp = meta.get("zero_compression")
+    comp = parse_compression(comp) if comp else Compression.none
+    if is_error_feedback(comp) or is_fp8(comp):
+        return _unsupported(
+            (f"unmodeled zero allgather codec: {comp.__name__}",))
+    spec = _zero.plan_arena(leaves, world)
+    use_rs = _zero._use_reducescatter()
+    ops, rows = [], []
+    for i, buf in enumerate(spec.buffers):
+        if buf.size < 1:
+            continue
+        dt = str(jnp.dtype(buf.dtype))
+        tag = f"arena{i}({dt})"
+        if use_rs:
+            ops.append(ExpectedOp("reduce_scatter", dt, buf.padded,
+                                  f"{tag}/reduce-scatter"))
+        else:
+            ops.append(ExpectedOp("psum", dt, buf.padded,
+                                  f"{tag}/allreduce"))
+        ops.append(ExpectedOp("all_gather", _wire_dtype(comp, buf.dtype),
+                              buf.shard, f"{tag}/allgather"))
+        rows.append({"bucket": i, "dtype": dt, "leaves": len(buf.leaves),
+                     "elements": buf.size, "padded": buf.padded,
+                     "shard": buf.shard, "codec": comp.__name__,
+                     "kind": "zero-arena"})
+    return ExpectedExchange(ops=ops, plan_rows=rows)

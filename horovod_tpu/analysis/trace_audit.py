@@ -1,0 +1,313 @@
+"""Layer-1 static step auditor: trace, walk, cross-check -- never execute.
+
+``audit_step`` traces a training step with ``jax.make_jaxpr`` (no device
+execution, no donation side effects), extracts its collective graph with
+:mod:`.jaxpr_walk`, derives the planner contract with :mod:`.stepmodel`,
+and reports :class:`~horovod_tpu.analysis.findings.Finding` rows for:
+
+- ``audit-plan-missing`` (error): a planned collective leg the trace
+  never emits -- the exchange silently dropped a bucket;
+- ``audit-plan-unaccounted`` (error): an emitted collective no plan row
+  (nor the scalar loss/metric allowance) accounts for -- untracked wire
+  traffic, the static form of the reference's mismatch stall;
+- ``audit-desync-branch`` (error): ``cond``/``while`` control flow whose
+  predicate is data-dependent on ``axis_index`` guarding a collective --
+  ranks can disagree on whether the collective runs;
+- ``audit-donation`` (error): a donated input leaf whose aval matches no
+  output, so its buffer is freed with the caller still holding the
+  array;
+- ``audit-fence`` (error): a TPU-backed mesh whose eager fence policy
+  degrades to CPU-style barrier+block, or a barrier-signature collective
+  (scalar int32 psum) traced into a TPU step body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import jaxpr_walk as _walk
+from .findings import ERROR, WARNING, Finding
+from .stepmodel import ExpectedExchange, expected_exchange, meta_from_step
+
+# Scalar reductions (loss mean, metric max/min, desync probes) ride beside
+# any exchange; they are matched after plan legs so a planned scalar leg
+# still claims its record first.
+_AUX_KINDS = frozenset({"psum", "pmax", "pmin"})
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """Outcome of one ``audit_step`` call."""
+    name: str
+    findings: List[Finding]
+    collectives: List[_walk.CollectiveRecord]
+    expected: Optional[ExpectedExchange]
+    summary: Dict[str, int]
+
+    def ok(self) -> bool:
+        return not any(f.severity == ERROR for f in self.findings)
+
+    def render(self) -> str:
+        s = self.summary
+        head = (f"audit {self.name}: "
+                f"{s['planned_buckets']} planned bucket(s), "
+                f"{s['expected_ops']} planned collective leg(s), "
+                f"{s['emitted_ops']} emitted, {s['matched_ops']} matched, "
+                f"{s['aux_ops']} scalar-aux -- "
+                f"{'OK' if self.ok() else 'FINDINGS'}")
+        lines = [head]
+        lines += [f"  {f.render()}" for f in self.findings]
+        return "\n".join(lines)
+
+
+def _mesh_platform() -> Optional[str]:
+    from ..core.state import global_state
+    st = global_state()
+    if st.mesh is None:
+        return None
+    from ..collectives.eager import _mesh_platform as mp
+    return mp(st.mesh)
+
+
+def _fence_findings(name: str,
+                    records: Sequence[_walk.CollectiveRecord]
+                    ) -> List[Finding]:
+    from ..controller.fusion import _fence_policy
+    findings = []
+    policy = _fence_policy()
+    platform = _mesh_platform()
+    if platform == "tpu" and policy.startswith("barrier+block"):
+        findings.append(Finding(
+            rule="audit-fence", severity=ERROR, path=name,
+            ident="eager-policy",
+            message=f"TPU mesh resolves eager fence policy {policy!r}; "
+                    "TPU transports must be compiler-scheduled"))
+    if platform == "tpu":
+        for r in records:
+            if (r.kind == "psum" and r.elements == 1
+                    and r.dtype == "int32"):
+                findings.append(Finding(
+                    rule="audit-fence", severity=ERROR, path=name,
+                    ident=r.path,
+                    message="barrier-signature collective (scalar int32 "
+                            "psum) traced into a TPU step body; XLA "
+                            "schedules TPU collectives -- CPU-style "
+                            "barriers only serialize"))
+    return findings
+
+
+def _match_plan(name: str, expected: ExpectedExchange,
+                records: Sequence[_walk.CollectiveRecord],
+                stats_allowance: Counter) -> Tuple[List[Finding],
+                                                   Dict[str, int]]:
+    want = Counter(op.sig() for op in expected.ops)
+    labels: Dict[Tuple[str, str, int], List[str]] = {}
+    for op in expected.ops:
+        labels.setdefault(op.sig(), []).append(op.label)
+    matched = aux = stats = 0
+    unaccounted: List[_walk.CollectiveRecord] = []
+    for r in records:
+        sig = r.sig()
+        if want.get(sig, 0) > 0:
+            want[sig] -= 1
+            matched += 1
+        elif stats_allowance.get(sig, 0) > 0:
+            stats_allowance[sig] -= 1
+            stats += 1
+        elif r.kind in _AUX_KINDS and r.elements == 1:
+            aux += 1
+        else:
+            unaccounted.append(r)
+
+    findings = []
+    for sig, n in want.items():
+        if n <= 0:
+            continue
+        for label in labels[sig][-n:]:
+            findings.append(Finding(
+                rule="audit-plan-missing", severity=ERROR, path=name,
+                ident=label,
+                message=f"planned collective leg never emitted: "
+                        f"{sig[0]} {sig[1]}[{sig[2]}] ({label})"))
+    for r in unaccounted:
+        findings.append(Finding(
+            rule="audit-plan-unaccounted", severity=ERROR, path=name,
+            ident=r.path,
+            message=f"emitted collective not in the plan: {r.kind} "
+                    f"{r.dtype}[{r.elements}] at {r.path}"))
+    stats_left = sum(stats_allowance.values())
+    counts = {"matched_ops": matched, "aux_ops": aux,
+              "stats_ops": stats, "stats_unused": stats_left,
+              "unaccounted_ops": len(unaccounted),
+              "missing_ops": sum(n for n in want.values() if n > 0)}
+    return findings, counts
+
+
+def audit_step(fn, *args,
+               meta: Optional[dict] = None,
+               donate_argnums: Optional[Sequence[int]] = None,
+               batch_stats: Any = None,
+               name: str = "step") -> AuditReport:
+    """Statically audit a training step against its exchange plan.
+
+    ``fn`` is the step as the builder returned it (the
+    ``_InstrumentedStep`` wrapper is unwrapped and its builder ``meta``
+    picked up automatically) or any jit/shard_map callable; ``args`` are
+    example arguments of the real shapes (traced, never executed, so
+    donation does not consume them).  ``meta`` overrides/provides the
+    builder metadata for plan matching (omit it to skip plan matching on
+    unknown callables).  ``donate_argnums`` enables the donation-safety
+    check; ``batch_stats`` declares a flax mutable-stats tree whose
+    per-leaf averaging psums are accounted to the stats exchange.
+    """
+    inner = getattr(fn, "_fn", fn)
+    if meta is None:
+        meta = meta_from_step(fn)
+    closed = jax.make_jaxpr(inner)(*args)
+
+    records = _walk.collect_collectives(closed)
+    findings: List[Finding] = []
+    summary: Dict[str, int] = {
+        "emitted_ops": len(records), "planned_buckets": 0,
+        "expected_ops": 0, "matched_ops": 0, "aux_ops": 0,
+        "stats_ops": 0, "unaccounted_ops": 0, "missing_ops": 0,
+    }
+
+    expected = None
+    if meta is not None:
+        expected = expected_exchange(args[0], meta)
+        for note in expected.notes:
+            findings.append(Finding(
+                rule="audit-plan-unsupported" if not expected.supported
+                else "audit-plan-note", severity=WARNING, path=name,
+                ident="model", message=note))
+        if expected.supported:
+            stats_allow: Counter = Counter()
+            if batch_stats is not None:
+                for leaf in jax.tree.leaves(batch_stats):
+                    if jnp.issubdtype(leaf.dtype, jnp.floating):
+                        stats_allow[("psum", str(jnp.dtype(leaf.dtype)),
+                                     int(leaf.size))] += 1
+            plan_findings, counts = _match_plan(name, expected, records,
+                                                stats_allow)
+            findings += plan_findings
+            summary.update(counts)
+            summary["planned_buckets"] = len(expected.plan_rows)
+            summary["expected_ops"] = len(expected.ops)
+
+    for d in _walk.find_rank_dependent_branches(closed):
+        findings.append(Finding(
+            rule="audit-desync-branch", severity=ERROR, path=name,
+            ident=d.path,
+            message=f"rank-dependent {d.primitive} predicate guards "
+                    f"collective(s) {', '.join(d.collectives)}: ranks can "
+                    "diverge on whether the collective executes (desync "
+                    "stall)"))
+
+    if donate_argnums:
+        for rec in _walk.check_donation(closed, args, donate_argnums):
+            findings.append(Finding(
+                rule="audit-donation", severity=ERROR, path=name,
+                ident=f"arg{rec.argnum}.leaf{rec.leaf_index}",
+                message=f"donated leaf {rec.dtype}{list(rec.shape)} of "
+                        f"argument {rec.argnum} matches no output aval: "
+                        "its buffer is freed while the caller still holds "
+                        "the array (read-after-donate)"))
+
+    findings += _fence_findings(name, records)
+    summary["desync"] = sum(1 for f in findings
+                            if f.rule == "audit-desync-branch")
+    summary["donation"] = sum(1 for f in findings
+                              if f.rule == "audit-donation")
+    return AuditReport(name=name, findings=findings,
+                       collectives=records, expected=expected,
+                       summary=summary)
+
+
+# -- the four reference configurations --------------------------------------
+
+STANDARD_CONFIGS = ("plain", "zero1", "powersgd_ef", "microbatch2")
+
+# Threshold chosen so the tiny parameter tree below splits into TWO f32
+# buckets (256 + 192 elements), exercising multi-bucket matching.
+_TINY_THRESHOLD = 1024
+
+
+def _tiny_params():
+    a = jnp.linspace(-1.0, 1.0, 256, dtype=jnp.float32).reshape(16, 16)
+    b = jnp.linspace(0.5, 1.5, 128, dtype=jnp.float32)
+    c = jnp.linspace(-0.5, 0.5, 64, dtype=jnp.float32)
+    return {"a": a, "b": b, "c": c}
+
+
+def _tiny_loss(params, batch):
+    # Per-example-mean loss touching every leaf (nonzero grads all over).
+    x = batch
+    s = (jnp.sum(params["a"] ** 2) + jnp.sum(params["b"] ** 2)
+         + jnp.sum(params["c"] ** 2))
+    return jnp.mean(x) * s
+
+
+def build_standard_config(config: str):
+    """Build ``(step, args, donate_argnums, name)`` for one of the four
+    reference configurations (requires an initialized mesh)."""
+    import optax
+
+    from .. import training as _training
+    from ..collectives.compression import Compression
+    from ..core import basics as _basics
+    from ..optim import distributed as _dist
+    from ..optim import zero as _zero
+
+    mesh = _basics.mesh()
+    world = int(mesh.devices.size)
+    params = _tiny_params()
+    batch = jnp.ones((world * 2, 4), jnp.float32)
+
+    if config == "plain":
+        opt = _dist.DistributedOptimizer(
+            optax.sgd(0.01), compression=Compression.fp16,
+            fusion_threshold=_TINY_THRESHOLD)
+        step = _training.make_train_step(_tiny_loss, opt, mesh=mesh)
+        opt_state = opt.init(params)
+    elif config == "zero1":
+        opt = optax.sgd(0.01)
+        step = _training.make_train_step(_tiny_loss, opt, mesh=mesh,
+                                         zero_stage=1)
+        opt_state = _zero.zero_init(opt, params, mesh=mesh)
+    elif config == "powersgd_ef":
+        opt = _dist.DistributedOptimizer(
+            optax.sgd(0.01), compression="powersgd:2",
+            fusion_threshold=_TINY_THRESHOLD)
+        step = _training.make_train_step(_tiny_loss, opt, mesh=mesh)
+        opt_state = opt.init(params)
+    elif config == "microbatch2":
+        opt = _dist.DistributedOptimizer(
+            optax.sgd(0.01), compression=Compression.fp16,
+            fusion_threshold=_TINY_THRESHOLD)
+        step = _training.make_train_step(_tiny_loss, opt, mesh=mesh,
+                                         microbatches=2)
+        opt_state = opt.init(params)
+    else:
+        raise ValueError(f"unknown standard config {config!r}; "
+                         f"pick from {STANDARD_CONFIGS}")
+    # donate_argnums mirrors make_train_step's own (0, 1) donation.
+    return step, (params, opt_state, batch), (0, 1), f"step:{config}"
+
+
+def audit_standard_configs(configs: Optional[Sequence[str]] = None
+                           ) -> Dict[str, AuditReport]:
+    """Audit the reference configurations (plain DP, ZeRO-1, powersgd+EF,
+    microbatches=2) against their plans.  Requires ``horovod_tpu.init()``
+    to have built a mesh."""
+    reports = {}
+    for config in (configs or STANDARD_CONFIGS):
+        step, args, donate, name = build_standard_config(config)
+        reports[config] = audit_step(step, *args, donate_argnums=donate,
+                                     name=name)
+    return reports

@@ -22,6 +22,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from ..collectives import ops as _ops
 from .mesh import EP_AXIS
 
 
@@ -78,14 +79,13 @@ def moe_ffn(x, router_kernel, w_up, w_down, *, capacity_factor: float = 1.25,
     slots = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     # all_to_all: split the expert dim across ranks, concat token slots ->
     # (E_l, ep * C, d): every slot destined for my local experts.
-    slots = jax.lax.all_to_all(slots, axis, split_axis=0, concat_axis=1,
-                               tiled=True)
+    slots = _ops.alltoall(slots, axes=axis, split_axis=0, concat_axis=1)
     h = jnp.einsum("ecd,edf->ecf", slots.astype(x.dtype), w_up)
     h = activation(h)
     out = jnp.einsum("ecf,efd->ecd", h, w_down)
     # Route results back: split slots, concat experts -> (E, C, d).
-    out = jax.lax.all_to_all(out.astype(jnp.float32), axis, split_axis=1,
-                             concat_axis=0, tiled=True)
+    out = _ops.alltoall(out.astype(jnp.float32), axes=axis, split_axis=1,
+                        concat_axis=0)
     y = jnp.einsum("tec,ecd->td", combine, out)
     return y.astype(x.dtype), _load_balance_loss(probs, dispatch)
 

@@ -22,6 +22,8 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from ..collectives import ops as _ops
+from ..collectives.reduce_op import Sum
 from .mesh import PP_AXIS
 
 
@@ -76,7 +78,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         banked = jax.lax.dynamic_update_index_in_dim(
             outputs, y, jnp.maximum(out_idx, 0), axis=0)
         outputs = jnp.where(out_idx >= 0, banked, outputs)
-        incoming = jax.lax.ppermute(y, axis, perm)
+        incoming = _ops.ppermute(y, perm, axes=axis)
         return (incoming, outputs), ()
 
     outputs0 = jnp.zeros((m,) + microbatches.shape[1:],
@@ -85,7 +87,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         tick, (zero_mb, outputs0), jnp.arange(ticks))
     # Only the last rank's bank is real; broadcast it over the pp axis.
     outputs = jnp.where(my == size - 1, outputs, jnp.zeros_like(outputs))
-    return jax.lax.psum(outputs, axis)
+    return _ops.allreduce(outputs, Sum, axes=axis)
 
 
 def split_microbatches(batch: jnp.ndarray, n: int) -> jnp.ndarray:

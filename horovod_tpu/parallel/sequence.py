@@ -29,6 +29,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..collectives import ops as _ops
 from .mesh import SP_AXIS
 
 _NEG_INF = -1e30
@@ -103,10 +104,10 @@ def ring_attention(q, k, v, *, causal: bool = False,
 
     def step(carry, s):
         kb, vb, kseg_b, state = carry
-        kb = jax.lax.ppermute(kb, axis, perm)
-        vb = jax.lax.ppermute(vb, axis, perm)
+        kb = _ops.ppermute(kb, perm, axes=axis)
+        vb = _ops.ppermute(vb, perm, axes=axis)
         if has_seg:
-            kseg_b = jax.lax.ppermute(kseg_b, axis, perm)
+            kseg_b = _ops.ppermute(kseg_b, perm, axes=axis)
         state = merge_block(state, kb, vb, kseg_b, (my - s) % sp)
         return (kb, vb, kseg_b, state), ()
 
@@ -150,14 +151,13 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
         raise ValueError(f"heads {q.shape[1]} not divisible by sp={sp}")
 
     # (b, h, t_l, d): split heads (axis 1) across ranks, gather seq (2).
-    to_seq = partial(jax.lax.all_to_all, axis_name=axis, split_axis=1,
-                     concat_axis=2, tiled=True)
-    to_heads = partial(jax.lax.all_to_all, axis_name=axis, split_axis=2,
-                       concat_axis=1, tiled=True)
+    to_seq = partial(_ops.alltoall, axes=axis, split_axis=1, concat_axis=2)
+    to_heads = partial(_ops.alltoall, axes=axis, split_axis=2,
+                       concat_axis=1)
     kwargs = {}
     if segment_ids is not None:
-        kwargs["segment_ids"] = jax.lax.all_gather(
-            segment_ids, axis, axis=1, tiled=True)
+        kwargs["segment_ids"] = _ops.allgather(segment_ids, axes=axis,
+                                               axis=1, tiled=True)
     o = attn_fn(to_seq(q), to_seq(k), to_seq(v), causal=causal,
                 scale=scale, **kwargs)
     return to_heads(o)

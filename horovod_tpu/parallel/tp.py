@@ -30,6 +30,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..collectives import ops as _ops
+from ..collectives.reduce_op import Sum
 from .mesh import TP_AXIS
 
 
@@ -56,7 +58,7 @@ def row_parallel(x, kernel, bias=None, *, axis: str = TP_AXIS):
     (d_in / tp, d_out).  Bias is added *after* the psum (it is replicated;
     adding per-rank would multiply it by tp).
     """
-    y = jax.lax.psum(x @ kernel, axis)
+    y = _ops.allreduce(x @ kernel, Sum, axes=axis)
     if bias is not None:
         y = y + bias
     return y

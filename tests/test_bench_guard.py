@@ -782,3 +782,106 @@ def test_bench_chaos_mode_flags(monkeypatch):
     monkeypatch.delenv("BENCH_CHAOS_SPEC")
     b = importlib.reload(bench)
     assert not b.CHAOS_BENCH
+
+
+# -- static-audit block ------------------------------------------------------
+# PR 8: bench.py re-traces the benchmarked step through
+# horovod_tpu.analysis.audit_step and records the plan/emitted counts
+# under "audit" in each BENCH_*.json.  The validator only fires on
+# entries carrying the block (earlier committed rounds predate it): the
+# audit must have run clean -- ok, every planned leg matched, nothing
+# unaccounted, no error findings.
+
+
+def scan_audit_entries(bench_dir):
+    """Return [(path, why), ...] for bench entries whose static audit
+    failed or whose counts disagree with a clean match."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            audit = (entry.get("parsed") or {}).get("audit")
+            if not audit:
+                continue
+            if "error" in audit:
+                bad.append((path, f"audit crashed: {audit['error']}"))
+                continue
+            if not audit.get("ok"):
+                bad.append((path, "audit not ok: "
+                            + "; ".join(audit.get("findings", []))[:200]))
+                continue
+            if audit.get("matched_ops") != audit.get("expected_ops"):
+                bad.append((path, f"matched {audit.get('matched_ops')} != "
+                            f"expected {audit.get('expected_ops')}"))
+            if audit.get("unaccounted_ops") or audit.get("missing_ops"):
+                bad.append((path, "unaccounted/missing collectives: "
+                            f"{audit.get('unaccounted_ops')}/"
+                            f"{audit.get('missing_ops')}"))
+            errs = [f for f in audit.get("findings", [])
+                    if " error " in f]
+            if errs:
+                bad.append((path, f"error findings survived: {errs}"))
+    return bad
+
+
+def test_committed_audit_entries_ran_clean():
+    assert scan_audit_entries(REPO) == []
+
+
+def test_some_committed_round_carries_the_audit_block():
+    """Acceptance gate: at least one committed bench round proves the
+    benchmarked step's exchange matched its plan."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            audit = (entry.get("parsed") or {}).get("audit") or {}
+            if audit.get("ok"):
+                found.append((path, audit))
+    assert found, "no committed bench round carries a clean audit block"
+    for _, audit in found:
+        assert audit["matched_ops"] == audit["expected_ops"] > 0
+        assert audit["emitted_ops"] >= audit["matched_ops"]
+
+
+def _write_audited(tmp_path, name, audit):
+    parsed = {"metric": "resnet50_images_per_sec_per_chip", "value": 2500.0,
+              "unit": "images/s/chip", "vs_baseline": None,
+              "config": "tinycnn_batch256",
+              "baseline_config": "batch256_s2d_bf16", "audit": audit}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def test_audit_validator_accepts_clean_block(tmp_path):
+    _write_audited(tmp_path, "BENCH_r70.json", {
+        "emitted_ops": 18, "planned_buckets": 1, "expected_ops": 11,
+        "matched_ops": 11, "aux_ops": 1, "stats_ops": 6,
+        "unaccounted_ops": 0, "missing_ops": 0, "ok": True,
+        "findings": ["audit-plan-note warning bench:step [model] world=1"]})
+    assert scan_audit_entries(str(tmp_path)) == []
+
+
+def test_audit_validator_trips_on_dirty_blocks(tmp_path):
+    _write_audited(tmp_path, "BENCH_r71.json", {
+        "emitted_ops": 3, "expected_ops": 2, "matched_ops": 1,
+        "unaccounted_ops": 1, "missing_ops": 1, "ok": False,
+        "findings": ["audit-plan-missing error bench:step [bucket1] ..."]})
+    _write_audited(tmp_path, "BENCH_r72.json",
+                   {"error": "TypeError: boom"})
+    _write_audited(tmp_path, "BENCH_r73.json", {
+        "emitted_ops": 3, "expected_ops": 2, "matched_ops": 2,
+        "unaccounted_ops": 1, "missing_ops": 0, "ok": True,
+        "findings": []})
+    why = dict(scan_audit_entries(str(tmp_path)))
+    assert "audit not ok" in why[str(tmp_path / "BENCH_r71.json")]
+    assert "audit crashed" in why[str(tmp_path / "BENCH_r72.json")]
+    assert "unaccounted/missing" in why[str(tmp_path / "BENCH_r73.json")]

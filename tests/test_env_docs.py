@@ -1,59 +1,36 @@
-"""Static check: every ``HOROVOD_*`` environment variable the library
-reads must be documented in ``docs/api.md`` (PR 5 satellite).
+"""Env-var registry contract, now served by the analysis plane.
 
-The scan is grep-based over ``horovod_tpu/``: any ``_env(...)`` /
-``_env_bool(...)`` / ``_env_int(...)`` / ``_env_float(...)`` call site
-and any literal ``os.environ`` access of a ``HOROVOD_``/``HVD_TPU_``
-name contributes a variable; each must appear (with its ``HOROVOD_``
-spelling) somewhere in docs/api.md.  An env knob nobody can discover is
-a support burden, and this test makes adding one without a doc row a
-loud failure instead of a review nit.
+The grep that used to live here moved into
+``horovod_tpu.analysis.lints.envreg`` (the ``lint-undocumented-env``
+rule), which the CLI gate also runs; these tests assert the rule passes
+on the real tree AND still catches an injected undocumented env read, so
+the migration cannot have neutered the check.
 """
 
-import glob
 import os
-import re
+
+from horovod_tpu.analysis.lints import read_env_vars
+from horovod_tpu.analysis.lints.base import LintContext
+from horovod_tpu.analysis.lints.envreg import EnvRegistryRule
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_ENV_CALL = re.compile(
-    r'_env(?:_bool|_int|_float)?\(\s*"([A-Z][A-Z0-9_]*)"')
-# Literal os.environ reads of a fully-prefixed name.  Writes (launcher
-# code exporting identity to children) count too: the variable is part
-# of the public surface either way.
-_ENV_LITERAL = re.compile(
-    r'(?:os\.environ(?:\.get)?[\[(]\s*|getenv\(\s*)"'
-    r'(?:HOROVOD_|HVD_TPU_)([A-Z][A-Z0-9_]*)"')
 
-
-def read_env_vars(pkg_dir):
-    """Return {canonical_name: [file, ...]} for every HOROVOD_* env var
-    read in the package (canonical = without prefix)."""
-    hits = {}
-    for path in sorted(glob.glob(os.path.join(pkg_dir, "**", "*.py"),
-                                 recursive=True)):
-        src = open(path).read()
-        names = set(_ENV_CALL.findall(src)) | set(_ENV_LITERAL.findall(src))
-        for name in names:
-            hits.setdefault(name, []).append(os.path.relpath(path, REPO))
-    return hits
+def _run_rule(pkg_dir=None, repo_root=None):
+    ctx = LintContext(pkg_dir=pkg_dir or os.path.join(REPO, "horovod_tpu"),
+                      repo_root=repo_root or REPO)
+    return list(EnvRegistryRule().run(ctx))
 
 
 def test_every_env_read_is_documented_in_api_md():
-    doc = open(os.path.join(REPO, "docs", "api.md")).read()
-    hits = read_env_vars(os.path.join(REPO, "horovod_tpu"))
-    assert hits, "scanner found no env reads -- the regex rotted"
-    undocumented = {name: files for name, files in sorted(hits.items())
-                    if "HOROVOD_" + name not in doc}
-    assert not undocumented, (
-        "HOROVOD_* env vars read in horovod_tpu/ but absent from "
-        f"docs/api.md: {undocumented}")
+    findings = _run_rule()
+    assert not findings, "\n".join(f.render() for f in findings)
 
 
 def test_pr5_compression_vars_are_read_and_documented():
     """The PR 5 knobs exist on both sides of the contract."""
     doc = open(os.path.join(REPO, "docs", "api.md")).read()
-    hits = read_env_vars(os.path.join(REPO, "horovod_tpu"))
+    hits = read_env_vars(os.path.join(REPO, "horovod_tpu"), REPO)
     for name in ("COMPRESSION", "EF_RESIDUAL", "AUTOTUNE_CODEC"):
         assert name in hits, f"{name} is no longer read anywhere"
         assert "HOROVOD_" + name in doc
@@ -66,5 +43,22 @@ def test_scanner_catches_both_read_styles(tmp_path):
         'x = _env_int("SOME_KNOB", 3)\n'
         'y = os.environ.get("HOROVOD_OTHER_KNOB")\n'
         'z = os.environ["HVD_TPU_THIRD_KNOB"]\n')
-    hits = read_env_vars(str(pkg))
+    hits = read_env_vars(str(pkg), str(tmp_path))
     assert set(hits) == {"SOME_KNOB", "OTHER_KNOB", "THIRD_KNOB"}
+
+
+def test_rule_flags_injected_undocumented_read(tmp_path):
+    """An env read with no docs row must surface as lint-undocumented-env
+    with the variable name as the finding ident."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "knobs.py").write_text(
+        'a = _env_bool("DOCUMENTED_KNOB", False)\n'
+        'b = os.environ.get("HOROVOD_SNEAKY_KNOB")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "api.md").write_text("| HOROVOD_DOCUMENTED_KNOB | ... |\n")
+    findings = _run_rule(pkg_dir=str(pkg), repo_root=str(tmp_path))
+    assert [f.ident for f in findings] == ["SNEAKY_KNOB"]
+    assert findings[0].rule == "lint-undocumented-env"
+    assert findings[0].path == "pkg/knobs.py"
