@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -145,22 +146,37 @@ def _run(kind: str, x, name: Optional[str], ps, per_rank_fn, op_label: str,
                           out_specs=P(HVD_AXIS))
         return jax.jit(f)
 
+    from ..timeline import spans as _spans
+    rec = _spans.recorder()
+    tags = {"rank": rec.rank, "step": rec.step, "leg": kind}
     try:
+        t_neg = time.perf_counter()
         if timeline:
-            with timeline.range(name or kind, "NEGOTIATE_" + kind.upper()):
+            with timeline.range(name or kind, "NEGOTIATE_" + kind.upper(),
+                                args=tags):
                 fn = st.cache.get_or_build(key, build)
-            with timeline.range(name or kind, kind.upper()):
+            t_exec = time.perf_counter()
+            with timeline.range(name or kind, kind.upper(), args=tags):
                 out = fn(arr)
         else:
             fn = st.cache.get_or_build(key, build)
+            t_exec = time.perf_counter()
             out = fn(arr)
     except Exception as e:
         if publish_meta is not None:
             _publish_abort(e)
         raise
+    t_done = time.perf_counter()
+    rec.add("negotiate", t_exec - t_neg, leg=kind)
+    rec.add("exchange", t_done - t_exec, leg=kind)
     with _eager_stats_lock:
         _eager_stats["ops"] += 1
-    _eager_fence(mesh, out)
+    if timeline:
+        with timeline.range(name or kind, "FENCE", args=tags):
+            _eager_fence(mesh, out)
+    else:
+        _eager_fence(mesh, out)
+    rec.add("fence", time.perf_counter() - t_done, leg=kind)
     return out
 
 
@@ -712,12 +728,19 @@ def flush_deferred() -> None:
             ps = _ps.get_process_set(None)
             units = _plan_flush_units(pending, _deferred_fuse_enabled())
             _note_flush(units)
+            from ..timeline import spans as _spans
+            rec = _spans.recorder()
             with _join.flush(ps, len(units)):
                 err = None
-                for unit in units:
+                for i, unit in enumerate(units):
                     if err is None:
                         try:
-                            values = unit.dispatch()
+                            fuse_key = (f"fused@{unit.pos}" if unit.fused
+                                        else f"single@{unit.pos}")
+                            with rec.span("bucket", name="deferred_flush",
+                                          leg="deferred_flush",
+                                          bucket_id=i, fuse_key=fuse_key):
+                                values = unit.dispatch()
                         except BaseException as e:  # noqa: BLE001
                             err = e
                             values = {

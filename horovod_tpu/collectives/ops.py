@@ -307,6 +307,10 @@ def chunked_allreduce(x,
     # every chunk scatters evenly across the mesh.
     chunk_elems = max(1, int(chunk_bytes) // itemsize)
     chunk_elems += (-chunk_elems) % n
+    # Trace-time leg registration for straggler attribution (fires once
+    # per trace; RS(B)+AG(B) moves an equivalent-allreduce payload).
+    from ..timeline import spans as _spans
+    _spans.note_leg("chunked_rs_ag", nbytes=int(flat.size) * itemsize)
     pieces = []
     for off in range(0, flat.size, chunk_elems):
         piece = flat[off:off + chunk_elems]
@@ -747,6 +751,10 @@ def fp8_allreduce(x,
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
     rows = flat.reshape(n, -1)                     # row j -> rank j
+    # Trace-time leg registration: fp8 all_to_all + result allgather,
+    # one wire byte per e4m3 element in each direction.
+    from ..timeline import spans as _spans
+    _spans.note_leg("fp8_allreduce", nbytes=2 * int(flat.size))
     q, scales = fp8_quantize(rows, axis=0)         # per-destination scales
     recv = lax.all_to_all(q, a, split_axis=0, concat_axis=0, tiled=True)
     # scale matrix: S[src, dst]; my column is the scale each sender used
@@ -860,6 +868,9 @@ def powersgd_allreduce(x,
         if pad else acc
     mat = flat.reshape(m, c)
     r = max(1, min(int(rank), m, c))
+    # Trace-time leg registration: two f32 factor allreduces on the wire.
+    from ..timeline import spans as _spans
+    _spans.note_leg("powersgd_allreduce", nbytes=2 * r * (m + c) * 4)
 
     p = mat @ _powersgd_seed_matrix(c, r)          # [m, r]
     p = lax.psum(p, axes if len(axes) > 1 else axes[0]) / n
@@ -919,6 +930,9 @@ def topk_allreduce(x,
         acc = acc + residual.astype(jnp.float32).ravel()
     size = acc.size
     k = min(topk_count(size, fraction), size)
+    # Trace-time leg registration: (value f32, index int32) pairs gathered.
+    from ..timeline import spans as _spans
+    _spans.note_leg("topk_allreduce", nbytes=8 * k)
 
     _, idx = lax.top_k(jnp.abs(acc), k)            # int32 indices
     vals = jnp.take(acc, idx)

@@ -132,7 +132,8 @@ def init(
         if cfg.timeline:
             from ..timeline import Timeline
             st.timeline = Timeline(cfg.timeline,
-                                   mark_cycles=cfg.timeline_mark_cycles)
+                                   mark_cycles=cfg.timeline_mark_cycles,
+                                   rank=jax.process_index())
         if cfg.autotune:
             from ..autotune import Autotuner
             st.autotuner = Autotuner(cfg)
@@ -147,6 +148,20 @@ def init(
         elif cfg.metrics_port >= 0:
             logger.warning("HOROVOD_METRICS_PORT set but HOROVOD_METRICS=0; "
                            "not starting the metrics endpoint")
+        # Span layer: tag this process's spans with its rank and mirror
+        # them into the timeline when one is open; when metrics are on,
+        # arm the straggler monitor on the recorder's step boundary.
+        from ..timeline import spans as _spans
+        rec = _spans.recorder().configure(rank=jax.process_index(),
+                                          timeline=st.timeline)
+        if cfg.metrics_enabled:
+            from ..timeline.straggler import StragglerMonitor
+            st.straggler = StragglerMonitor(
+                world=jax.process_count(),
+                stall_check_time=cfg.stall_check_time)
+            rec.add_listener(st.straggler.observe)
+        if cfg.trace_sync:
+            _install_trace_plane(st, cfg, rec)
         from . import stall as _stall
         _stall.configure(cfg)
         # Deterministic fault injection (HOROVOD_CHAOS): installed once
@@ -163,6 +178,35 @@ def init(
             "horovod_tpu initialized: %d device(s), mesh axes %s, "
             "process %d/%d", int(st.mesh.devices.size), st.mesh.axis_names,
             jax.process_index(), jax.process_count())
+
+
+def _install_trace_plane(st, cfg: Config, rec) -> None:
+    """Arm the cross-rank trace plane (HOROVOD_TRACE_SYNC=1): NTP-style
+    clock offset against the rendezvous KV server + per-step summary
+    publication.  The KV endpoint comes from the elastic assignment URL
+    (``HVD_TPU_ELASTIC_ASSIGNMENT=http://...`` + the per-job secret);
+    without one this degrades to a warning, never an init failure."""
+    import os as _os
+    from ..elastic.notify import ASSIGNMENT_ENV
+    from ..run.secret import SECRET_ENV
+    url = _os.environ.get(ASSIGNMENT_ENV, "")
+    secret = _os.environ.get(SECRET_ENV)
+    if not url.startswith("http://") or not secret:
+        logger.warning(
+            "HOROVOD_TRACE_SYNC=1 but no HTTP KV rendezvous is "
+            "configured (%s/%s); skipping clock alignment",
+            ASSIGNMENT_ENV, SECRET_ENV)
+        return
+    try:
+        from ..run.http_kv import KVClient
+        from ..timeline.sync import TracePlane
+        kv = KVClient.from_url(url, secret, timeout_s=5.0)
+        st.trace_plane = TracePlane(
+            kv, rank=jax.process_index(), size=jax.process_count(),
+            publish_steps=cfg.trace_publish_steps, monitor=st.straggler)
+        rec.add_listener(st.trace_plane.on_summary)
+    except Exception as e:  # ConnectionError, auth, ... -- telemetry only
+        logger.warning("trace plane disabled: %s", e)
 
 
 _atexit_registered = False
@@ -307,7 +351,10 @@ def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
     with st.lock:
         if st.timeline is not None:
             st.timeline.close()
-        st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+        st.timeline = Timeline(file_path, mark_cycles=mark_cycles,
+                               rank=jax.process_index())
+        from ..timeline import spans as _spans
+        _spans.recorder().configure(timeline=st.timeline)
 
 
 def stop_timeline() -> None:
@@ -318,3 +365,5 @@ def stop_timeline() -> None:
         if st.timeline is not None:
             st.timeline.close()
             st.timeline = None
+            from ..timeline import spans as _spans
+            _spans.recorder().timeline = None

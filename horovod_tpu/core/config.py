@@ -182,6 +182,15 @@ class Config:
     metrics_enabled: bool = True
     metrics_port: int = -1
 
+    # Cross-rank trace plane (timeline/sync.py).  HOROVOD_TRACE_SYNC=1:
+    # at init() each rank estimates its clock offset to the rendezvous
+    # KV server (NTP-style ping over http_kv) and publishes a compact
+    # per-step span summary every HOROVOD_TRACE_PUBLISH_STEPS steps;
+    # rank 0 merges them and feeds the straggler monitor.  Requires a
+    # reachable KV server (elastic/launcher runs); no-op without one.
+    trace_sync: bool = False
+    trace_publish_steps: int = 10
+
     # Persistent XLA compilation cache directory (HOROVOD_COMPILE_CACHE /
     # HVD_TPU_COMPILE_CACHE).  Big-model compiles through the tunnelled
     # runtime take tens of minutes (BERT-Large: ~35 min); the cache pays
@@ -307,4 +316,6 @@ def load_config() -> Config:
         force_cpu=_env_bool("FORCE_CPU"),
         metrics_enabled=_env_bool("METRICS", True),
         metrics_port=_env_int("METRICS_PORT", -1),
+        trace_sync=_env_bool("TRACE_SYNC"),
+        trace_publish_steps=_env_int("TRACE_PUBLISH_STEPS", 10),
     )

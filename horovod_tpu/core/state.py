@@ -41,6 +41,12 @@ class GlobalState:
         # Prometheus /metrics endpoint (run/metrics_server.py), started by
         # init() when HOROVOD_METRICS_PORT >= 0.
         self.metrics_server = None
+        # Cross-rank trace plane (timeline/sync.py::TracePlane), armed by
+        # init() under HOROVOD_TRACE_SYNC=1 with a reachable KV server.
+        self.trace_plane = None
+        # Straggler monitor (timeline/straggler.py), armed whenever
+        # metrics are enabled; fed by the SpanRecorder step boundary.
+        self.straggler = None
         # True when this process called jax.distributed.initialize and owns
         # a shutdown obligation.
         self.owns_distributed: bool = False
@@ -58,6 +64,13 @@ class GlobalState:
         if self.metrics_server is not None:
             self.metrics_server.stop()
         self.metrics_server = None
+        self.trace_plane = None
+        self.straggler = None
+        try:
+            from ..timeline import spans as _spans
+            _spans.recorder().reset()
+        except ImportError:  # pragma: no cover - partial install
+            pass
         self.owns_distributed = False
         # Preemption machinery is keyed to the runtime lifecycle: stop
         # the GCE poll thread and forget the handler-installed latch so

@@ -26,6 +26,9 @@ Each fault clause is ``<kind>@step=<k>[,rank=<r>|rank=any][,secs=<t>]
 - ``hb_drop``: for ``secs`` seconds heartbeat writes are suppressed
   (``core/stall.py`` writers check :func:`heartbeat_drop_active`),
   exercising driver-side staleness handling.
+- ``slow``: the target rank's host thread sleeps ``secs`` at the step
+  boundary -- a deterministic straggler for the cross-rank trace plane
+  (``timeline/straggler.py``) to detect and attribute.
 
 ``rank=any`` picks a victim with the seeded RNG -- identical on every
 process because the choice depends only on (seed, fault index, size).
@@ -47,7 +50,7 @@ logger = logging.getLogger("horovod_tpu.elastic")
 _ENV = "HOROVOD_CHAOS"
 _ENV_ALT = "HVD_TPU_CHAOS"
 
-_KINDS = ("kill", "sigterm", "comm", "kv_blackout", "hb_drop")
+_KINDS = ("kill", "sigterm", "comm", "kv_blackout", "hb_drop", "slow")
 
 
 class ChaosSpecError(ValueError):
@@ -182,6 +185,15 @@ class ChaosInjector:
             _set_kv_blackout(f.secs)
         elif f.kind == "hb_drop":
             _set_hb_drop(f.secs)
+        elif f.kind == "slow":
+            # Deterministic straggler: stall THIS rank's host thread for
+            # secs at the step boundary.  The delay lands between
+            # dispatches, so the span layer books it as dispatch-gap
+            # time and the straggler monitor attributes it to this rank
+            # (exercised by examples/straggler_probe.py).
+            logger.warning("chaos: slowing rank %d by %.3fs at step %d",
+                           self.rank, f.secs, self.step)
+            time.sleep(max(0.0, f.secs))
 
     def on_step(self, step: Optional[int] = None) -> None:
         """Advance the chaos clock and fire any due faults.

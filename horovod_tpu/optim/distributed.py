@@ -313,8 +313,17 @@ def ef_exchange(grads, residuals, *, compression, op=Average,
             f"a different fusion threshold or codec?")
     buffers = pack(leaves, spec)
     feed = _ef_enabled()
+    # Trace-time leg registration for the straggler report (fires once
+    # per trace, exactly like _note_compression_ratio below).
+    from ..timeline import spans as _spans
     out_bufs, new_res = [], []
-    for buf, res, (dt, _ls) in zip(buffers, residuals, spec.buffers):
+    for i, (buf, res, (dt, _ls)) in enumerate(
+            zip(buffers, residuals, spec.buffers)):
+        _spans.note_leg(
+            "ef_exchange",
+            nbytes=wire_payload_bytes(compression, int(buf.size),
+                                      jnp.dtype(buf.dtype).itemsize),
+            bucket_id=i)
         if not jnp.issubdtype(buf.dtype, jnp.floating):
             out_bufs.append(_ops.allreduce(
                 buf, op, axes=axes, prescale_factor=prescale_factor,

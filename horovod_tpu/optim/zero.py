@@ -287,8 +287,15 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
     p_arenas = arena_pack(p_leaves, spec)
     idx = _ops.axis_index(axes)
     use_rs = _use_reducescatter()
+    # Trace-time leg registration (fires once per trace, like
+    # _note_compression_ratio): attributes the compiled step's exchange
+    # bytes to the ZeRO RS/AG legs for the cross-rank straggler report.
+    from ..timeline import spans as _spans
     g_shards, p_shards = [], []
-    for g, p, buf in zip(g_arenas, p_arenas, spec.buffers):
+    for i, (g, p, buf) in enumerate(zip(g_arenas, p_arenas, spec.buffers)):
+        _spans.note_leg("zero_rs" if use_rs else "zero_allreduce",
+                        nbytes=int(g.size) * jnp.dtype(g.dtype).itemsize,
+                        bucket_id=i)
         if use_rs:
             gs = _ops.reducescatter(g, Average, axes=axes)
         else:
@@ -306,8 +313,12 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
         from .distributed import _ef_enabled
         feed = _ef_enabled()
         full, new_res = [], []
-        for old, new, res, arena, buf in zip(
-                old_shards, p_shards, residuals, p_arenas, spec.buffers):
+        for i, (old, new, res, arena, buf) in enumerate(zip(
+                old_shards, p_shards, residuals, p_arenas, spec.buffers)):
+            _spans.note_leg(
+                "zero_ag",
+                nbytes=int(new.size) * jnp.dtype(new.dtype).itemsize,
+                bucket_id=i)
             if (not jnp.issubdtype(buf.dtype, jnp.floating)
                     or buf.shard < 1):
                 full.append(_ops.allgather(new, axes=axes))
@@ -326,8 +337,12 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
         return new_params, _ZeroEFState(
             tuple(r[None] for r in new_res),
             jax.tree.map(lambda v: v[None], inner))
-    full = [compressed_allgather(s, axes=axes, compression=comp)
-            for s in p_shards]
+    full = []
+    for i, s in enumerate(p_shards):
+        _spans.note_leg(
+            "zero_ag", nbytes=int(s.size) * jnp.dtype(s.dtype).itemsize,
+            bucket_id=i)
+        full.append(compressed_allgather(s, axes=axes, compression=comp))
     new_params = jax.tree.unflatten(treedef, arena_unpack(full, spec))
     return new_params, jax.tree.map(lambda v: v[None], inner)
 

@@ -92,6 +92,13 @@ class RendezvousServer:
                     return self._reply(503)
                 if not self._verify(b""):
                     return self._reply(403)
+                if self.path == "/time":
+                    # NTP-style clock reference for the trace plane
+                    # (timeline/sync.py): the instant the reply is built
+                    # is the server-clock sample; signed like every
+                    # other KV request.
+                    import time
+                    return self._reply(200, repr(time.time()).encode())
                 with lock:
                     val = store.get(self.path)
                 self._reply(200, val) if val is not None else self._reply(404)
@@ -228,3 +235,13 @@ class KVClient:
             code, _ = self._request("DELETE", f"/kv/{scope}/{key}")
             self._check(f"DELETE {scope}/{key}", code)
         self._retrying(_once, f"kv DELETE {scope}/{key}")
+
+    def server_time(self) -> float:
+        """The KV server's wall clock (seconds since the epoch), for
+        NTP-style offset estimation (``timeline/sync.py``).  Retried
+        like every other KV call; auth failures surface immediately."""
+        def _once() -> float:
+            code, body = self._request("GET", "/time")
+            self._check("GET /time", code)
+            return float(body)
+        return self._retrying(_once, "kv GET /time")

@@ -60,10 +60,16 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/healthz":
+                    # Liveness must answer WITHOUT a signature even when
+                    # HMAC auth is armed: kubelet/LB probes cannot sign,
+                    # and the reply ("ok") carries no telemetry.  The
+                    # data endpoints below stay protected.
+                    return self._reply(200, b"ok\n")
                 if not self._verify():
                     return self._reply(403)
                 from ..timeline import metrics as _metrics
-                path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 try:
                     if path in ("/", "/metrics"):
                         return self._reply(
@@ -73,8 +79,6 @@ class MetricsServer:
                         body = json.dumps(
                             _metrics.metrics_snapshot()).encode()
                         return self._reply(200, body, "application/json")
-                    if path == "/healthz":
-                        return self._reply(200, b"ok\n")
                 except Exception as e:  # a bad collector must not 404
                     return self._reply(
                         500, f"metrics render failed: {e}\n".encode())

@@ -30,7 +30,8 @@ class Timeline:
     """Append-only Chrome-trace event stream with a background writer."""
 
     def __init__(self, path: str, mark_cycles: bool = False,
-                 flush_interval: float = 1.0):
+                 flush_interval: float = 1.0, rank: Optional[int] = None,
+                 hostname: Optional[str] = None):
         self.path = path
         self.mark_cycles = mark_cycles
         self._events: Deque[dict] = deque()
@@ -41,6 +42,31 @@ class Timeline:
         self._close_lock = threading.Lock()
         self._closed = False
         self._t0 = time.perf_counter()
+        # Wall-clock anchor captured at the SAME instant as the
+        # perf_counter epoch: offline merge aligns files via
+        # wall_us = epoch_unix_us + ts, so n ranks' traces become
+        # mergeable without the live KV offset handshake.  rank falls
+        # back to the launcher-provided env identity (no jax import:
+        # the timeline must open before backends initialize).
+        self.epoch_unix_us = time.time() * 1e6
+        if rank is None:
+            for var in ("HVD_TPU_RANK", "HOROVOD_RANK"):
+                v = os.environ.get(var, "")
+                if v.lstrip("-").isdigit():
+                    rank = int(v)
+                    break
+        self.rank = int(rank) if rank is not None else 0
+        if hostname is None:
+            import socket
+            try:
+                hostname = socket.gethostname()
+            except OSError:
+                hostname = "unknown"
+        self.hostname = hostname
+        self._events.append({
+            "name": "clock_anchor", "ph": "M", "pid": 0,
+            "args": {"epoch_unix_us": self.epoch_unix_us,
+                     "rank": self.rank, "hostname": self.hostname}})
         self._file = open(path, "w")
         self._file.write("[\n")
         self._wrote_any = False
@@ -65,17 +91,42 @@ class Timeline:
                 "args": {"name": track}})
         return pid
 
-    def begin(self, tensor: str, phase: str) -> None:
+    def begin(self, tensor: str, phase: str,
+              args: Optional[dict] = None) -> None:
         with self._lock:
-            self._events.append({"name": phase, "ph": "B",
-                                 "pid": self._pid(tensor), "tid": 0,
-                                 "ts": self._us()})
+            ev = {"name": phase, "ph": "B",
+                  "pid": self._pid(tensor), "tid": 0,
+                  "ts": self._us()}
+            if args:
+                ev["args"] = args
+            self._events.append(ev)
 
-    def end(self, tensor: str, phase: str) -> None:
+    def end(self, tensor: str, phase: str,
+            args: Optional[dict] = None) -> None:
         with self._lock:
-            self._events.append({"name": phase, "ph": "E",
-                                 "pid": self._pid(tensor), "tid": 0,
-                                 "ts": self._us()})
+            ev = {"name": phase, "ph": "E",
+                  "pid": self._pid(tensor), "tid": 0,
+                  "ts": self._us()}
+            if args:
+                ev["args"] = args
+            self._events.append(ev)
+
+    def complete(self, tensor: str, phase: str, dur_s: float,
+                 args: Optional[dict] = None) -> None:
+        """Retroactive Chrome "X" complete event spanning the PAST
+        ``dur_s`` seconds and ending now -- for regions only measurable
+        after the fact (the inter-dispatch gap: its start is known only
+        once the next dispatch begins)."""
+        with self._lock:
+            ev = {"name": phase, "ph": "X",
+                  "pid": self._pid(tensor), "tid": 0,
+                  # Clamp to the trace epoch: a gap can predate open()
+                  # (the first window of a freshly attached timeline).
+                  "ts": max(0.0, self._us() - float(dur_s) * 1e6),
+                  "dur": float(dur_s) * 1e6}
+            if args:
+                ev["args"] = args
+            self._events.append(ev)
 
     def instant(self, name: str, track: str = "cycle") -> None:
         with self._lock:
@@ -114,8 +165,8 @@ class Timeline:
             self.instant("CYCLE")
 
     @contextlib.contextmanager
-    def range(self, tensor: str, phase: str):
-        self.begin(tensor, phase)
+    def range(self, tensor: str, phase: str, args: Optional[dict] = None):
+        self.begin(tensor, phase, args=args)
         try:
             yield
         finally:
@@ -209,7 +260,12 @@ class DispatchGapMonitor:
         if self._t0 is None:
             raise RuntimeError("end_window() without begin_window()")
         wall = time.perf_counter() - self._t0
-        gap = 1.0 - min(self._dispatched / wall, 1.0) if wall > 0 else 0.0
+        # Clamp dispatched time into [0, wall]: a clock stepping
+        # backwards mid-window (mocked clocks, NTP slews) must yield a
+        # fraction in [0, 1], never a negative gap or one above 1.
+        dispatched = max(self._dispatched, 0.0)
+        gap = 1.0 - min(dispatched / wall, 1.0) if wall > 0 else 0.0
+        gap = min(max(gap, 0.0), 1.0)
         self.windows.append(gap)
         self._t0 = None
         if self.timeline is not None:

@@ -1,0 +1,256 @@
+"""Cross-rank span layer: tagged timing spans + per-step summaries.
+
+Every host-side timing region in the exchange path funnels through the
+process-wide :class:`SpanRecorder`: eager collective dispatch and fence
+waits (``collectives/eager.py``), fused deferred-flush buckets, and the
+jitted step's dispatch / dispatch-gap (``training._InstrumentedStep``).
+Each span is tagged ``(rank, step, bucket_id, fuse_key, leg)`` and, when
+a :class:`~horovod_tpu.timeline.Timeline` is attached, mirrored into the
+Chrome-trace file so one rank's file already carries the attribution the
+cross-rank merge needs.
+
+In-jit exchange legs (``collectives/ops.py``, ``optim/zero.py``,
+``optim/distributed.py``) cannot be host-timed span-by-span -- XLA owns
+their schedule.  They register themselves at *trace time* via
+:func:`note_leg` instead (the same host-side-effect idiom as
+``optim/distributed._note_compression_ratio``: fires once per trace, so
+retraces refresh it and cached executions cost nothing).  The registered
+byte counts let the straggler report attribute a compiled step's
+exchange time across legs proportionally.
+
+Per step, the recorder folds its spans into a compact summary dict::
+
+    {"rank": r, "step": s, "t0_us": <unix epoch us at dispatch start>,
+     "wall_s": ..., "spans": {"dispatch": ..., "dispatch_gap": ...,
+     "exchange": ..., "fence": ..., "bucket": ...}, "legs": {...}}
+
+which feeds the :class:`~horovod_tpu.timeline.straggler.StragglerMonitor`
+locally and, under ``HOROVOD_TRACE_SYNC=1``, the KV trace plane
+(``timeline/sync.py``) for rank 0 to merge.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional
+
+#: Span kinds a step decomposes into.  "dispatch" is the jitted-step
+#: dispatch call; "dispatch_gap" the host time between consecutive
+#: dispatches (input pipeline, Python glue, injected host delays);
+#: "exchange" an eager collective execution; "fence" a blocking
+#: device->host wait; "bucket" one fused deferred-flush unit;
+#: "negotiate" trace+compile on an executable-cache miss.
+SPAN_KINDS = ("dispatch", "dispatch_gap", "exchange", "fence", "bucket",
+              "negotiate", "compute")
+
+#: Per-step summaries kept in the ring buffer.
+SUMMARY_RING = 64
+
+
+class SpanRecorder:
+    """Process-wide span sink; cheap enough to call per collective."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rank = 0
+        self.timeline = None  # Optional[Timeline]
+        self._step = 0
+        # step -> {"spans": {kind: secs}, "tags": [...]}  (ring)
+        self._acc: "OrderedDict[int, dict]" = OrderedDict()
+        self.summaries: "OrderedDict[int, dict]" = OrderedDict()
+        # trace-time leg registry: leg -> {"nbytes": n, "buckets": k}
+        self.legs: Dict[str, dict] = {}
+        self._listeners = []
+
+    # -- wiring -----------------------------------------------------------
+    def configure(self, rank: Optional[int] = None,
+                  timeline=None) -> "SpanRecorder":
+        with self._lock:
+            if rank is not None:
+                self.rank = int(rank)
+            if timeline is not None:
+                self.timeline = timeline
+        return self
+
+    def add_listener(self, fn) -> None:
+        """``fn(summary_dict)`` called after every step boundary.
+        Idempotent by identity (re-init must not double-feed)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    # -- step clock -------------------------------------------------------
+    def set_step(self, step: int) -> None:
+        self._step = int(step)
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def _bucket(self, step: int) -> dict:
+        acc = self._acc.get(step)
+        if acc is None:
+            acc = self._acc[step] = {"spans": {}, "legs": {}}
+            while len(self._acc) > SUMMARY_RING:
+                self._acc.popitem(last=False)
+        return acc
+
+    # -- span emission ----------------------------------------------------
+    def add(self, kind: str, dur_s: float, leg: Optional[str] = None,
+            bucket_id: Optional[int] = None,
+            fuse_key: Optional[str] = None, emit: bool = False) -> None:
+        """Record a completed span of ``dur_s`` seconds at the current
+        step (the non-contextmanager form, for callers that already
+        timed the region themselves).  ``emit=True`` mirrors it into the
+        attached timeline as a retroactive "X" event ending now -- used
+        for regions with no begin/end pair of their own (the dispatch
+        gap); callers whose region already has a timeline range must
+        leave it False or the merge would double-count."""
+        with self._lock:
+            acc = self._bucket(self._step)
+            acc["spans"][kind] = acc["spans"].get(kind, 0.0) + float(dur_s)
+            if leg:
+                lg = acc["legs"].setdefault(leg, {"secs": 0.0, "count": 0})
+                lg["secs"] += float(dur_s)
+                lg["count"] += 1
+        if emit:
+            tl = self.timeline
+            if tl is not None:
+                args = {"rank": self.rank, "step": self._step}
+                if leg is not None:
+                    args["leg"] = leg
+                if bucket_id is not None:
+                    args["bucket_id"] = int(bucket_id)
+                if fuse_key is not None:
+                    args["fuse_key"] = str(fuse_key)
+                try:
+                    tl.complete("spans", kind, dur_s, args=args)
+                except Exception:
+                    pass
+
+    @contextlib.contextmanager
+    def span(self, kind: str, name: str = "", leg: Optional[str] = None,
+             bucket_id: Optional[int] = None,
+             fuse_key: Optional[str] = None):
+        """Time a host region and tag it ``(rank, step, bucket_id,
+        fuse_key, leg)``.  Mirrors into the Chrome-trace timeline (one
+        ``spans`` track, args carry the tags) when one is attached."""
+        tl = self.timeline
+        args = None
+        if tl is not None:
+            args = {"rank": self.rank, "step": self._step}
+            if leg is not None:
+                args["leg"] = leg
+            if bucket_id is not None:
+                args["bucket_id"] = int(bucket_id)
+            if fuse_key is not None:
+                args["fuse_key"] = str(fuse_key)
+            tl.begin(name or "spans", kind, args=args)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            if tl is not None:
+                tl.end(name or "spans", kind)
+            self.add(kind, dur, leg=leg, bucket_id=bucket_id,
+                     fuse_key=fuse_key)
+
+    # -- trace-time leg registry ------------------------------------------
+    def note_leg(self, leg: str, nbytes: int = 0,
+                 bucket_id: Optional[int] = None,
+                 fuse_key: Optional[str] = None) -> None:
+        """Register an in-jit exchange leg (called at TRACE time from
+        inside jitted code -- a host side effect that fires once per
+        trace, like ``_note_compression_ratio``).  The byte totals let
+        the offline report split compiled-step exchange time across
+        legs; they are per-trace wire payloads, not per-step timings."""
+        with self._lock:
+            lg = self.legs.setdefault(leg, {"nbytes": 0, "buckets": 0})
+            lg["nbytes"] += int(nbytes)
+            lg["buckets"] += 1
+        tl = self.timeline
+        if tl is not None:
+            try:
+                tl.counter(f"leg_bytes/{leg}", float(nbytes))
+            except Exception:
+                pass
+
+    # -- step boundary ----------------------------------------------------
+    def step_boundary(self, step: int, wall_s: float,
+                      t0_unix_us: Optional[float] = None) -> dict:
+        """Close step ``step``: fold accumulated spans into a summary,
+        push it through the listeners (straggler monitor, KV publisher)
+        and return it.  ``wall_s`` is the full step wall including the
+        dispatch gap; ``t0_unix_us`` anchors the step on the wall clock
+        for the cross-rank merge."""
+        with self._lock:
+            acc = self._acc.pop(step, {"spans": {}, "legs": {}})
+            summary = {
+                "rank": self.rank,
+                "step": int(step),
+                "t0_us": float(t0_unix_us if t0_unix_us is not None
+                               else time.time() * 1e6),
+                "wall_s": float(wall_s),
+                "spans": {k: round(v, 9)
+                          for k, v in sorted(acc["spans"].items())},
+                "legs": {k: {"secs": round(v["secs"], 9),
+                             "count": v["count"]}
+                         for k, v in sorted(acc["legs"].items())},
+            }
+            self.summaries[step] = summary
+            while len(self.summaries) > SUMMARY_RING:
+                self.summaries.popitem(last=False)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(summary)
+            except Exception:  # observers must never break training
+                pass
+        return summary
+
+    def reset(self) -> None:
+        """Forget accumulated state (tests / re-init)."""
+        with self._lock:
+            self._step = 0
+            self._acc.clear()
+            self.summaries.clear()
+            self.legs.clear()
+            self._listeners = []
+            self.timeline = None
+            self.rank = 0
+
+
+def dominant_span(summary: dict) -> str:
+    """The span kind that ate the most host time in a step summary
+    (``"compute"`` when the dispatch dominates and nothing else is
+    recorded -- on the scan-loop path the device work hides behind one
+    dispatch)."""
+    spans = summary.get("spans") or {}
+    if not spans:
+        return "compute"
+    return max(spans.items(), key=lambda kv: kv[1])[0]
+
+
+_recorder = SpanRecorder()
+
+
+def recorder() -> SpanRecorder:
+    """The process-wide :class:`SpanRecorder` singleton."""
+    return _recorder
+
+
+def note_leg(leg: str, nbytes: int = 0, bucket_id: Optional[int] = None,
+             fuse_key: Optional[str] = None) -> None:
+    """Module-level convenience for in-jit call sites (keeps the traced
+    code's import surface to one function)."""
+    _recorder.note_leg(leg, nbytes=nbytes, bucket_id=bucket_id,
+                       fuse_key=fuse_key)

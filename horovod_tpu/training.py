@@ -444,11 +444,18 @@ def _microbatch_grad_pipe(exchange, axes):
         bufs = pack(leaves, spec)
         n = _ops.axis_size(axes)
         q = _ops.microbatch_pad_quantum(n)
+        from .timeline import spans as _spans
         shards = []
-        for buf in bufs:
+        for i, buf in enumerate(bufs):
             c, ctx = compression.compress(buf)
             if pre != 1.0:
                 c = c * jnp.asarray(pre, dtype=c.dtype)
+            # Trace-time leg registration (once per trace): the overlap
+            # RS leg's wire bytes per bucket, for straggler attribution.
+            _spans.note_leg(
+                "microbatch_rs",
+                nbytes=int(c.size) * jnp.dtype(c.dtype).itemsize,
+                bucket_id=i)
             shard = _ops.psum_scatter_bucket(c, axes=axes, quantum=q)
             shards.append(
                 compression.decompress(shard, ctx).astype(jnp.float32))
@@ -463,14 +470,20 @@ def _microbatch_grad_pipe(exchange, axes):
         scale = 1.0 / k
         if exchange["op"] is Average:
             scale = scale / n
+        from .timeline import spans as _spans
         out = []
-        for shard, (dt, lspecs) in zip(state, spec.buffers):
+        for i, (shard, (dt, lspecs)) in enumerate(
+                zip(state, spec.buffers)):
             shard = shard * scale
             if post != 1.0:
                 shard = shard * post
             shard = shard.astype(dt)
             c2, ctx2 = compression.compress(shard)
             size = sum(s.size for s in lspecs)
+            _spans.note_leg(
+                "microbatch_ag",
+                nbytes=int(c2.size) * jnp.dtype(c2.dtype).itemsize,
+                bucket_id=i)
             full = _ops.allgather_bucket(c2, size, axes=axes)
             out.append(compression.decompress(full, ctx2))
         return jax.tree.unflatten(treedef, unpack(out, spec))
@@ -821,6 +834,10 @@ class _InstrumentedStep:
         self._meta = meta
         self._accounting: Optional[Tuple[str, int, int]] = None
         self._step_count = 0
+        # perf_counter at the previous call's return: the time until the
+        # next call is the host dispatch gap (input pipeline, Python
+        # glue, injected chaos delays) the span layer attributes.
+        self._last_end: Optional[float] = None
 
     def __getattr__(self, name):
         return getattr(self._fn, name)
@@ -836,14 +853,25 @@ class _InstrumentedStep:
 
     def __call__(self, params, *rest):
         from .timeline import metrics as _metrics
+        from .timeline import spans as _spans
         import time as _time
         reg = _metrics.registry()
         if not reg.enabled:
             return self._fn(params, *rest)
         codec, wire, raw = self._account(params)
+        rec = _spans.recorder()
+        step = self._step_count + self._steps
+        rec.set_step(step)
         t0 = _time.perf_counter()
-        out = self._fn(params, *rest)
-        wall = _time.perf_counter() - t0
+        t0_unix_us = _time.time() * 1e6
+        gap = (t0 - self._last_end) if self._last_end is not None else 0.0
+        if gap > 0:
+            rec.add("dispatch_gap", gap, emit=True)
+        with rec.span("dispatch", name="step"):
+            out = self._fn(params, *rest)
+        t1 = _time.perf_counter()
+        wall = t1 - t0
+        self._last_end = t1
         self._step_count += self._steps
         try:
             _metrics.record_step_report(_metrics.StepReport(
@@ -855,6 +883,14 @@ class _InstrumentedStep:
                 codec=codec,
                 exchanged_bytes=wire,
                 uncompressed_bytes=raw))
+        except Exception:
+            pass
+        try:
+            # Step summary wall INCLUDES the dispatch gap (a late host
+            # is a late rank); the wall-clock anchor backs up to the
+            # gap's start so merged traces show the full step extent.
+            rec.step_boundary(step, wall + gap,
+                              t0_unix_us=t0_unix_us - gap * 1e6)
         except Exception:
             pass
         return out

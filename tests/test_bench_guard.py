@@ -885,3 +885,125 @@ def test_audit_validator_trips_on_dirty_blocks(tmp_path):
     assert "audit not ok" in why[str(tmp_path / "BENCH_r71.json")]
     assert "audit crashed" in why[str(tmp_path / "BENCH_r72.json")]
     assert "unaccounted/missing" in why[str(tmp_path / "BENCH_r73.json")]
+
+
+# ---------------------------------------------------------------------------
+# Straggler-attribution entries (PR 9)
+# ---------------------------------------------------------------------------
+
+def scan_straggler_entries(bench_dir):
+    """Return [(path, why), ...] for malformed straggler entries.
+
+    A straggler entry records the deterministic slow-rank drill
+    (examples/straggler_probe.py): one rank stalled by the chaos
+    ``slow`` fault, the monitor naming it.  It must carry the injected
+    spec, show detected_rank == injected_rank (the whole point of the
+    drill), a positive lateness, a dominant span kind, a fleet of at
+    least two ranks all of which merged, and a null vs_baseline (an
+    attribution drill is never throughput-comparable)."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            st = parsed.get("straggler")
+            if not st:
+                continue
+            spec = st.get("spec")
+            if not (isinstance(spec, str) and "slow@step=" in spec):
+                bad.append((path, f"spec must carry a slow@step= fault, "
+                                  f"got {spec!r}"))
+            world = st.get("world")
+            if not isinstance(world, int) or world < 2:
+                bad.append((path, f"world must be an int >= 2, "
+                                  f"got {world!r}"))
+            inj, det = st.get("injected_rank"), st.get("detected_rank")
+            if not isinstance(inj, int) or inj != det:
+                bad.append((path, f"detected_rank {det!r} != "
+                                  f"injected_rank {inj!r}: the monitor "
+                                  f"missed the slow rank"))
+            late = st.get("lateness_s")
+            if not (isinstance(late, (int, float)) and late > 0):
+                bad.append((path, f"lateness_s must be > 0, got {late!r}"))
+            if not st.get("dominant_span"):
+                bad.append((path, "dominant_span missing: attribution "
+                                  "must name WHERE the rank is slow"))
+            if isinstance(world, int) and st.get("merged_ranks") != world:
+                bad.append((path, f"merged_ranks {st.get('merged_ranks')!r}"
+                                  f" != world {world!r}: the offline "
+                                  f"merge dropped rank traces"))
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "straggler entries must carry a null "
+                                  "vs_baseline"))
+    return bad
+
+
+def test_committed_straggler_entries_well_formed():
+    assert scan_straggler_entries(REPO) == []
+
+
+def test_committed_straggler_round_exists_and_attributes():
+    """Acceptance gate: a committed bench round must record the slow-rank
+    drill with the monitor naming the injected rank."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            st = (entry.get("parsed") or {}).get("straggler")
+            if st:
+                found.append((path, st))
+    assert found, "no committed bench round carries a straggler block"
+    for path, st in found:
+        assert st["detected_rank"] == st["injected_rank"], (path, st)
+        assert st["dominant_span"] == "dispatch_gap", (path, st)
+        assert st["lateness_s"] > 0, (path, st)
+
+
+def _write_straggler(tmp_path, name, st, vs_baseline=None):
+    parsed = {"metric": "straggler_attribution",
+              "value": st.get("lateness_s"), "unit": "seconds_late",
+              "vs_baseline": vs_baseline, "config": "mlp_w8_slow0.25",
+              "baseline_config": "mlp_w8_slow0.25", "straggler": st}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 8, "cmd": "straggler_probe.py", "rc": 0, "tail": "",
+         "parsed": parsed}))
+
+
+def test_straggler_guard_accepts_good_entry(tmp_path):
+    _write_straggler(tmp_path, "BENCH_r95.json", {
+        "spec": "seed=1;slow@step=4,rank=5,secs=0.25", "world": 8,
+        "injected_rank": 5, "injected_secs": 0.25, "detected_rank": 5,
+        "dominant_span": "dispatch_gap", "lateness_s": 0.012,
+        "skew_s": 0.003, "merged_ranks": 8, "merged_events": 256})
+    assert scan_straggler_entries(str(tmp_path)) == []
+
+
+def test_straggler_guard_trips_on_bad_entries(tmp_path):
+    _write_straggler(tmp_path, "BENCH_r96.json", {
+        "spec": "comm@step=1,rank=0",   # wrong fault kind
+        "world": 1,                     # not a fleet
+        "injected_rank": 5, "detected_rank": 3,  # missed the rank
+        "lateness_s": 0.0,              # no measured lateness
+        "dominant_span": "",            # no attribution
+        "merged_ranks": 1})
+    _write_straggler(tmp_path, "BENCH_r97.json", {
+        "spec": "slow@step=4,rank=5,secs=0.25", "world": 8,
+        "injected_rank": 5, "detected_rank": 5, "lateness_s": 0.01,
+        "dominant_span": "dispatch_gap", "merged_ranks": 7},
+        vs_baseline=1.0)                # must be null on a drill
+    why = " ".join(w for _, w in scan_straggler_entries(str(tmp_path)))
+    assert "slow@step=" in why
+    assert "world" in why
+    assert "missed the slow rank" in why
+    assert "lateness_s" in why
+    assert "dominant_span" in why
+    assert "merged_ranks" in why
+    assert "vs_baseline" in why

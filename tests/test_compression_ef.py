@@ -303,7 +303,7 @@ def test_ef_exchange_emits_compression_ratio_counter(hvd, monkeypatch):
         def counter(self, name, value, track="counters"):
             recorded.append({name: value})
 
-        def range(self, tensor, phase):
+        def range(self, tensor, phase, args=None):
             import contextlib
             return contextlib.nullcontext()
 

@@ -347,7 +347,7 @@ def test_flush_emits_timeline_counters(hvd, monkeypatch):
         def counter(self, name, value, track="counters"):
             recorded.append({name: value})
 
-        def range(self, tensor, phase):
+        def range(self, tensor, phase, args=None):
             import contextlib
             return contextlib.nullcontext()
     monkeypatch.setattr(global_state(), "timeline", _TL())

@@ -146,3 +146,112 @@ def test_overlap_emits_timeline_counter(tmp_path):
     counters = [ev for ev in doc if ev.get("ph") == "C"]
     ev = [e for e in counters if e["name"] == "exchange_overlap"][0]
     assert 0.0 <= ev["args"]["exchange_overlap"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# Window edges: empty windows, single samples, and clocks that go backwards
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    """Scripted perf_counter: returns the next value from a list."""
+
+    def __init__(self, values):
+        self.values = list(values)
+
+    def __call__(self):
+        return self.values.pop(0) if self.values else 0.0
+
+
+def test_gap_empty_window_is_all_gap(monkeypatch):
+    """A window with zero dispatches is pure host time: gap 1.0."""
+    import horovod_tpu.timeline as T
+    mon = DispatchGapMonitor()
+    monkeypatch.setattr(T.time, "perf_counter",
+                        _FakeClock([100.0, 100.5]))
+    mon.begin_window()
+    assert mon.end_window() == 1.0
+
+
+def test_gap_zero_width_window_reports_zero(monkeypatch):
+    """begin/end at the same instant (wall == 0) must not divide by
+    zero; 0.0 by convention."""
+    import horovod_tpu.timeline as T
+    mon = DispatchGapMonitor()
+    monkeypatch.setattr(T.time, "perf_counter",
+                        _FakeClock([100.0, 100.0]))
+    mon.begin_window()
+    assert mon.end_window() == 0.0
+    assert mon.gap_fraction == 0.0
+
+
+def test_gap_single_dispatch_sample(monkeypatch):
+    """One dispatch covering half the window: gap exactly 0.5."""
+    import horovod_tpu.timeline as T
+    mon = DispatchGapMonitor()
+    monkeypatch.setattr(
+        T.time, "perf_counter",
+        #          begin  disp-in  disp-out  end
+        _FakeClock([100.0, 100.0, 100.5, 101.0]))
+    mon.begin_window()
+    with mon.dispatch():
+        pass
+    assert mon.end_window() == pytest.approx(0.5)
+
+
+def test_gap_backwards_clock_clamps_into_unit_interval(monkeypatch):
+    """A clock stepping backwards inside dispatch() makes dispatched
+    time negative; the fraction must clamp into [0, 1], never go
+    negative or above 1."""
+    import horovod_tpu.timeline as T
+    mon = DispatchGapMonitor()
+    monkeypatch.setattr(
+        T.time, "perf_counter",
+        #          begin  disp-in  disp-out(backwards!)  end
+        _FakeClock([100.0, 101.0, 100.0, 102.0]))
+    mon.begin_window()
+    with mon.dispatch():
+        pass
+    assert mon._dispatched < 0  # the regression precondition
+    gap = mon.end_window()
+    assert 0.0 <= gap <= 1.0
+    assert gap == 1.0  # nothing credibly dispatched
+
+
+def test_gap_dispatch_longer_than_wall_clamps_to_zero(monkeypatch):
+    """Dispatched time exceeding the window wall (clock slew the other
+    way) must clamp the gap to 0, not go negative."""
+    import horovod_tpu.timeline as T
+    mon = DispatchGapMonitor()
+    monkeypatch.setattr(
+        T.time, "perf_counter",
+        #          begin  disp-in  disp-out  end(before disp-out!)
+        _FakeClock([100.0, 100.0, 103.0, 101.0]))
+    mon.begin_window()
+    with mon.dispatch():
+        pass
+    assert mon.end_window() == 0.0
+
+
+def test_overlap_zero_width_window(monkeypatch):
+    """steps >= 1 with wall == 0: everything hidden (frac 1.0),
+    never a ZeroDivisionError."""
+    import horovod_tpu.timeline as T
+    from horovod_tpu.timeline import OverlapMonitor
+    mon = OverlapMonitor(compute_s=0.01, comm_s=0.01)
+    monkeypatch.setattr(T.time, "perf_counter",
+                        _FakeClock([100.0, 100.0]))
+    mon.begin_window()
+    assert mon.end_window(steps=1) == 1.0
+
+
+def test_overlap_backwards_clock_clamps(monkeypatch):
+    """Negative wall (backwards clock across the window) must still
+    yield a fraction in [0, 1]."""
+    import horovod_tpu.timeline as T
+    from horovod_tpu.timeline import OverlapMonitor
+    mon = OverlapMonitor(compute_s=0.01, comm_s=0.01)
+    monkeypatch.setattr(T.time, "perf_counter",
+                        _FakeClock([100.0, 99.0]))
+    mon.begin_window()
+    frac = mon.end_window(steps=1)
+    assert 0.0 <= frac <= 1.0

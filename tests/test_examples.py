@@ -1,6 +1,7 @@
 """Smoke-run every example workload on the CPU mesh (reference CI runs
 its examples per framework; BASELINE.json names these five configs)."""
 
+import json
 import os
 import subprocess
 import sys
@@ -76,6 +77,28 @@ def test_metrics_probe_example_cpu():
     assert "metrics probe OK" in out
     assert "horovod_step_total 3" in out
     assert "exchange plan" in out
+
+
+@pytest.mark.integration
+def test_straggler_probe_example_cpu(tmp_path):
+    """8-rank virtual-mesh drill: the chaos `slow` fault stalls one
+    rank, the straggler monitor and the merged-trace report must both
+    name it with a dispatch_gap-dominated step (the probe asserts this
+    internally; the bench entry is validated here)."""
+    bench = tmp_path / "BENCH_r99.json"
+    out = _run([os.path.join(REPO, "examples", "straggler_probe.py"),
+                "--steps", "10", "--slow-rank", "3", "--slow-step", "4",
+                "--slow-secs", "0.3", "--bench-json", str(bench)])
+    assert "straggler probe OK" in out
+    assert "straggler: rank 3" in out
+    assert "dispatch_gap" in out
+    assert "host-bound" in out
+    doc = json.loads(bench.read_text())
+    st = doc["parsed"]["straggler"]
+    assert st["detected_rank"] == 3 and st["injected_rank"] == 3
+    assert st["merged_ranks"] == 8
+    from test_bench_guard import scan_straggler_entries
+    assert scan_straggler_entries(str(tmp_path)) == []
 
 
 @pytest.mark.integration
