@@ -102,6 +102,16 @@ EAGER_NP = int(os.environ.get("BENCH_EAGER_NP", "2"))
 CHAOS_BENCH = _env_on("BENCH_CHAOS")
 CHAOS_SPEC = os.environ.get("BENCH_CHAOS_SPEC",
                             "seed=7;comm@step=11,rank=0")
+# BENCH_SERVING=1 runs the continuous-batching inference drill instead of
+# training throughput: the LLAMA_SERVE toy decoder served over an 8-way
+# tensor-parallel virtual CPU mesh, a seeded open-loop Poisson load from
+# serving/loadgen.py, reporting tokens/s plus p50/p99 TTFT and per-token
+# latency and mean batch occupancy.  A CPU-mesh serving drill has no
+# training-throughput peer -> vs_baseline null.
+SERVING_BENCH = _env_on("BENCH_SERVING")
+SERVING_REQUESTS = int(os.environ.get("BENCH_SERVING_REQUESTS", "24"))
+SERVING_RATE = float(os.environ.get("BENCH_SERVING_RATE", "50"))
+SERVING_SLOTS = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
 
 
 def _config() -> str:
@@ -229,6 +239,71 @@ def _main_chaos():
             "ef_residual_recovered_bytes": int(tm.registry().counter(
                 "horovod_ef_residual_recovered_bytes").value),
             "recovery_report": {k: v for k, v in recovery.items()},
+        },
+    }
+    print(json.dumps(result), flush=True)
+    os._exit(0)
+
+
+def _main_serving():
+    """BENCH_SERVING=1: continuous-batching serving throughput drill."""
+    import dataclasses
+    from horovod_tpu.utils.platform import force_host_device_count
+    force_host_device_count(8, cpu=True)  # before jax touches the backend
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from horovod_tpu import serving
+    from horovod_tpu.models.transformer import LLAMA_SERVE, LlamaLM
+
+    cfg = LLAMA_SERVE
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("tp",))
+    eng = serving.ServingEngine(cfg, params, mesh=mesh,
+                                slots=SERVING_SLOTS, page_size=8,
+                                max_len=64)
+    spec = serving.LoadSpec(num_requests=SERVING_REQUESTS,
+                            rate_rps=SERVING_RATE,
+                            prompt_lens=(4, 8, 16), output_lens=(4, 8),
+                            vocab_size=cfg.vocab_size, seed=11)
+    # Warm-up pass compiles the decode step and every prompt-length
+    # prefill variant outside the timed run (same length mix, tiny N).
+    eng.serve(serving.generate(
+        dataclasses.replace(spec, num_requests=6, seed=1)))
+    report = eng.serve(serving.generate(spec))
+
+    config = f"llama_serve_w8_slots{SERVING_SLOTS}"
+    result = {
+        "metric": "serving_tokens_per_sec",
+        "value": round(report.tokens_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # CPU-mesh serving drill: no throughput peer
+        "config": config,
+        "baseline_config": config,
+        "serving": {
+            "world": 8,
+            "slots": SERVING_SLOTS,
+            "requests": report.num_requests,
+            "completed": report.completed,
+            "rejected": report.rejected,
+            "prompt_tokens": report.prompt_tokens,
+            "new_tokens": report.new_tokens,
+            "decode_steps": report.decode_steps,
+            "tokens_per_s": round(report.tokens_per_s, 2),
+            "ttft_p50_ms": round(report.ttft_p50_s * 1e3, 3),
+            "ttft_p99_ms": round(report.ttft_p99_s * 1e3, 3),
+            "token_latency_p50_ms": round(
+                report.token_latency_p50_s * 1e3, 3),
+            "token_latency_p99_ms": round(
+                report.token_latency_p99_s * 1e3, 3),
+            "batch_occupancy": round(report.mean_occupancy, 4),
+            "load": {"rate_rps": SERVING_RATE,
+                     "num_requests": SERVING_REQUESTS,
+                     "prompt_lens": list(spec.prompt_lens),
+                     "output_lens": list(spec.output_lens),
+                     "seed": spec.seed},
         },
     }
     print(json.dumps(result), flush=True)
@@ -369,6 +444,8 @@ def main():
         _main_eager()
     if CHAOS_BENCH:
         _main_chaos()
+    if SERVING_BENCH:
+        _main_serving()
     if OVERLAP and ZERO:
         sys.exit("BENCH_OVERLAP / HOROVOD_MICROBATCHES>1 is incompatible "
                  "with HOROVOD_ZERO=1 (the ZeRO arena exchange is already "

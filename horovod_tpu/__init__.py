@@ -68,6 +68,7 @@ from .training import (  # noqa: F401
     stacked_batch_sharding, steps_per_execution, microbatches,
 )
 from .data import DevicePrefetcher, prefetch_to_device  # noqa: F401
+from . import serving  # noqa: F401  (continuous-batching inference)
 from .timeline.metrics import (  # noqa: F401  (unified metrics plane)
     StepReport, metrics_snapshot, last_step_report, render_prometheus,
 )

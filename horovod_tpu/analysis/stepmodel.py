@@ -136,6 +136,8 @@ def expected_exchange(params, meta: dict) -> ExpectedExchange:
     from ..optim import distributed as _dist
     from ..optim import zero as _zero
 
+    if meta.get("kind") == "serving_decode":
+        return _expected_serving_decode(meta)
     world = int(meta.get("world", 1))
     if world <= 1:
         return _expected_world1(params, meta)
@@ -189,6 +191,45 @@ def expected_exchange(params, meta: dict) -> ExpectedExchange:
                       f"bucket{r['bucket']}({r['dtype']})/allreduce")
            for r in rows]
     return ExpectedExchange(ops=ops, plan_rows=rows)
+
+
+def _expected_serving_decode(meta: dict) -> ExpectedExchange:
+    """The serving TP decode step's activation contract.
+
+    Two row-parallel closures per decoder layer (``wo`` after attention,
+    ``w_down`` after the SwiGLU), each one ``collectives.ops.allreduce``
+    == one ``psum`` of the full residual activation -- ``slots * d_model``
+    elements at the compute dtype.  Size-1-axis psums are NOT elided at
+    trace time, so the same two-per-layer contract holds at tp=1.
+
+    Per-slot LoRA banks are declined, not guessed: the adapter gather is
+    an indexing pattern the pricing model does not cover, and a wrong
+    expectation is worse than an honest unsupported warning.
+    """
+    if meta.get("lora"):
+        return _unsupported(("serving TP decode with per-slot LoRA banks: "
+                             "unmodeled adapter exchange",))
+    missing = [k for k in ("num_layers", "d_model", "slots")
+               if not meta.get(k)]
+    if missing:
+        return _unsupported(
+            (f"serving decode meta missing {'/'.join(missing)}: "
+             "cannot derive activation widths",))
+    layers = int(meta["num_layers"])
+    elements = int(meta["slots"]) * int(meta["d_model"])
+    dtype = str(jnp.dtype(meta.get("dtype", "float32")))
+    ops: List[ExpectedOp] = []
+    for li in range(layers):
+        ops.append(ExpectedOp("psum", dtype, elements,
+                              f"layer{li}/attn_wo/allreduce"))
+        ops.append(ExpectedOp("psum", dtype, elements,
+                              f"layer{li}/mlp_down/allreduce"))
+    rows = [{"bucket": 0, "dtype": dtype, "leaves": 2 * layers,
+             "elements": 2 * layers * elements,
+             "kind": "serving-tp-decode"}]
+    return ExpectedExchange(ops=ops, plan_rows=rows, notes=(
+        f"serving decode: 2 row-parallel allreduces/layer x {layers} "
+        f"layer(s), {elements} elements each",))
 
 
 def _ef_ops(rows: List[dict], comp) -> List[ExpectedOp]:

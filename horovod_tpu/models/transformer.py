@@ -239,6 +239,12 @@ LLAMA_1B = LlamaConfig(vocab_size=32000, num_layers=16, num_heads=16,
 LLAMA_TINY = LlamaConfig(vocab_size=256, num_layers=2, num_heads=4,
                          num_kv_heads=2, head_dim=16, d_model=64,
                          ffn_hidden=128, max_seq_len=128)
+# Serving-test variant: full-MHA head counts (8 query AND 8 kv heads) so a
+# tensor-parallel decode step divides evenly across the 8-device virtual
+# mesh (kv heads shard over tp; LLAMA_TINY's 2 kv heads cap tp at 2).
+LLAMA_SERVE = LlamaConfig(vocab_size=256, num_layers=2, num_heads=8,
+                          num_kv_heads=8, head_dim=16, d_model=64,
+                          ffn_hidden=128, max_seq_len=128)
 
 
 class LlamaLM(nn.Module):

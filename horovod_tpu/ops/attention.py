@@ -149,6 +149,44 @@ def attention_reference(q, k, v, *, causal: bool = False,
     return out.astype(v.dtype)
 
 
+def decode_attention(q, k, v, *, lengths, scale: Optional[float] = None):
+    """Single-token decode attention over a length-masked KV cache.
+
+    ``q``: ``(b, h, 1, d)`` -- the current token's query per slot.
+    ``k``/``v``: ``(b, h_kv, s, d)`` -- the cache view, where only the
+    first ``lengths[i]`` positions of row ``i`` hold live keys (anything
+    beyond is recycled-page garbage and must not contribute).
+    ``lengths``: ``(b,)`` int, live key count per row; a row with
+    ``lengths == 0`` (an idle batch slot) produces EXACTLY zero output
+    via the reference's dead-row convention.
+
+    No causal mask is needed: the current token sits at position
+    ``lengths - 1`` and every cached key is at a position ``< lengths``,
+    so the length mask IS the bottom-right-aligned causal mask for a
+    one-token query.  Runs the XLA reference path (decode batches are
+    tiny on the q axis; a Pallas grid would idle the MXU).
+    """
+    if q.shape[2] != 1:
+        raise ValueError(f"decode_attention expects a single-token query, "
+                         f"got tq={q.shape[2]}")
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(f"query heads {q.shape[1]} not a multiple of "
+                         f"kv heads {k.shape[1]}")
+    if lengths.shape != (q.shape[0],):
+        raise ValueError(f"lengths must be ({q.shape[0]},), got "
+                         f"{lengths.shape}")
+    rep = q.shape[1] // k.shape[1]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    s = k.shape[2]
+    kv_seg = (jnp.arange(s)[None, :]
+              < lengths[:, None]).astype(jnp.int32)
+    q_seg = jnp.ones((q.shape[0], 1), jnp.int32)
+    return attention_reference(q, k, v, causal=False, scale=scale,
+                               segment_ids=q_seg, kv_segment_ids=kv_seg)
+
+
 def _causal_mask(s, qi, ki, bq, bk, off):
     rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + off
     cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)

@@ -1,0 +1,19 @@
+"""Serving data plane: continuous-batching multi-host inference.
+
+A new CLIENT of the existing exchange stack, not a parallel universe:
+the tensor-parallel decode step routes its activation collectives
+through ``collectives/ops.py`` (fusion planner / span recorder / static
+auditor all see them), per-request lifecycle lands in the PR 6
+MetricsRegistry, and per-leg decode time is attributed by the PR 9
+span layer exactly like training time.
+"""
+
+from .decode import (build_decode_step, decode_param_specs,  # noqa: F401
+                     greedy_sample, prefill_forward, stack_adapters,
+                     ServingDecodeStep)
+from .engine import (RequestPrefetcher, ServingEngine,  # noqa: F401
+                     ServingReport)
+from .kvcache import (CacheConfig, PagedKVCache,  # noqa: F401
+                      cache_sharding)
+from .loadgen import LoadSpec, generate  # noqa: F401
+from .scheduler import ContinuousBatchScheduler, Request  # noqa: F401

@@ -1,0 +1,453 @@
+"""Functional Llama prefill / tensor-parallel incremental decode.
+
+:class:`~horovod_tpu.models.transformer.LlamaLM` is a flax module built
+for training; serving needs the SAME math refactored into two functional
+entry points that thread a paged KV cache instead of re-reading the whole
+context every token:
+
+* :func:`prefill_forward` -- full-context forward over a prompt that also
+  returns the per-layer post-RoPE K/V ready to scatter into the cache
+  (replicated; prompt work is compute-bound and tiny next to decode).
+* :func:`build_decode_step` -- a single-token batched decode step
+  compiled as ``jit(shard_map(...))`` over a named ``tp`` mesh.  Head
+  projections are column-parallel, the ``wo``/``w_down`` closures
+  row-parallel via :func:`horovod_tpu.parallel.tp.row_parallel`, so every
+  activation collective is a ``collectives.ops.allreduce`` -- visible to
+  the fusion planner, registered with the span recorder at trace time
+  (:func:`~horovod_tpu.timeline.spans.note_leg`), and priced by the
+  static auditor through the ``_meta`` dict the returned wrapper carries
+  (the ``_InstrumentedStep`` convention).
+
+Every cast mirrors ``models/transformer.py`` operation-for-operation
+(``Dense`` computes ``x.astype(dtype) @ kernel.astype(dtype)``, RMSNorm
+normalizes in f32, RoPE rotates in f32, the tied-embedding readout runs
+in f32), so incremental decode matches the flax full-context forward to
+float tolerance -- the tentpole parity contract.
+
+Multi-LoRA: ``stack_adapters`` packs N trained adapter trees into banked
+``[n_adapters, ...]`` leaves; the decode step then gathers each slot's
+adapter pair by a per-slot ``adapter_ids`` operand INSIDE the step, so
+one base model serves heterogeneous adapters in one decode batch
+(tensor-parallel meshes decline the banks -- adapters stay tp=1).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..models.transformer import LlamaConfig, rotary_embedding
+from ..ops.attention import decode_attention, flash_attention
+from ..parallel.tp import row_parallel
+from ..timeline import spans as _spans
+
+TP_AXIS = "tp"
+
+_COLUMN_KEYS = ("wq", "wk", "wv", "w_gate", "w_up")
+_ROW_KEYS = ("wo", "w_down")
+
+
+# ---------------------------------------------------------------------------
+# Shared math (the Dense/RMSNorm mirror).
+# ---------------------------------------------------------------------------
+
+
+def _dense(x, node, dtype, *, lora_select=None, lora_alpha=16.0):
+    """``Dense.__call__`` replayed over a raw param node.
+
+    ``lora_select``: optional ``(a, b)`` adapter pair already gathered
+    for this call -- either a plain ``[d_in, r]/[r, d_out]`` pair (one
+    adapter) or per-slot ``[s, d_in, r]/[s, r, d_out]`` banks.
+    """
+    y = x.astype(dtype) @ node["kernel"].astype(dtype)
+    if lora_select is not None:
+        a, b = lora_select
+        r = a.shape[-1]
+        scale = jnp.asarray(lora_alpha / r, dtype)
+        if a.ndim == 2:
+            y = y + (x.astype(dtype) @ a.astype(dtype)
+                     @ b.astype(dtype)) * scale
+        else:
+            # Per-slot banks: slot s uses its own (a[s], b[s]).
+            t = jnp.einsum("sqd,sdr->sqr", x.astype(dtype),
+                           a.astype(dtype))
+            y = y + jnp.einsum("sqr,sro->sqo", t, b.astype(dtype)) * scale
+    return y
+
+
+def _rmsnorm(x, scale, dtype, epsilon: float = 1e-5):
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + epsilon)
+    return (norm * scale).astype(dtype)
+
+
+def _node_lora(node, adapters_node, select):
+    """Resolve the adapter pair for one Dense node, preferring banked
+    adapters (``adapters_node``) gathered by ``select`` over in-tree
+    ``lora_a``/``lora_b`` leaves."""
+    if adapters_node is not None:
+        return select(adapters_node["lora_a"], adapters_node["lora_b"])
+    if "lora_a" in node:
+        return node["lora_a"], node["lora_b"]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Prefill: full-context forward exposing per-layer K/V.
+# ---------------------------------------------------------------------------
+
+
+def prefill_forward(params, config: LlamaConfig, tokens, positions=None,
+                    *, segment_ids=None, dtype=jnp.float32,
+                    adapters=None, adapter_id=None, lora_alpha=16.0
+                    ) -> Tuple[Any, Any, Any]:
+    """Forward a prompt batch, returning ``(logits, k_layers, v_layers)``.
+
+    ``tokens``: ``[b, t]`` int32.  ``k_layers``/``v_layers``:
+    ``[num_layers, b, t, num_kv_heads, head_dim]`` post-RoPE -- the
+    layout :meth:`PagedKVCache.write_prefill` scatters (squeeze the batch
+    dim for the per-slot write).  Padding isolation via ``segment_ids``
+    follows the model convention (pad tokens get segment 0).
+
+    ``adapters``/``adapter_id``: banked LoRA tree + the ONE adapter this
+    prompt uses (prefill admits one request at a time).
+    """
+    cfg = config
+    p = params["params"] if "params" in params else params
+    b, t = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    emb = p["tok_embed"]
+    x = emb[tokens].astype(dtype)
+
+    def select(a, bnk):
+        return a[adapter_id], bnk[adapter_id]
+
+    ad = (adapters["params"] if adapters is not None and
+          "params" in adapters else adapters)
+    ks, vs = [], []
+    for li in range(cfg.num_layers):
+        blk = p[f"layer_{li}"]
+        abk = None if ad is None else ad.get(f"layer_{li}")
+
+        def lora(group, name, _blk=blk, _abk=abk):
+            node = _blk[group][name]
+            anode = None if _abk is None else _abk.get(group, {}).get(name)
+            return _node_lora(node, anode, select)
+
+        h = _rmsnorm(x, blk["attn_norm"]["scale"], dtype)
+        attn = blk["attn"]
+        q = _dense(h, attn["wq"], dtype, lora_select=lora("attn", "wq"),
+                   lora_alpha=lora_alpha)
+        k = _dense(h, attn["wk"], dtype, lora_select=lora("attn", "wk"),
+                   lora_alpha=lora_alpha)
+        v = _dense(h, attn["wv"], dtype, lora_select=lora("attn", "wv"),
+                   lora_alpha=lora_alpha)
+        q = q.reshape(b, t, cfg.num_heads, cfg.head_dim).transpose(
+            0, 2, 1, 3)
+        k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim).transpose(
+            0, 2, 1, 3)
+        v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim).transpose(
+            0, 2, 1, 3)
+        q = rotary_embedding(q, positions, cfg.rope_theta)
+        k = rotary_embedding(k, positions, cfg.rope_theta)
+        o = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
+        x = x + _dense(o, attn["wo"], dtype, lora_select=lora("attn", "wo"),
+                       lora_alpha=lora_alpha)
+        # Cache layout: [b, t, kv_heads, head_dim], post-RoPE.
+        ks.append(k.transpose(0, 2, 1, 3))
+        vs.append(v.transpose(0, 2, 1, 3))
+
+        h = _rmsnorm(x, blk["mlp_norm"]["scale"], dtype)
+        mlp = blk["mlp"]
+        gate = _dense(h, mlp["w_gate"], dtype,
+                      lora_select=lora("mlp", "w_gate"),
+                      lora_alpha=lora_alpha)
+        up = _dense(h, mlp["w_up"], dtype,
+                    lora_select=lora("mlp", "w_up"),
+                    lora_alpha=lora_alpha)
+        x = x + _dense(jax.nn.silu(gate) * up, mlp["w_down"], dtype,
+                       lora_select=lora("mlp", "w_down"),
+                       lora_alpha=lora_alpha)
+
+    x = _rmsnorm(x, p["final_norm"]["scale"], dtype)
+    logits = x.astype(jnp.float32) @ emb.astype(jnp.float32).T
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel decode step.
+# ---------------------------------------------------------------------------
+
+
+def decode_param_specs(params, tp_axis: str = TP_AXIS):
+    """PartitionSpec tree for ``shard_map`` over the decode params:
+    column kernels split on the output dim, row kernels on the input dim,
+    everything else replicated (the ``shard_tp_params`` key convention)."""
+
+    def spec(path, leaf):
+        names = [getattr(kk, "key", "") for kk in path]
+        if "kernel" in names and leaf.ndim == 2:
+            owner = names[-2] if names[-1] == "kernel" else ""
+            if owner in _COLUMN_KEYS:
+                return P(None, tp_axis)
+            if owner in _ROW_KEYS:
+                return P(tp_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+class ServingDecodeStep:
+    """Callable wrapper around the jitted decode step.
+
+    Carries the builder ``_meta`` the static auditor dispatches on (the
+    ``_InstrumentedStep`` convention: ``analysis.meta_from_step`` reads
+    ``_meta``, ``audit_step`` unwraps ``_fn``) and times each dispatch
+    into the span recorder under the ``serving_decode`` leg.
+    """
+
+    def __init__(self, fn, meta: dict):
+        self._fn = fn
+        self._meta = meta
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def __call__(self, *args):
+        rec = _spans.recorder()
+        with rec.span("dispatch", name="serving", leg="serving_decode"):
+            return self._fn(*args)
+
+
+def build_decode_step(config: LlamaConfig, mesh, *,
+                      slots: int, page_size: int, pages_per_slot: int,
+                      dtype=jnp.float32, with_lora: bool = False,
+                      lora_alpha: float = 16.0,
+                      tp_axis: str = TP_AXIS) -> ServingDecodeStep:
+    """Compile the batched one-token decode step over ``mesh``.
+
+    Signature of the returned step::
+
+        logits, k_pool, v_pool = step(params, k_pool, v_pool, tokens,
+                                      positions, page_table, active
+                                      [, adapters, adapter_ids])
+
+    ``tokens``/``positions``/``active``: ``[slots]`` (current token, its
+    absolute position == live length before this step, slot liveness).
+    ``page_table``: ``[slots, pages_per_slot]``.  The step writes the new
+    token's post-RoPE K/V into its page in-step, attends over the
+    length-masked slot view, and returns replicated next-token logits.
+    Idle slots produce zero attention output (dead-row convention) and
+    their logits are discarded by the engine.
+    """
+    cfg = config
+    tp = int(np.prod([mesh.shape[a] for a in mesh.axis_names
+                      if a == tp_axis])) if mesh is not None else 1
+    if mesh is not None and tp_axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {tp_axis!r} axis: {mesh.axis_names}")
+    for what, n in (("num_heads", cfg.num_heads),
+                    ("num_kv_heads", cfg.num_kv_heads),
+                    ("ffn_hidden", cfg.ffn_hidden)):
+        if n % tp:
+            raise ValueError(f"{what}={n} not divisible by tp={tp}")
+    if with_lora and tp > 1:
+        raise NotImplementedError(
+            "per-slot LoRA banks are tp=1 only (a row-parallel adapter "
+            "would need its own psum fold); shard requests, not adapters")
+    heads_l = cfg.num_heads // tp
+    kvh_l = cfg.num_kv_heads // tp
+    hd = cfg.head_dim
+    nbytes_leg = slots * cfg.d_model * jnp.dtype(dtype).itemsize
+
+    def spmd(params, k_pool, v_pool, tokens, positions, page_table,
+             active, adapters=None, adapter_ids=None):
+        p = params["params"] if "params" in params else params
+        ad = (adapters["params"] if adapters is not None and
+              "params" in adapters else adapters)
+        s = tokens.shape[0]
+        emb = p["tok_embed"]
+        x = emb[tokens].astype(dtype)[:, None, :]          # [S, 1, d]
+        pos2 = positions[:, None]                          # [S, 1]
+        # The step writes EVERY slot's K/V (fixed batch shape); idle
+        # slots are redirected to the pool's trailing scratch page so
+        # they never clobber a live page.
+        scratch = slots * pages_per_slot
+        page = jnp.where(active,
+                         page_table[jnp.arange(s), positions // page_size],
+                         scratch)
+        off = positions % page_size
+
+        def select(a, b):
+            return a[adapter_ids], b[adapter_ids]
+
+        for li in range(cfg.num_layers):
+            blk = p[f"layer_{li}"]
+            abk = None if ad is None else ad.get(f"layer_{li}")
+
+            def lora(group, name, _blk=blk, _abk=abk):
+                node = _blk[group][name]
+                anode = (None if _abk is None
+                         else _abk.get(group, {}).get(name))
+                return _node_lora(node, anode, select)
+
+            h = _rmsnorm(x, blk["attn_norm"]["scale"], dtype)
+            attn = blk["attn"]
+            q = _dense(h, attn["wq"], dtype,
+                       lora_select=lora("attn", "wq"),
+                       lora_alpha=lora_alpha)
+            k = _dense(h, attn["wk"], dtype,
+                       lora_select=lora("attn", "wk"),
+                       lora_alpha=lora_alpha)
+            v = _dense(h, attn["wv"], dtype,
+                       lora_select=lora("attn", "wv"),
+                       lora_alpha=lora_alpha)
+            q = q.reshape(s, 1, heads_l, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(s, 1, kvh_l, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(s, 1, kvh_l, hd).transpose(0, 2, 1, 3)
+            q = rotary_embedding(q, pos2, cfg.rope_theta)
+            k = rotary_embedding(k, pos2, cfg.rope_theta)
+
+            # In-step cache write: the new token's K/V lands at
+            # (page, offset) -- one scatter per pool per layer.
+            pool_dt = k_pool.dtype
+            k_pool = k_pool.at[li, page, off].set(
+                k[:, :, 0, :].astype(pool_dt))
+            v_pool = v_pool.at[li, page, off].set(
+                v[:, :, 0, :].astype(pool_dt))
+
+            # Slot view: gather this slot's pages -> [S, kvh, max_len, d].
+            ks = k_pool[li][page_table].reshape(
+                s, pages_per_slot * page_size, kvh_l, hd
+            ).transpose(0, 2, 1, 3)
+            vs = v_pool[li][page_table].reshape(
+                s, pages_per_slot * page_size, kvh_l, hd
+            ).transpose(0, 2, 1, 3)
+            lengths = jnp.where(active, positions + 1, 0)
+            o = decode_attention(q.astype(dtype), ks.astype(dtype),
+                                 vs.astype(dtype), lengths=lengths)
+            o = o.transpose(0, 2, 1, 3).reshape(s, 1, heads_l * hd)
+
+            # Row-parallel closures: the activation allreduce routes
+            # through collectives.ops (planner/auditor/span visible).
+            _spans.note_leg(f"serving_decode/layer{li}/attn_wo",
+                            nbytes=nbytes_leg)
+            y = row_parallel(o.astype(dtype),
+                             attn["wo"]["kernel"].astype(dtype),
+                             axis=tp_axis)
+            wo_lora = lora("attn", "wo")
+            if wo_lora is not None:
+                y = y + _dense_lora_only(o, wo_lora, dtype, lora_alpha)
+            x = x + y
+
+            h = _rmsnorm(x, blk["mlp_norm"]["scale"], dtype)
+            mlp = blk["mlp"]
+            gate = _dense(h, mlp["w_gate"], dtype,
+                          lora_select=lora("mlp", "w_gate"),
+                          lora_alpha=lora_alpha)
+            up = _dense(h, mlp["w_up"], dtype,
+                        lora_select=lora("mlp", "w_up"),
+                        lora_alpha=lora_alpha)
+            act = (jax.nn.silu(gate) * up).astype(dtype)
+            _spans.note_leg(f"serving_decode/layer{li}/mlp_down",
+                            nbytes=nbytes_leg)
+            y = row_parallel(act, mlp["w_down"]["kernel"].astype(dtype),
+                             axis=tp_axis)
+            wd_lora = lora("mlp", "w_down")
+            if wd_lora is not None:
+                y = y + _dense_lora_only(act, wd_lora, dtype, lora_alpha)
+            x = x + y
+
+        x = _rmsnorm(x, p["final_norm"]["scale"], dtype)
+        logits = (x.astype(jnp.float32)
+                  @ emb.astype(jnp.float32).T)[:, 0, :]   # [S, vocab]
+        return logits, k_pool, v_pool
+
+    def _build(params_example, adapters_example=None):
+        pool_spec = P(None, None, None, tp_axis, None)
+        in_specs = [decode_param_specs(params_example, tp_axis),
+                    pool_spec, pool_spec, P(), P(), P(), P()]
+        if adapters_example is not None:
+            in_specs += [jax.tree.map(lambda _: P(), adapters_example),
+                         P()]
+        fn = jax.shard_map(spmd, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=(P(), pool_spec, pool_spec),
+                           check_vma=False)
+        return jax.jit(fn)
+
+    # The jitted callable is built lazily on first call so the shard_map
+    # in_specs can mirror the actual params tree (LoRA leaves included).
+    state = {}
+
+    def step(*args):
+        key = len(args)
+        if key not in state:
+            state[key] = _build(args[0], args[7] if len(args) > 7 else None)
+        return state[key](*args)
+
+    meta = {"kind": "serving_decode", "world": tp, "tp": tp,
+            "num_layers": cfg.num_layers, "d_model": cfg.d_model,
+            "slots": int(slots), "dtype": str(jnp.dtype(dtype)),
+            "lora": bool(with_lora)}
+    return ServingDecodeStep(step, meta)
+
+
+def _dense_lora_only(x, lora_select, dtype, lora_alpha):
+    """The adapter half of ``_dense`` (added after a row-parallel psum;
+    tp=1 only, enforced by the builder)."""
+    a, b = lora_select
+    r = a.shape[-1]
+    scale = jnp.asarray(lora_alpha / r, dtype)
+    if a.ndim == 2:
+        return (x.astype(dtype) @ a.astype(dtype)
+                @ b.astype(dtype)) * scale
+    t = jnp.einsum("sqd,sdr->sqr", x.astype(dtype), a.astype(dtype))
+    return jnp.einsum("sqr,sro->sqo", t, b.astype(dtype)) * scale
+
+
+def greedy_sample(logits) -> jnp.ndarray:
+    """Deterministic next token per slot: argmax over the vocab."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Multi-LoRA banks.
+# ---------------------------------------------------------------------------
+
+
+def stack_adapters(param_trees) -> Any:
+    """Pack N per-adapter param trees into one banked adapter tree.
+
+    Input trees are full model params (each holding ``lora_a``/``lora_b``
+    leaves, e.g. from ``LlamaLM(lora_rank=r).init``); the result keeps
+    ONLY the adapter leaves, stacked on a new leading ``n_adapters`` dim,
+    nested exactly like the source tree -- the layout the decode step's
+    per-slot ``adapter_ids`` gather consumes.
+    """
+    if not param_trees:
+        raise ValueError("need at least one adapter tree")
+
+    def keep(tree):
+        if not isinstance(tree, dict):
+            return None
+        out = {}
+        for kk, vv in tree.items():
+            if kk in ("lora_a", "lora_b"):
+                out[kk] = vv
+            else:
+                sub = keep(vv)
+                if sub:
+                    out[kk] = sub
+        return out
+
+    kept = [keep(t) for t in param_trees]
+    if not kept[0]:
+        raise ValueError("adapter trees hold no lora_a/lora_b leaves")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *kept)

@@ -1,0 +1,294 @@
+"""Serving engine front-end: prefetch -> prefill -> continuous decode.
+
+One object owns the whole data plane: the paged KV cache, the
+continuous-batching scheduler, the jitted prefill, and the
+tensor-parallel decode step.  The request feed generalizes the
+``data.DevicePrefetcher`` double-buffering idiom from training batches
+to requests: a producer thread stages each upcoming prompt onto device
+while the engine is still decoding, so admission never stalls on a
+host-to-device copy.
+
+Knobs (all overridable per-constructor-arg, documented in docs/api.md):
+
+* ``HOROVOD_SERVING_SLOTS`` -- decode batch slots (default 8)
+* ``HOROVOD_SERVING_PAGE_SIZE`` -- KV page length in tokens (default 16)
+* ``HOROVOD_SERVING_MAX_LEN`` -- per-sequence cap (default: model max)
+* ``HOROVOD_SERVING_PREFETCH`` -- request prefetch depth (default 2)
+
+The engine keeps two clocks: a VIRTUAL clock that fast-forwards through
+idle gaps in the open-loop arrival schedule (TTFT and queueing are
+measured against it, so latency percentiles are arrival-faithful), and
+the real wall clock for throughput (tokens/s is never diluted by
+fast-forwarded idle time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.config import _env_int
+from ..timeline import spans as _spans
+from .decode import build_decode_step, greedy_sample, prefill_forward
+from .kvcache import CacheConfig, PagedKVCache, cache_sharding
+from .scheduler import ContinuousBatchScheduler, Request
+
+
+class _Stop:
+    def __init__(self, error: Optional[BaseException] = None):
+        self.error = error
+
+
+class RequestPrefetcher:
+    """Stage upcoming requests' prompts onto device ahead of admission.
+
+    Same shape as ``data.DevicePrefetcher``: bounded queue, daemon
+    producer, sentinel-carried errors, context-manager close.  Yields
+    ``(request, device_prompt)`` in arrival order.
+    """
+
+    def __init__(self, requests: Sequence[Request], depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, args=(list(requests),),
+            name="serving-prefetch", daemon=True)
+        self._thread.start()
+
+    def _produce(self, requests):
+        try:
+            for req in requests:
+                if self._closed.is_set():
+                    return
+                dev = jax.device_put(jnp.asarray(req.prompt, jnp.int32))
+                while not self._closed.is_set():
+                    try:
+                        self._q.put((req, dev), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+            self._q.put(_Stop())
+        except BaseException as e:  # surfaced in the consumer
+            self._q.put(_Stop(e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if isinstance(item, _Stop):
+            if item.error is not None:
+                raise item.error
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._closed.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+@dataclasses.dataclass
+class ServingReport:
+    """Aggregate result of one ``serve()`` run."""
+
+    num_requests: int
+    completed: int
+    rejected: int
+    prompt_tokens: int
+    new_tokens: int
+    wall_s: float
+    decode_steps: int
+    tokens_per_s: float
+    ttft_p50_s: float
+    ttft_p99_s: float
+    token_latency_p50_s: float
+    token_latency_p99_s: float
+    mean_occupancy: float
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _pct(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class ServingEngine:
+    """Continuous-batching inference over one Llama-family model."""
+
+    def __init__(self, config, params, *, mesh=None, slots: int = 0,
+                 page_size: int = 0, max_len: int = 0, dtype=jnp.float32,
+                 adapters=None, adapter_ids=None, lora_alpha: float = 16.0,
+                 prefetch_depth: int = 0):
+        self.config = config
+        self.params = params
+        if mesh is None:
+            from jax.sharding import Mesh
+            mesh = Mesh(np.asarray(jax.devices()[:1]), ("tp",))
+        self.mesh = mesh
+        self.slots = slots or _env_int("SERVING_SLOTS", 8)
+        self.page_size = page_size or _env_int("SERVING_PAGE_SIZE", 16)
+        self.max_len = max_len or _env_int("SERVING_MAX_LEN",
+                                           config.max_seq_len)
+        self.prefetch_depth = prefetch_depth or _env_int(
+            "SERVING_PREFETCH", 2)
+        self.dtype = dtype
+        self.adapters = adapters
+        self.lora_alpha = lora_alpha
+        self.cache_config = CacheConfig(
+            num_layers=config.num_layers,
+            num_kv_heads=config.num_kv_heads, head_dim=config.head_dim,
+            slots=self.slots, page_size=self.page_size,
+            max_len=self.max_len, dtype=str(jnp.dtype(dtype)))
+        self.cache = PagedKVCache(self.cache_config,
+                                  cache_sharding(mesh))
+        self.scheduler = ContinuousBatchScheduler(self.slots, self.cache)
+        self.step = build_decode_step(
+            config, mesh, slots=self.slots, page_size=self.page_size,
+            pages_per_slot=self.cache_config.pages_per_slot, dtype=dtype,
+            with_lora=adapters is not None, lora_alpha=lora_alpha)
+
+        def _prefill(p, toks, ad, aid):
+            return prefill_forward(p, config, toks, dtype=dtype,
+                                   adapters=ad, adapter_id=aid,
+                                   lora_alpha=lora_alpha)
+
+        self._prefill = jax.jit(_prefill)
+
+    # -- one-request helpers ----------------------------------------------
+    def _do_prefill(self, slot: int, req: Request, prompt_dev) -> int:
+        with _spans.recorder().span("dispatch", name="prefill",
+                                    leg="serving_prefill"):
+            aid = jnp.int32(req.adapter_id) if self.adapters is not None \
+                else None
+            logits, kl, vl = self._prefill(self.params, prompt_dev[None],
+                                           self.adapters, aid)
+            self.cache.write_prefill(slot, kl[:, 0], vl[:, 0])
+            first = int(greedy_sample(logits[:, -1, :])[0])
+        return first
+
+    # -- the serve loop ----------------------------------------------------
+    def serve(self, requests: Sequence[Request]) -> ServingReport:
+        """Run the open-loop request stream to completion."""
+        sched = self.scheduler
+        cache = self.cache
+        pending = sorted(requests, key=lambda r: r.arrival_s)
+        rejected = 0
+        admissible = []
+        for req in pending:
+            if req.prompt_len + req.max_new_tokens > self.max_len:
+                rejected += 1
+                sched._m_requests.labels(event="rejected").inc()
+            else:
+                admissible.append(req)
+
+        start = time.monotonic()
+        skip = 0.0
+
+        def now() -> float:
+            return time.monotonic() - start + skip
+
+        completed: List[Request] = []
+        occ_samples: List[float] = []
+        decode_steps = 0
+        last_tokens = np.zeros((self.slots,), np.int32)
+        adapter_ids = np.zeros((self.slots,), np.int32)
+        prompts_dev: Dict[int, Any] = {}
+
+        with RequestPrefetcher(admissible, self.prefetch_depth) as feed:
+            fetched = next(feed, None)
+
+            while True:
+                # Pull every request whose arrival time has passed.
+                while fetched is not None and \
+                        fetched[0].arrival_s <= now():
+                    req, dev = fetched
+                    prompts_dev[req.rid] = dev
+                    sched.submit(req)
+                    fetched = next(feed, None)
+                if not sched.has_work():
+                    if fetched is None:
+                        break
+                    # Idle: fast-forward the virtual clock to the next
+                    # arrival instead of sleeping.
+                    gap = fetched[0].arrival_s - now()
+                    if gap > 0:
+                        skip += gap
+                    continue
+
+                for slot, req in sched.admit(now()):
+                    first = self._do_prefill(
+                        slot, req, prompts_dev.pop(req.rid))
+                    req.tokens.append(first)
+                    sched.note_prefill(req, now())
+                    last_tokens[slot] = first
+                    adapter_ids[slot] = req.adapter_id
+                    if req.finished:
+                        completed.append(sched.release(slot, now()))
+
+                if not sched.active:
+                    continue
+
+                # One continuous-batching decode step over live slots.
+                for slot in sched.active:
+                    cache.reserve(slot, int(cache.lengths[slot]) + 1)
+                active = np.zeros((self.slots,), bool)
+                for slot in sched.active:
+                    active[slot] = True
+                args = [self.params, cache.k, cache.v,
+                        jnp.asarray(np.array(last_tokens)),
+                        cache.lengths_device(), cache.table_device(),
+                        jnp.asarray(active)]
+                if self.adapters is not None:
+                    args += [self.adapters,
+                             jnp.asarray(np.array(adapter_ids))]
+                t0 = time.monotonic()
+                logits, cache.k, cache.v = self.step(*args)
+                sampled = np.asarray(greedy_sample(logits))  # sync point
+                step_s = time.monotonic() - t0
+                decode_steps += 1
+                occ_samples.append(sched.occupancy)
+
+                for slot, req in list(sched.active.items()):
+                    tok = int(sampled[slot])
+                    req.tokens.append(tok)
+                    cache.lengths[slot] += 1
+                    last_tokens[slot] = tok
+                    sched.note_decode_token(req, step_s)
+                    if req.finished or \
+                            int(cache.lengths[slot]) >= self.max_len:
+                        completed.append(sched.release(slot, now()))
+
+        wall_s = max(time.monotonic() - start, 1e-9)
+        new_tokens = sum(len(r.tokens) for r in completed)
+        prompt_tokens = sum(r.prompt_len for r in completed)
+        ttfts = [r.ttft_s for r in completed if r.ttft_s is not None]
+        lats = [l for r in completed for l in r.token_latencies]
+        return ServingReport(
+            num_requests=len(requests), completed=len(completed),
+            rejected=rejected, prompt_tokens=prompt_tokens,
+            new_tokens=new_tokens, wall_s=wall_s,
+            decode_steps=decode_steps,
+            tokens_per_s=new_tokens / wall_s,
+            ttft_p50_s=_pct(ttfts, 50), ttft_p99_s=_pct(ttfts, 99),
+            token_latency_p50_s=_pct(lats, 50),
+            token_latency_p99_s=_pct(lats, 99),
+            mean_occupancy=(float(np.mean(occ_samples))
+                            if occ_samples else 0.0))

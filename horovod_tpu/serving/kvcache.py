@@ -1,0 +1,193 @@
+"""Sharded paged KV cache for the serving data plane.
+
+Physical layout is a fixed page pool per layer::
+
+    k, v: [num_layers, num_pages, page_size, num_kv_heads, head_dim]
+
+sharded over the ``tp`` mesh axis on the kv-head dim (the same split the
+tensor-parallel decode step gives the attention projections, so a rank's
+cache shard pairs exactly with its ``wk``/``wv`` kernel shards and no
+cross-rank traffic ever touches the cache).  The LOGICAL view -- which
+pages belong to which batch slot, and how many tokens are live -- is
+host-side metadata: an int32 ``page_table[slots, pages_per_slot]`` plus a
+``lengths[slots]`` vector, shipped into the compiled step as plain
+replicated operands.  Correctness never depends on page contents being
+zeroed: every read masks positions ``>= lengths`` through
+:func:`horovod_tpu.ops.attention.decode_attention`, so a recycled page's
+stale keys are unreachable by construction (the eviction/reuse test
+asserts this bit-for-bit).
+
+Pages are allocated lazily from a free list as a slot's sequence grows
+and returned wholesale on eviction -- continuous batching recycles slots
+mid-flight, so the pool, not the slot count, bounds resident KV bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Static shape of the pool (identical on every rank and mesh size)."""
+
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    slots: int
+    page_size: int
+    max_len: int
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.max_len % self.page_size:
+            raise ValueError(
+                f"max_len {self.max_len} not a multiple of page_size "
+                f"{self.page_size}")
+
+    @property
+    def pages_per_slot(self) -> int:
+        return self.max_len // self.page_size
+
+    @property
+    def num_pages(self) -> int:
+        return self.slots * self.pages_per_slot
+
+    @property
+    def scratch_page(self) -> int:
+        """Index of the write sink: the decode step writes EVERY slot's
+        K/V unconditionally (fixed-shape batch), so idle slots are
+        redirected to this extra page past the allocatable pool instead
+        of clobbering page 0."""
+        return self.num_pages
+
+    def layout(self) -> dict:
+        """GLOBAL layout descriptor.  Mesh-size invariant by contract:
+        the pool shape, page table geometry and dtype never depend on
+        how many ranks the kv-head dim is split over (asserted by
+        tests/test_serving.py across 1- and 8-device meshes)."""
+        return {
+            "kv_shape": [self.num_layers, self.num_pages + 1,
+                         self.page_size, self.num_kv_heads, self.head_dim],
+            "page_table_shape": [self.slots, self.pages_per_slot],
+            "page_size": self.page_size,
+            "pages_per_slot": self.pages_per_slot,
+            "num_pages": self.num_pages,
+            "scratch_page": self.scratch_page,
+            "dtype": str(jnp.dtype(self.dtype)),
+        }
+
+
+class PagedKVCache:
+    """Device page pool + host page table / free list for one model."""
+
+    def __init__(self, config: CacheConfig, sharding=None):
+        self.config = config
+        c = config
+        # +1: trailing scratch page, the write sink for idle slots.
+        shape = (c.num_layers, c.num_pages + 1, c.page_size,
+                 c.num_kv_heads, c.head_dim)
+        k = jnp.zeros(shape, jnp.dtype(c.dtype))
+        v = jnp.zeros(shape, jnp.dtype(c.dtype))
+        if sharding is not None:
+            k = jax.device_put(k, sharding)
+            v = jax.device_put(v, sharding)
+        self.sharding = sharding
+        self.k = k
+        self.v = v
+        # Host-side logical view.  Unallocated table entries point at
+        # page 0 -- harmless, reads beyond ``lengths`` are masked.
+        self.page_table = np.zeros((c.slots, c.pages_per_slot), np.int32)
+        self.lengths = np.zeros((c.slots,), np.int32)
+        self._allocated = np.zeros((c.slots,), np.int32)  # pages per slot
+        self._free = list(range(c.num_pages - 1, -1, -1))  # pop() -> 0, 1...
+
+    # -- page accounting ---------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def can_admit(self, length: int) -> bool:
+        """Whether a sequence of ``length`` tokens fits the pool now."""
+        need = -(-max(int(length), 1) // self.config.page_size)
+        return need <= len(self._free)
+
+    def reserve(self, slot: int, length: int) -> None:
+        """Ensure slot ``slot`` has pages for ``length`` tokens."""
+        c = self.config
+        if length > c.max_len:
+            raise ValueError(f"length {length} exceeds max_len {c.max_len}")
+        need = -(-int(length) // c.page_size)
+        have = int(self._allocated[slot])
+        if need > have:
+            if need - have > len(self._free):
+                raise RuntimeError(
+                    f"KV page pool exhausted: slot {slot} needs "
+                    f"{need - have} page(s), {len(self._free)} free")
+            for i in range(have, need):
+                self.page_table[slot, i] = self._free.pop()
+            self._allocated[slot] = need
+
+    def free_slot(self, slot: int) -> None:
+        """Return the slot's pages to the pool and mark it idle.  Page
+        CONTENTS are deliberately left in place: the masking contract,
+        not zeroing, is what guarantees no stale attention mass."""
+        n = int(self._allocated[slot])
+        for i in range(n - 1, -1, -1):
+            self._free.append(int(self.page_table[slot, i]))
+        self._allocated[slot] = 0
+        self.lengths[slot] = 0
+
+    # -- device writes -----------------------------------------------------
+    def write_prefill(self, slot: int, k_layers, v_layers) -> None:
+        """Scatter a prefilled prompt's K/V into the slot's pages.
+
+        ``k_layers``/``v_layers``: ``[num_layers, t, num_kv_heads,
+        head_dim]`` (post-RoPE, as the decode step expects).  Reserves
+        pages for ``t`` tokens and sets ``lengths[slot] = t``.
+        """
+        c = self.config
+        t = int(k_layers.shape[1])
+        self.reserve(slot, t)
+        pos = np.arange(t)
+        pages = jnp.asarray(self.page_table[slot][pos // c.page_size])
+        offs = jnp.asarray(pos % c.page_size)
+        dt = jnp.dtype(c.dtype)
+        # One scatter per pool: [L, t, H, D] lands at (page, off) pairs.
+        self.k = self.k.at[:, pages, offs].set(k_layers.astype(dt))
+        self.v = self.v.at[:, pages, offs].set(v_layers.astype(dt))
+        self.lengths[slot] = t
+
+    def grow(self, slot: int) -> None:
+        """Account one decoded token (the decode step already wrote its
+        K/V in-step); reserves the next page at a boundary crossing."""
+        new_len = int(self.lengths[slot]) + 1
+        self.reserve(slot, new_len)
+        self.lengths[slot] = new_len
+
+    # -- step operands -----------------------------------------------------
+    def table_device(self) -> jnp.ndarray:
+        # np.array copy matters: jnp.asarray of host numpy is zero-copy
+        # on CPU, so the device operand would ALIAS the mutable host
+        # table and later host updates would race the dispatched step.
+        return jnp.asarray(np.array(self.page_table))
+
+    def lengths_device(self) -> jnp.ndarray:
+        return jnp.asarray(np.array(self.lengths))
+
+    def layout(self) -> dict:
+        return self.config.layout()
+
+
+def cache_sharding(mesh, tp_axis: str = "tp"):
+    """NamedSharding splitting the kv-head dim over ``tp`` (dims:
+    layers, pages, page_size, kv_heads, head_dim)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(None, None, None, tp_axis, None))

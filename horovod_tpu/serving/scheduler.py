@@ -1,0 +1,179 @@
+"""Continuous-batching request scheduler.
+
+The decode step has a FIXED batch shape (``slots`` sequences), so
+throughput is a slot-occupancy game: the scheduler admits queued
+requests into free slots the moment one opens (no generation-boundary
+barriers -- "continuous" batching), recycles a slot the instant its
+request finishes, and evicts nothing by default (admission is gated on
+KV page availability via :meth:`PagedKVCache.can_admit`, so an admitted
+request can always run to completion).
+
+Lifecycle: ``queued -> prefill -> decode -> done``.  Every transition is
+instrumented through the PR 6 :class:`MetricsRegistry` --
+
+* ``horovod_serving_requests_total{event}`` -- submitted / admitted /
+  completed / rejected transitions,
+* ``horovod_serving_tokens_total{phase}`` -- prefill vs decode tokens,
+* ``horovod_serving_queue_depth`` / ``horovod_serving_batch_occupancy``
+  gauges,
+* ``horovod_serving_ttft_seconds`` / ``horovod_serving_token_latency_seconds``
+  histograms (time-to-first-token, per-output-token latency)
+
+-- the same families the bench serving block and ``serving_probe``
+scrape back out of ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..timeline.metrics import registry as _registry
+
+# Per-token decode latencies sit well under the default step buckets'
+# sweet spot; extend the low end so p50 lands inside a bucket.
+LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                   0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request moving through the serving lifecycle."""
+
+    rid: int
+    prompt: np.ndarray                 # int32 [t]
+    max_new_tokens: int
+    adapter_id: int = 0
+    arrival_s: float = 0.0             # open-loop arrival offset
+    state: str = "queued"              # queued|prefill|decode|done
+    slot: int = -1
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    admit_s: Optional[float] = None
+    first_token_s: Optional[float] = None
+    done_s: Optional[float] = None
+    token_latencies: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def prompt_len(self) -> int:
+        return int(len(self.prompt))
+
+    @property
+    def finished(self) -> bool:
+        return len(self.tokens) >= self.max_new_tokens
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_s is None:
+            return None
+        return self.first_token_s - self.arrival_s
+
+
+class ContinuousBatchScheduler:
+    """Admit/evict requests into a fixed-shape decode batch."""
+
+    def __init__(self, slots: int, cache=None):
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        self.slots = slots
+        self.cache = cache
+        self.queue: "collections.deque[Request]" = collections.deque()
+        self.active: dict[int, Request] = {}
+        self._free_slots = list(range(slots - 1, -1, -1))  # pop() -> 0, 1...
+        reg = _registry()
+        self._m_requests = reg.counter(
+            "horovod_serving_requests_total",
+            "Serving request lifecycle transitions", labelnames=("event",))
+        self._m_tokens = reg.counter(
+            "horovod_serving_tokens_total",
+            "Tokens processed by the serving engine", labelnames=("phase",))
+        self._m_queue = reg.gauge(
+            "horovod_serving_queue_depth", "Requests waiting for a slot")
+        self._m_occ = reg.gauge(
+            "horovod_serving_batch_occupancy",
+            "Live fraction of the fixed decode batch (0..1)")
+        self._m_ttft = reg.histogram(
+            "horovod_serving_ttft_seconds", "Time to first token",
+            buckets=LATENCY_BUCKETS)
+        self._m_tok_lat = reg.histogram(
+            "horovod_serving_token_latency_seconds",
+            "Per-output-token latency", buckets=LATENCY_BUCKETS)
+
+    # -- state gauges ------------------------------------------------------
+    @property
+    def occupancy(self) -> float:
+        return len(self.active) / self.slots
+
+    def _update_gauges(self) -> None:
+        self._m_queue.set(len(self.queue))
+        self._m_occ.set(self.occupancy)
+
+    # -- transitions -------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        """queued: request enters the wait queue (arrival already
+        happened from the load generator's point of view)."""
+        if req.prompt_len < 1:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        req.state = "queued"
+        self.queue.append(req)
+        self._m_requests.labels(event="submitted").inc()
+        self._update_gauges()
+
+    def admit(self, now_s: float) -> List[Tuple[int, Request]]:
+        """Move queued requests into free slots while pages allow.
+
+        FIFO admission: the head of the queue blocks (no head-of-line
+        bypass -- keeps TTFT ordering honest under overload).  Returns
+        ``(slot, request)`` pairs the engine must now prefill.
+        """
+        out: List[Tuple[int, Request]] = []
+        while self.queue and self._free_slots:
+            req = self.queue[0]
+            # +1: room for at least one generated token beyond the prompt.
+            if self.cache is not None and not self.cache.can_admit(
+                    req.prompt_len + 1):
+                break
+            self.queue.popleft()
+            slot = self._free_slots.pop()
+            req.slot = slot
+            req.state = "prefill"
+            req.admit_s = now_s
+            self.active[slot] = req
+            self._m_requests.labels(event="admitted").inc()
+            out.append((slot, req))
+        self._update_gauges()
+        return out
+
+    def note_prefill(self, req: Request, now_s: float) -> None:
+        """prefill done: the prompt's KV is resident and the first token
+        sampled -- the request joins the decode batch."""
+        req.state = "decode"
+        req.first_token_s = now_s
+        self._m_tokens.labels(phase="prefill").inc(req.prompt_len)
+        self._m_tokens.labels(phase="decode").inc()  # the sampled token
+        self._m_ttft.observe(max(now_s - req.arrival_s, 0.0))
+
+    def note_decode_token(self, req: Request, latency_s: float) -> None:
+        self._m_tokens.labels(phase="decode").inc()
+        self._m_tok_lat.observe(max(latency_s, 0.0))
+        req.token_latencies.append(latency_s)
+
+    def release(self, slot: int, now_s: float, *,
+                completed: bool = True) -> Request:
+        """done: recycle the slot (and its KV pages) immediately."""
+        req = self.active.pop(slot)
+        req.state = "done"
+        req.done_s = now_s
+        req.slot = -1
+        self._free_slots.append(slot)
+        if self.cache is not None:
+            self.cache.free_slot(slot)
+        self._m_requests.labels(
+            event="completed" if completed else "evicted").inc()
+        self._update_gauges()
+        return req
+
+    def has_work(self) -> bool:
+        return bool(self.queue or self.active)

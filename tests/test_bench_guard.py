@@ -1007,3 +1007,140 @@ def test_straggler_guard_trips_on_bad_entries(tmp_path):
     assert "dominant_span" in why
     assert "merged_ranks" in why
     assert "vs_baseline" in why
+
+
+# ---------------------------------------------------------------------------
+# Serving entries (PR 10)
+# ---------------------------------------------------------------------------
+
+def scan_serving_entries(bench_dir):
+    """Return [(path, why), ...] for malformed serving entries.
+
+    A serving entry records the continuous-batching inference drill
+    (BENCH_SERVING=1): tokens/s under the seeded open-loop load, p50/p99
+    TTFT and per-token latency, and mean batch occupancy.  Throughput
+    must be positive and consistent with the headline value, every
+    percentile pair must be ordered, occupancy must be a fraction of the
+    fixed batch, all admitted requests must complete, and vs_baseline
+    must be null (a CPU-mesh serving drill has no throughput peer)."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            sv = parsed.get("serving")
+            if not sv:
+                continue
+            tps = sv.get("tokens_per_s")
+            if not (isinstance(tps, (int, float)) and tps > 0):
+                bad.append((path, f"tokens_per_s must be > 0, got {tps!r}"))
+            elif parsed.get("value") != tps:
+                bad.append((path, f"headline value {parsed.get('value')!r}"
+                                  f" != serving.tokens_per_s {tps!r}"))
+            for p50k, p99k in (("ttft_p50_ms", "ttft_p99_ms"),
+                               ("token_latency_p50_ms",
+                                "token_latency_p99_ms")):
+                p50, p99 = sv.get(p50k), sv.get(p99k)
+                if not (isinstance(p50, (int, float))
+                        and isinstance(p99, (int, float))
+                        and 0 <= p50 <= p99):
+                    bad.append((path, f"latency pair {p50k}/{p99k} must "
+                                      f"satisfy 0 <= p50 <= p99, got "
+                                      f"{p50!r}/{p99!r}"))
+            occ = sv.get("batch_occupancy")
+            if not (isinstance(occ, (int, float)) and 0 < occ <= 1):
+                bad.append((path, f"batch_occupancy must be in (0, 1], "
+                                  f"got {occ!r}"))
+            n_req, done = sv.get("requests"), sv.get("completed")
+            rejected = sv.get("rejected", 0)
+            if not isinstance(n_req, int) or done != n_req - rejected:
+                bad.append((path, f"completed {done!r} != requests "
+                                  f"{n_req!r} - rejected {rejected!r}: "
+                                  f"the drill dropped requests"))
+            slots = sv.get("slots")
+            if not isinstance(slots, int) or slots < 1:
+                bad.append((path, f"slots must be an int >= 1, "
+                                  f"got {slots!r}"))
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "serving entries must carry a null "
+                                  "vs_baseline on the CPU mesh"))
+    return bad
+
+
+def test_committed_serving_entries_well_formed():
+    assert scan_serving_entries(REPO) == []
+
+
+def test_committed_serving_round_exists():
+    """Acceptance gate: a committed bench round must record the serving
+    drill with tokens/s and both latency percentile pairs."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            sv = (entry.get("parsed") or {}).get("serving")
+            if sv:
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries a serving block"
+    for path, parsed in found:
+        sv = parsed["serving"]
+        assert parsed["metric"] == "serving_tokens_per_sec", path
+        assert sv["tokens_per_s"] > 0, (path, sv)
+        assert sv["ttft_p50_ms"] <= sv["ttft_p99_ms"], (path, sv)
+        assert sv["token_latency_p50_ms"] <= \
+            sv["token_latency_p99_ms"], (path, sv)
+
+
+def _write_serving(tmp_path, name, sv, vs_baseline=None, value=None):
+    parsed = {"metric": "serving_tokens_per_sec",
+              "value": sv.get("tokens_per_s") if value is None else value,
+              "unit": "tokens/s", "vs_baseline": vs_baseline,
+              "config": "llama_serve_w8_slots8",
+              "baseline_config": "llama_serve_w8_slots8", "serving": sv}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 11, "cmd": "BENCH_SERVING=1 bench.py", "rc": 0, "tail": "",
+         "parsed": parsed}))
+
+
+def _good_serving_block():
+    return {"world": 8, "slots": 8, "requests": 24, "completed": 24,
+            "rejected": 0, "prompt_tokens": 224, "new_tokens": 140,
+            "decode_steps": 41, "tokens_per_s": 262.95,
+            "ttft_p50_ms": 13.3, "ttft_p99_ms": 24.9,
+            "token_latency_p50_ms": 7.9, "token_latency_p99_ms": 10.2,
+            "batch_occupancy": 0.35}
+
+
+def test_serving_guard_accepts_good_entry(tmp_path):
+    _write_serving(tmp_path, "BENCH_r90.json", _good_serving_block())
+    assert scan_serving_entries(str(tmp_path)) == []
+
+
+def test_serving_guard_trips_on_bad_entries(tmp_path):
+    bad = _good_serving_block()
+    bad.update({"tokens_per_s": 0.0,          # no throughput
+                "ttft_p50_ms": 30.0,          # p50 > p99
+                "batch_occupancy": 1.5,       # beyond the fixed batch
+                "completed": 20,              # dropped requests
+                "slots": 0})                  # no batch
+    _write_serving(tmp_path, "BENCH_r91.json", bad)
+    _write_serving(tmp_path, "BENCH_r92.json", _good_serving_block(),
+                   vs_baseline=1.0)           # must be null on CPU
+    _write_serving(tmp_path, "BENCH_r93.json", _good_serving_block(),
+                   value=999.0)               # headline/block mismatch
+    why = " ".join(w for _, w in scan_serving_entries(str(tmp_path)))
+    assert "tokens_per_s must be > 0" in why
+    assert "p50 <= p99" in why
+    assert "batch_occupancy" in why
+    assert "dropped requests" in why
+    assert "slots" in why
+    assert "vs_baseline" in why
+    assert "headline value" in why

@@ -102,6 +102,34 @@ def test_straggler_probe_example_cpu(tmp_path):
 
 
 @pytest.mark.integration
+def test_llama_lora_multi_adapter_serving_cpu():
+    """Three LoRA adapters share one base model in a single decode
+    batch; each slot's stream must match a dedicated engine running
+    that adapter merged into the base weights (asserted internally)."""
+    out = _run([os.path.join(REPO, "examples", "llama_lora.py"),
+                "--serve-adapters", "3", "--cpu-devices", "1"])
+    assert "multi-LoRA serve OK: 3 adapters" in out
+    assert "adapter 2: 10 tokens match merged-weight reference" in out
+
+
+@pytest.mark.integration
+def test_serving_probe_example_cpu(tmp_path):
+    """8-device virtual-mesh serving drill: the probe scrapes its own
+    /metrics endpoint and asserts the request-lifecycle families and
+    span attribution (internally); the bench entry is validated here."""
+    bench = tmp_path / "BENCH_r98.json"
+    out = _run([os.path.join(REPO, "examples", "serving_probe.py"),
+                "--requests", "12", "--bench-json", str(bench)])
+    assert "serving probe OK" in out
+    assert "tokens/s" in out
+    doc = json.loads(bench.read_text())
+    sv = doc["parsed"]["serving"]
+    assert sv["world"] == 8 and sv["completed"] == sv["requests"]
+    from test_bench_guard import scan_serving_entries
+    assert scan_serving_entries(str(tmp_path)) == []
+
+
+@pytest.mark.integration
 def test_torch_resnet50_example_cpu():
     out = _run([os.path.join(REPO, "examples", "torch_resnet50.py"),
                 "--cpu-devices", "2", "--image-size", "64",
