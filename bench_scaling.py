@@ -40,6 +40,13 @@ Usage::
                          # vs the uncompressed exchange.  (topk is bench.py
                          # -only: its allgather wire grows with n, so the
                          # mesh-invariance gate does not apply.)
+    python bench_scaling.py --models rn50-hier --ns 64 256
+                         # two-level ICI x DCN exchange (fp8 on the DCN
+                         # leg only): per-leg bytes recorded at trace
+                         # time must equal the plan_hier_legs closed
+                         # form, and -- both meshes sharing the 32-chip
+                         # ICI extent -- be identical across mesh sizes;
+                         # the DCN hop must ride under the flat-AR wire
     python bench_scaling.py --worker rn50 8  # (internal) one subprocess
 
 Prints one summary JSON line (machine-readable gate) after the tables.
@@ -97,6 +104,16 @@ POWERSGD_RANK = 4
 PARITY_STEPS = 30
 PARITY_BOUND = 1.25
 
+# Two-level exchange variant (--models rn50-hier --ns 64 256): virtual
+# (dcn, ici) meshes sharing one ICI extent -- 64 = 2x32, 256 = 8x32 --
+# so the padding quantum (lcm(256, n_ici)) and with it EVERY per-leg
+# payload is identical across mesh sizes: the hier mesh-invariance gate
+# is exact equality on per-leg bytes, not a tolerance band.  The DCN
+# hop rides the fp8 codec (the contended-cross-slice configuration the
+# autotuner's hierarchical axis selects); ICI legs stay full precision.
+HIER_ICI = 32
+HIER_DCN_CODEC = "fp8"
+
 # CNN cases: (constructor kwargs, image size).  Spatial size does not
 # affect gradient payload EXCEPT for VGG (the 224x224 fc1 holds most of
 # its 138M params), so VGG compiles at full resolution; Inception needs
@@ -145,6 +162,9 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
     if model.endswith("-powersgd"):
         cnn_base = model[:-len("-powersgd")]
         efspec = f"powersgd:{POWERSGD_RANK}"
+    hier = model.endswith("-hier")
+    if hier:
+        cnn_base = model[:-len("-hier")]
     if cnn_base in _CNN_CASES:
         from horovod_tpu import models as zoo
         # fp32 params = the bench configuration's wire dtype; the -fp8
@@ -171,10 +191,15 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
             jax.random.PRNGKey(0))
         params = variables["params"]
         stats = variables.get("batch_stats", {})
+        if hier:
+            # Per-leg codec: full-precision ICI legs, fp8 on the DCN hop
+            # only (the two-level exchange's reason to exist).
+            comp_arg = f"ici:none,dcn:{HIER_DCN_CODEC}"
+        else:
+            comp_arg = efspec or (hvd.Compression.fp8 if fp8
+                                  else hvd.Compression.none)
         opt = hvd.DistributedOptimizer(
-            optax.sgd(0.1, momentum=0.9),
-            compression=efspec or (hvd.Compression.fp8 if fp8
-                                   else hvd.Compression.none))
+            optax.sgd(0.1, momentum=0.9), compression=comp_arg)
         opt_state = jax.eval_shape(opt.init, params)
         step = make_flax_train_step(
             m.apply, opt, microbatches=OVERLAP_K if overlap else None)
@@ -205,7 +230,9 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         buckets = len(plan_buckets(grad_leaves).buffers)
         stats_bytes = sum(l.size * l.dtype.itemsize
                           for l in jax.tree.leaves(stats))
-        if fp8:
+        if fp8 or hier:
+            # hier: the bucket exchange is RS + gathers, never an AR;
+            # the gate on its structure lives in the hier rows below.
             expected_emitted = None
         elif efspec:
             # PowerSGD: TWO factor psums per bucket (P, then the
@@ -254,6 +281,22 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
                                    jnp.dtype(dt).itemsize, n)
                 for dt, lspecs in plan_buckets(grad_leaves).buffers) \
                 + stats_bytes + 4
+        elif hier:
+            # Per-leg closed form from the SAME planner the runtime's
+            # spans.note_leg accounting mirrors: padded bucket at f32 on
+            # both ICI legs, the 1/n_ici shard at one byte/element on
+            # the fp8 DCN hop.  Bucket sums are mesh-invariant because
+            # every bench mesh shares HIER_ICI.
+            from horovod_tpu.controller.fusion import plan_hier_legs
+            hier_legs = {}
+            for dt, lspecs in plan_buckets(grad_leaves).buffers:
+                bsize = sum(s.size for s in lspecs)
+                for leg in plan_hier_legs(
+                        bsize, dt, n_dcn=n // HIER_ICI, n_ici=HIER_ICI,
+                        compression=f"ici:none,dcn:{HIER_DCN_CODEC}"):
+                    hier_legs[leg.tag] = hier_legs.get(leg.tag, 0) \
+                        + leg.nbytes
+            payload = sum(hier_legs.values()) + stats_bytes + 4
         else:
             payload = grad_bytes + stats_bytes + 4
     elif model in ("bert-large", "bert-base", "bert-tiny",
@@ -391,6 +434,12 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         expected["uncompressed_payload_bytes"] = \
             sum(l.size * l.dtype.itemsize for l in grad_leaves) \
             + stats_bytes + 4
+    if hier:
+        expected["hier_legs_planned"] = hier_legs
+        # What a FLAT allreduce of the same buckets would put on every
+        # link -- DCN included: the wire the two-level decomposition plus
+        # the DCN codec exists to undercut on the slow cross-slice hop.
+        expected["flat_allreduce_bytes"] = grad_bytes
     return step, args, expected
 
 
@@ -433,10 +482,32 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
     else:
         from horovod_tpu.utils.platform import force_host_device_count
         force_host_device_count(n, cpu=True)
-        hvd.init()
+        if model.endswith("-hier"):
+            from horovod_tpu.parallel.mesh import build_mesh
+            if n % HIER_ICI:
+                raise SystemExit(
+                    f"-hier meshes are (n/{HIER_ICI}, {HIER_ICI}); "
+                    f"n={n} does not divide")
+            hvd.init(mesh=build_mesh(jax.devices()[:n], hierarchical=True,
+                                     dcn_size=n // HIER_ICI))
+        else:
+            hvd.init()
         step, args, expected = _build_case(model, n)
     assert hvd.size() == n, (hvd.size(), n)
     lowered = step.lower(*args)
+    hier_block = None
+    if model.endswith("-hier"):
+        # spans.note_leg fires at trace time (once per bucket per leg),
+        # so after .lower() the recorder's registry holds the exchange's
+        # OWN byte accounting -- the numbers the gate compares against
+        # the plan_hier_legs closed form.
+        from horovod_tpu.timeline.spans import recorder
+        hier_block = {
+            "mesh": [n // HIER_ICI, HIER_ICI],
+            "legs_recorded": {
+                k: int(v["nbytes"]) for k, v in recorder().legs.items()
+                if k.startswith("hier/")},
+        }
     emitted = scaling.emitted_collective_stats(lowered.as_text())
     compiled = lowered.compile()
     text = compiled.as_text()
@@ -479,6 +550,7 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
         "equivalent_allreduce_payload": eq_payload,
         "donation": scaling.has_buffer_donation(text),
         "schedule": schedule,
+        "hier": hier_block,
         **expected,
     }), flush=True)
 
@@ -566,7 +638,12 @@ def _spawn(model: str, n: int, timeout: int = 2400,
                         # the optimizer argument, never the environment.
                         "HOROVOD_COMPRESSION", "HVD_TPU_COMPRESSION",
                         "HOROVOD_EF_RESIDUAL", "HVD_TPU_EF_RESIDUAL",
-                        "HOROVOD_AUTOTUNE_CODEC", "HVD_TPU_AUTOTUNE_CODEC")}
+                        "HOROVOD_AUTOTUNE_CODEC", "HVD_TPU_AUTOTUNE_CODEC",
+                        # The -hier worker builds its own two-level mesh;
+                        # an ambient topology spec or autotuner hier axis
+                        # must not re-mesh the flat baseline rows.
+                        "HOROVOD_HIERARCHICAL", "HVD_TPU_HIERARCHICAL",
+                        "HOROVOD_AUTOTUNE_HIER", "HVD_TPU_AUTOTUNE_HIER")}
     cmd = [sys.executable, os.path.abspath(__file__),
            "--parity" if parity else "--worker", model, str(n)]
     if topology:
@@ -579,6 +656,103 @@ def _spawn(model: str, n: int, timeout: int = 2400,
             f"worker {model}@{n} failed:\n{proc.stdout[-2000:]}\n"
             f"{proc.stderr[-2000:]}")
     return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _gate_hier(model, rows, summary) -> bool:
+    """Gates for the two-level (-hier) rows.
+
+    H1: the bytes the exchange registered at trace time (spans.note_leg)
+    equal the ``plan_hier_legs`` closed form, leg by leg.  H2: those
+    per-leg payloads are IDENTICAL across mesh sizes (the meshes share
+    the ICI extent, so padding, shard width, and codec wire all cancel
+    -- any drift means the exchange picked up a mesh-shape dependence).
+    H3: the emitted StableHLO carries the planned structure -- one
+    reduce-scatter plus three all-gathers per bucket (quantized shard +
+    scale over DCN, finalize over ICI), zero bucket all-reduces.  H4:
+    the DCN hop's wire sits under what a flat allreduce would put on the
+    same cross-slice links.
+    """
+    ok = True
+    planned0 = rows[0]["hier_legs_planned"]
+    flat = rows[0]["flat_allreduce_bytes"]
+    buckets = rows[0]["buckets"]
+    legs_match = invariant = True
+    for r in rows:
+        if r["hier"]["legs_recorded"] != r["hier_legs_planned"]:
+            ok = legs_match = False
+            print(f"FAIL: n={r['n']} recorded legs "
+                  f"{r['hier']['legs_recorded']} != planner closed form "
+                  f"{r['hier_legs_planned']}")
+        if r["hier_legs_planned"] != planned0:
+            ok = invariant = False
+            print(f"FAIL: per-leg payloads vary with the mesh: "
+                  f"n={r['n']} {r['hier_legs_planned']} != "
+                  f"n={rows[0]['n']} {planned0}")
+        rs = r["emitted"]["counts"].get("reduce-scatter", 0)
+        ag = r["emitted"]["counts"].get("all-gather", 0)
+        if rs != buckets or ag != 3 * buckets:
+            ok = False
+            print(f"FAIL: n={r['n']} emitted {rs} reduce-scatters / {ag} "
+                  f"all-gathers; the {buckets}-bucket plan needs "
+                  f"{buckets} / {3 * buckets}")
+    dcn = planned0.get("hier/dcn_ar", 0)
+    ratio = flat / dcn if dcn else 0.0
+    if not 0 < dcn < flat:
+        ok = False
+        print(f"FAIL: DCN leg {dcn} B not under the flat-AR wire "
+              f"{flat} B")
+    for leg in sorted(planned0):
+        print(f"- {leg}: {planned0[leg]/2**20:.2f} MiB/step "
+              f"(mesh-invariant, == planner closed form)")
+    print(f"- DCN hop vs flat AR on the cross-slice links: "
+          f"{dcn/2**20:.2f} MiB vs {flat/2**20:.1f} MiB "
+          f"({ratio:.1f}x reduction)")
+    summary[model] = {
+        "dcn_codec": HIER_DCN_CODEC,
+        "ns": [r["n"] for r in rows],
+        "meshes": {str(r["n"]): r["hier"]["mesh"] for r in rows},
+        "legs": planned0,
+        "total_wire_bytes": sum(planned0.values()),
+        "flat_allreduce_bytes": flat,
+        "dcn_vs_flat_ratio": round(ratio, 2),
+        "legs_match_plan": legs_match,
+        "mesh_invariant": invariant,
+        "buckets": buckets,
+    }
+    return ok
+
+
+def _write_hier_round(args, hs, ok) -> None:
+    """``--out BENCH_r<k>.json`` after a -hier run: emit the round record
+    shape bench.py --trajectory and tests/test_bench_guard.py consume."""
+    import re
+    m = re.search(r"r(\d+)", os.path.basename(args.out))
+    dcn, flat = hs["legs"]["hier/dcn_ar"], hs["flat_allreduce_bytes"]
+    rec = {
+        "n": int(m.group(1)) if m else 0,
+        "cmd": "JAX_PLATFORMS=cpu python bench_scaling.py --models "
+               + " ".join(args.models)
+               + " --ns " + " ".join(str(n) for n in args.ns),
+        "rc": 0 if ok else 1,
+        "tail": f"hier exchange: DCN leg {dcn/2**20:.2f} MiB vs "
+                f"{flat/2**20:.1f} MiB flat AR "
+                f"({hs['dcn_vs_flat_ratio']}x); per-leg bytes match "
+                f"plan_hier_legs on n={args.ns}",
+        "parsed": {
+            "metric": "hier_dcn_wire_reduction",
+            "value": hs["dcn_vs_flat_ratio"], "unit": "x",
+            # A virtual-CPU wire drill is never throughput-comparable to
+            # the measured baseline config.
+            "vs_baseline": None,
+            "config": f"rn50_hier_ici{HIER_ICI}_{HIER_DCN_CODEC}dcn",
+            "baseline_config": "batch256_s2d_bf16",
+            "hier": hs,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(f"wrote {args.out}")
 
 
 def run_topology_mode(args) -> int:
@@ -719,6 +893,17 @@ def main() -> int:
                   f"| {r['wire_link_bytes']/2**20:.1f} MiB "
                   f"| {r['equivalent_allreduce_payload']/2**20:.1f} MiB "
                   f"| {r['donation']} |")
+        if model.endswith("-hier"):
+            # Two-level rows gate on per-leg equality with the planner
+            # (exact), not the flat eq-AR drift band: the generic wire
+            # normalization assumes every collective spans the full
+            # mesh, which the whole point of the hier exchange is not
+            # to do.  Donation must still hold.
+            ok &= _gate_hier(model, rows, summary)
+            if not all(r["donation"] for r in rows):
+                ok = False
+                print("FAIL: buffer donation missing")
+            continue
         # Gate 1: payload matches the fusion planner's prediction.
         drift = abs(payloads[0] - predicted) / predicted
         if drift > args.tolerance:
@@ -803,6 +988,10 @@ def main() -> int:
     print()
     print(json.dumps({"metric": "scaling_evidence", "ok": ok,
                       "models": summary}), flush=True)
+    if args.out:
+        hier_models = [m for m in summary if m.endswith("-hier")]
+        if hier_models:
+            _write_hier_round(args, summary[hier_models[0]], ok)
     return 0 if ok else 1
 
 

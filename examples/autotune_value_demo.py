@@ -1,37 +1,41 @@
-"""Autotune value demo: the tuner discovers fp8 + hierarchical allreduce
-when the link budget rewards them -- and rejects them when it doesn't.
+"""Autotune value demo: the tuner discovers the two-level exchange with
+fp8 on the DCN hop when the link budget rewards it -- and rejects it when
+it doesn't.
 
 The autotuner's job (SURVEY.md 5.6, ``ParameterManager``) is to pick
 exchange knobs the user would otherwise hand-tune per topology.  This
 demo makes that value visible WITHOUT a physical two-level pod: an
 8-device virtual mesh is built as a (2 dcn x 4 ici) two-level topology
-(opening the hierarchical axis), the compression axis is opted in, and
-each sampled configuration is "timed" by an injected per-link bandwidth
-model instead of a wall clock -- an analytic ring/tree cost:
+(opening the hierarchical axis), the per-leg DCN codec axis is opted in
+(``HOROVOD_AUTOTUNE_HIER=1``), and each sampled configuration is "timed"
+by the per-link bandwidth model the autotune module exposes
+(:func:`horovod_tpu.autotune.modeled_exchange_seconds`) instead of a wall
+clock -- an analytic ring/tree cost:
 
 * flat allreduce moves ``2 (n-1)/n * bytes`` over the SLOWEST link the
   flat ring crosses (a flat ring over a two-level topology is throttled
   by its inter-island hops);
-* hierarchical moves ``2 (g-1)/g * bytes`` over ICI, then
-  ``2 (d-1)/d * bytes/g`` over DCN (the reduced payload crosses the slow
-  tier once per island, not once per chip), paying one extra phase
-  launch;
-* a lossy codec scales wire bytes (bf16/fp16 = 1/2, fp8 = 1/4) and pays
-  a fixed quantize cost per step.
+* hierarchical moves the FULL payload over ICI (``2 (g-1)/g * bytes``,
+  full precision) and only the ``bytes/g`` shard over DCN
+  (``2 (d-1)/d``), with the sampled DCN-leg codec scaling just that
+  hop's wire bytes (bf16/fp16 = 1/2, fp8 = 1/4) and paying a fixed
+  quantize cost per step, plus one extra phase launch per leg.
 
 Two scenarios bracket the decision:
 
 * ``contended_dcn``   -- 97 MiB gradients (RN50-scale), 40 GB/s ICI vs
-  1 GB/s DCN: wire time dominates, so the tuner should lock
-  hierarchical=1 + fp8 (the cheapest wire bytes over the slow tier);
+  1 GB/s DCN: the cross-slice wire dominates, so the tuner should lock
+  hierarchical=1 + fp8-on-DCN (the cheapest wire bytes over the slow
+  tier);
 * ``uniform_fast``    -- 4 MiB gradients, every link 40 GB/s, quantize
   5 ms: the wire is nearly free, so the codec's quantize cost and the
   second phase launch can only LOSE -- the tuner should lock
   hierarchical=0 + no codec.
 
-The cold-start tuner (no warm-start log) samples the 8-config grid
-(hier x codec) exhaustively and locks the modeled winner in each
-scenario.  ``python examples/autotune_value_demo.py`` writes the
+The cold-start tuner (no warm-start log) samples the 5-config grid
+(flat, plus hier x 4 DCN codecs -- the grid prunes DCN codecs without
+the hierarchical schedule) exhaustively and locks the modeled winner in
+each scenario.  ``python examples/autotune_value_demo.py`` writes the
 selections + the full modeled cost table to ``AUTOTUNE_DEMO.json``;
 ``tests/test_autotune.py`` asserts the selections.
 """
@@ -71,9 +75,12 @@ _CODEC_SCALE = {"none": 1.0, "bf16": 0.5, "fp16": 0.5, "fp8": 0.25}
 
 def codec_name(compression) -> str:
     """Map a Compression codec (or None = configured default) to the
-    demo's scale-table key."""
+    demo's scale-table key.  Per-leg composites report their DCN leg --
+    that is the hop the bandwidth model prices the codec on."""
     if compression is None:
         return "none"
+    if getattr(compression, "wire_format", "") == "hier_legs":
+        compression = compression.dcn
     name = compression.__name__.lower()
     for k in ("bf16", "fp16", "fp8"):
         if k in name:
@@ -82,21 +89,26 @@ def codec_name(compression) -> str:
 
 
 def modeled_step_seconds(hierarchical: bool, codec: str, sc: dict) -> float:
-    """Analytic exchange time for one step under the scenario's links."""
-    n = DCN_GROUPS * ICI_GROUP
-    wire = sc["payload_bytes"] * _CODEC_SCALE[codec]
+    """Analytic exchange time for one step under the scenario's links.
+
+    ``codec`` is the DCN-leg codec for hierarchical configurations (the
+    ICI legs stay full precision -- the real exchange's per-leg
+    contract) and the whole-exchange codec for flat ones.
+    """
+    from horovod_tpu.autotune import modeled_exchange_seconds
+    scale = _CODEC_SCALE[codec]
+    quant = sc["quantize_s"] if codec != "none" else 0.0
     if hierarchical:
-        g, d = ICI_GROUP, DCN_GROUPS
-        t = (2 * (g - 1) / g * wire / sc["ici_bw"]
-             + 2 * (d - 1) / d * (wire / g) / sc["dcn_bw"]
-             + 2 * sc["phase_overhead_s"])
-    else:
-        # The flat ring crosses the slowest tier with the FULL payload.
-        t = (2 * (n - 1) / n * wire / min(sc["ici_bw"], sc["dcn_bw"])
-             + sc["phase_overhead_s"])
-    if codec != "none":
-        t += sc["quantize_s"]
-    return t
+        return modeled_exchange_seconds(
+            sc["payload_bytes"], n_dcn=DCN_GROUPS, n_ici=ICI_GROUP,
+            hierarchical=True, ici_bw=sc["ici_bw"], dcn_bw=sc["dcn_bw"],
+            ici_wire_scale=1.0, dcn_wire_scale=scale, quantize_s=quant,
+            phase_overhead_s=sc["phase_overhead_s"])
+    return modeled_exchange_seconds(
+        sc["payload_bytes"], n_dcn=DCN_GROUPS, n_ici=ICI_GROUP,
+        hierarchical=False, ici_bw=sc["ici_bw"], dcn_bw=sc["dcn_bw"],
+        ici_wire_scale=scale, quantize_s=quant,
+        phase_overhead_s=sc["phase_overhead_s"])
 
 
 def cost_table(sc: dict) -> dict:
@@ -114,19 +126,20 @@ def run_scenario(name: str) -> dict:
     sc = SCENARIOS[name]
     assert _mesh_is_two_level(), \
         "run_scenario needs an initialized (dcn, ici) mesh"
-    os.environ["HOROVOD_AUTOTUNE_COMPRESSION"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_HIER"] = "1"
     try:
-        # One pinned threshold x pinned cycle x hier{0,1} x 4 codecs: an
-        # 8-config grid sampled exhaustively (max_samples=8).  The cycle
-        # axis is pinned explicitly -- the tuner otherwise widens it
-        # whenever the torch shim is resident in the process (e.g. under
-        # a full pytest collection), and a 24-config grid would outrun
-        # the exhaustive 8-sample budget.
+        # One pinned threshold x pinned cycle x {flat, hier x 4 DCN
+        # codecs}: a 5-config grid sampled exhaustively (max_samples=5).
+        # The cycle axis is pinned explicitly -- the tuner otherwise
+        # widens it whenever the torch shim is resident in the process
+        # (e.g. under a full pytest collection), and a wider grid would
+        # outrun the exhaustive sample budget.
         cfg = Config(autotune=True)
         tuner = Autotuner(cfg, steps_per_sample=1,
-                          candidates=[64 * _MiB], max_samples=8,
+                          candidates=[64 * _MiB], max_samples=5,
                           cycle_candidates=[cfg.cycle_time])
-        assert len(tuner.grid) == 8, len(tuner.grid)
+        assert tuner.tunes_hier_codec
+        assert len(tuner.grid) == 5, len(tuner.grid)
         guard = 0
         while not tuner.done and guard < 100:
             t = modeled_step_seconds(
@@ -136,7 +149,7 @@ def run_scenario(name: str) -> dict:
             guard += 1
         assert tuner.done, "tuner failed to lock within the guard budget"
     finally:
-        del os.environ["HOROVOD_AUTOTUNE_COMPRESSION"]
+        del os.environ["HOROVOD_AUTOTUNE_HIER"]
     picked = {"hierarchical": int(tuner.hierarchical_explicit()),
               "codec": codec_name(tuner.compression_override(None))}
     return {"scenario": name,

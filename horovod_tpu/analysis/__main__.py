@@ -3,9 +3,11 @@
 Modes (default ``--all``):
 
 - ``--lint``: AST rules over the ``horovod_tpu/`` source tree;
-- ``--step-audit``: trace-audit the four reference step configurations
-  (plain DP, ZeRO-1, powersgd+EF, microbatches=2) on a virtual CPU mesh
-  and cross-check emitted collectives against their plans;
+- ``--step-audit``: trace-audit the reference step configurations
+  (plain DP, ZeRO-1, powersgd+EF, microbatches=2 on the flat mesh, then
+  the hierarchical trio -- plain hier, hier+ZeRO-1, hier+EF-on-DCN -- on
+  a two-level remesh of the same virtual CPU devices) and cross-check
+  emitted collectives against their plans;
 - ``--all``: both.
 
 Findings matching ``analysis_baseline.txt`` (``--baseline`` to override)
@@ -50,18 +52,32 @@ def _parse_args(argv):
 
 
 def _run_step_audit(devices: int):
-    """Audit the reference configs on a forced-CPU virtual mesh.  Must
-    run before any jax backend initialization in this process."""
+    """Audit the reference configs on a forced-CPU virtual mesh (flat
+    pass, then the hierarchical configs on a two-level remesh of the same
+    devices).  Must run before any jax backend initialization in this
+    process."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from ..utils.platform import force_host_device_count
     force_host_device_count(devices, cpu=True)
     import horovod_tpu as hvd
     hvd.init()
-    from .trace_audit import audit_standard_configs
+    from .trace_audit import HIER_CONFIGS, audit_standard_configs
     try:
-        return audit_standard_configs()
+        reports = audit_standard_configs()
     finally:
         hvd.shutdown()
+    if devices >= 4 and devices % 2 == 0:
+        # Second pass: the same devices as a (2, n/2) two-level
+        # communicator -- plain hier, hier+ZeRO-1, hier+EF-on-DCN.
+        import jax
+        from ..parallel.mesh import build_mesh
+        hvd.init(mesh=build_mesh(jax.devices()[:devices],
+                                 hierarchical=True, dcn_size=2))
+        try:
+            reports.update(audit_standard_configs(HIER_CONFIGS))
+        finally:
+            hvd.shutdown()
+    return reports
 
 
 def main(argv=None) -> int:

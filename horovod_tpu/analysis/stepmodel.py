@@ -24,7 +24,15 @@ Width references:
   dtype;
 - microbatches=k: per reverse-planned bucket, k ``reduce_scatter`` legs
   of the ``lcm(256, n)``-padded bucket plus one closing ``all_gather``
-  of ``padded / n`` elements, all at the wire dtype.
+  of ``padded / n`` elements, all at the wire dtype;
+- hierarchical (two-level ``(dcn, ici)`` mesh): per bucket one
+  ``reduce_scatter`` of the ``lcm(256, n_ici)``-padded bucket over ICI,
+  the DCN hop of the ``padded / n_ici`` shard under the DCN-leg codec
+  (psum / powersgd P+Q / topk gathers / fp8 quantized gather), and one
+  closing ICI ``all_gather`` of the shard;
+- chunked: per wire-buffer chunk (``chunk_bytes / wire_itemsize``
+  elements, rounded up to a multiple of n) one ``reduce_scatter`` of the
+  padded piece plus one ``all_gather`` of ``piece / n`` elements.
 """
 
 from __future__ import annotations
@@ -103,11 +111,8 @@ def _expected_world1(params, meta: dict) -> ExpectedExchange:
     if is_error_feedback(comp) or is_fp8(comp):
         return _unsupported((f"world=1 {comp.__name__} exchange: unmodeled "
                              "degenerate codec path",))
-    from ..controller.fusion import exchange_chunk_bytes
-    from ..core.state import global_state
-    st = global_state()
-    if (st.config and st.config.hierarchical_allreduce) \
-            or exchange_chunk_bytes() > 0:
+    from ..controller.fusion import exchange_chunk_bytes, hier_requested
+    if hier_requested(comp) or exchange_chunk_bytes() > 0:
         return _unsupported(("world=1 chunked/hierarchical exchange: "
                              "unmodeled degenerate decomposition",))
     leaves = jax.tree.leaves(params)
@@ -165,32 +170,137 @@ def expected_exchange(params, meta: dict) -> ExpectedExchange:
     notes = []
     if exchange.get("process_set") is not None:
         notes.append("process-set reduction")
-    from ..collectives.reduce_op import Adasum
-    if exchange.get("op") is Adasum:
+    from ..collectives.reduce_op import Adasum, Average, Sum
+    from ..collectives.compression import is_hier_legs
+    from ..controller.fusion import hier_mesh_shape, hier_requested
+    op = exchange.get("op") or Average
+    if op is Adasum:
         notes.append("Adasum exchange")
-    if is_fp8(comp):
-        notes.append("fp8 exchange")
-    st = global_state()
-    if (st.config and st.config.hierarchical_allreduce
-            and not is_error_feedback(comp)):
-        notes.append("hierarchical allreduce")
-    if exchange_chunk_bytes() > 0 and not is_error_feedback(comp):
-        notes.append("chunked exchange")
     if notes:
         return _unsupported(f"unmodeled exchange path: {n}" for n in notes)
 
+    hier_shape = hier_mesh_shape()
+    hier = (hier_requested(comp) and hier_shape is not None
+            and op in (Sum, Average))
     thr = exchange["fusion_threshold"]
     if is_error_feedback(comp):
+        if is_hier_legs(comp) and hier_shape is None:
+            return _unsupported(("per-leg EF codec on a flat mesh: the "
+                                 "runtime raises (needs the (dcn, ici) "
+                                 "communicator)",))
         rows = explain_plan(params, threshold_bytes=_dist._ef_threshold(thr),
                             compression=comp, register=False)
-        return ExpectedExchange(ops=_ef_ops(rows, comp), plan_rows=rows)
+        ops = _ef_ops(rows, comp,
+                      hier_shape=hier_shape if is_hier_legs(comp) else None)
+        return ExpectedExchange(ops=ops, plan_rows=rows)
+    if is_fp8(comp):
+        return _unsupported(("unmodeled exchange path: fp8 exchange",))
     rows = explain_plan(params, threshold_bytes=thr, compression=comp,
                         register=False)
+    if hier:
+        n_dcn, n_ici = hier_shape
+        ops = []
+        for r in rows:
+            ops += _hier_bucket_ops(
+                f"bucket{r['bucket']}({r['dtype']})", r["elements"],
+                r["dtype"], comp, n_dcn, n_ici)
+        return ExpectedExchange(ops=ops, plan_rows=rows, notes=(
+            f"two-level exchange on the ({n_dcn}, {n_ici}) mesh",))
+    if is_hier_legs(comp):
+        # Flat-mesh degrade: the DCN hop is vacuous, the psum-compatible
+        # ICI codec rides the flat exchange (collective() parity).
+        ops = [ExpectedOp("psum", _wire_dtype(comp.ici, r["dtype"]),
+                          r["elements"],
+                          f"bucket{r['bucket']}({r['dtype']})/allreduce")
+               for r in rows]
+        return ExpectedExchange(ops=ops, plan_rows=rows, notes=(
+            "per-leg codec on a flat mesh: ICI codec on the flat psum",))
+    chunk = exchange_chunk_bytes()
+    if chunk > 0 and op in (Sum, Average):
+        return ExpectedExchange(ops=_chunked_ops(rows, comp, chunk, world),
+                                plan_rows=rows,
+                                notes=(f"chunked exchange ({chunk}B chunks "
+                                       "of the wire buffer)",))
     ops = [ExpectedOp("psum", _wire_dtype(comp, r["dtype"]),
                       r["elements"],
                       f"bucket{r['bucket']}({r['dtype']})/allreduce")
            for r in rows]
     return ExpectedExchange(ops=ops, plan_rows=rows)
+
+
+def _hier_bucket_ops(tag: str, size: int, dtype, comp, n_dcn: int,
+                     n_ici: int) -> List[ExpectedOp]:
+    """The collective legs one bucket of ``ops.hierarchical_allreduce``
+    emits: intra-slice reduce-scatter, cross-slice hop under the DCN
+    codec, intra-slice allgather (same arithmetic as
+    ``fusion.plan_hier_legs``, but in first-operand element counts --
+    what the jaxpr auditor records)."""
+    from ..collectives.compression import is_hier_legs
+    dt = jnp.dtype(dtype)
+    floating = jnp.issubdtype(dt, jnp.floating)
+    if is_hier_legs(comp):
+        ici_c, dcn_c = comp.ici, comp.dcn
+    else:
+        # A flat cast codec compresses the bucket before the op: every
+        # leg rides the wire dtype with no codec inside the exchange.
+        dt = jnp.dtype(_wire_dtype(comp, dt))
+        ici_c = dcn_c = Compression.none
+    if not floating:
+        ici_c = dcn_c = Compression.none
+    if n_dcn <= 1:
+        # Single slice: the op statically falls back to the flat psum.
+        return [ExpectedOp("psum", str(dt), size, f"{tag}/flat-ar")]
+    quantum = _ops.microbatch_pad_quantum(n_ici)
+    padded = size + (-size) % quantum
+    shard = padded // n_ici
+    ici_dt = _wire_dtype(ici_c, dt)
+    ops = [ExpectedOp("reduce_scatter", ici_dt, padded, f"{tag}/ici-rs")]
+    if floating and is_powersgd(dcn_c):
+        pw, qw = powersgd_factor_widths(shard, dcn_c.rank)
+        ops.append(ExpectedOp("psum", "float32", pw, f"{tag}/dcn-psum-P"))
+        ops.append(ExpectedOp("psum", "float32", qw, f"{tag}/dcn-psum-Q"))
+    elif floating and is_error_feedback(dcn_c):
+        k = min(topk_count(shard, dcn_c.fraction), shard)
+        ops.append(ExpectedOp("all_gather", "float32", k,
+                              f"{tag}/dcn-gather-values"))
+        ops.append(ExpectedOp("all_gather", "int32", k,
+                              f"{tag}/dcn-gather-indices"))
+    elif floating and is_fp8(dcn_c):
+        # Quantized gather-sum: e4m3 shards + one f32 scale per slice.
+        ops.append(ExpectedOp("all_gather", "float8_e4m3fn", shard,
+                              f"{tag}/dcn-gather-q"))
+        ops.append(ExpectedOp("all_gather", "float32", 1,
+                              f"{tag}/dcn-gather-scale"))
+    else:
+        ops.append(ExpectedOp("psum", _wire_dtype(dcn_c, dt), shard,
+                              f"{tag}/dcn-ar"))
+    ops.append(ExpectedOp("all_gather", ici_dt, shard, f"{tag}/ici-ag"))
+    return ops
+
+
+def _chunked_ops(rows: List[dict], comp, chunk_bytes: int,
+                 world: int) -> List[ExpectedOp]:
+    """The RS+AG pieces ``ops.chunked_allreduce`` emits per bucket.
+
+    Chunking acts on the COMPRESSED wire buffer (collective() compresses
+    first), so the chunk element quantum derives from the wire itemsize
+    and every piece rides the wire dtype."""
+    ops = []
+    for r in rows:
+        wire = _wire_dtype(comp, r["dtype"])
+        wire_item = jnp.dtype(wire).itemsize
+        chunk_elems = max(1, int(chunk_bytes) // wire_item)
+        chunk_elems += (-chunk_elems) % world
+        size = r["elements"]
+        tag = f"bucket{r['bucket']}({r['dtype']})"
+        for j, off in enumerate(range(0, size, chunk_elems)):
+            piece = min(chunk_elems, size - off)
+            padded = piece + (-piece) % world
+            ops.append(ExpectedOp("reduce_scatter", wire, padded,
+                                  f"{tag}/chunk{j}-rs"))
+            ops.append(ExpectedOp("all_gather", wire, padded // world,
+                                  f"{tag}/chunk{j}-ag"))
+    return ops
 
 
 def _expected_serving_decode(meta: dict) -> ExpectedExchange:
@@ -232,14 +342,24 @@ def _expected_serving_decode(meta: dict) -> ExpectedExchange:
         f"layer(s), {elements} elements each",))
 
 
-def _ef_ops(rows: List[dict], comp) -> List[ExpectedOp]:
-    """The two-leg EF exchange per floating bucket (ef_exchange)."""
+def _ef_ops(rows: List[dict], comp,
+            hier_shape: Optional[Tuple[int, int]] = None) -> List[ExpectedOp]:
+    """The two-leg EF exchange per floating bucket (ef_exchange).
+
+    With ``hier_shape`` (a per-leg ``ici:...,dcn:powersgd/topk`` codec on
+    the two-level mesh) each floating bucket routes through
+    ``hierarchical_allreduce`` with the EF codec scoped to the DCN hop;
+    non-float buckets still ride the plain flat psum."""
     ops = []
     for r in rows:
         tag = f"bucket{r['bucket']}({r['dtype']})"
         if not jnp.issubdtype(jnp.dtype(r["dtype"]), jnp.floating):
             ops.append(ExpectedOp("psum", r["dtype"], r["elements"],
                                   f"{tag}/allreduce"))
+            continue
+        if hier_shape is not None:
+            ops += _hier_bucket_ops(tag, r["elements"], r["dtype"], comp,
+                                    *hier_shape)
             continue
         size = r["elements"]
         if is_powersgd(comp):
@@ -299,7 +419,16 @@ def _expected_microbatch(leaves, exchange, k: int, world: int
 
 
 def _expected_zero(leaves, meta: dict, world: int) -> ExpectedExchange:
-    """ZeRO-1 arena exchange: reduce-scatter + compressed allgather."""
+    """ZeRO-1 arena exchange: reduce-scatter + compressed allgather.
+
+    On the two-level ``(dcn, ici)`` mesh the multi-axis collectives
+    decompose per axis (``ops.reducescatter`` loops ``psum_scatter`` in
+    axis order; ``ops.allgather`` gathers in reverse order), and a
+    per-leg ``ici:...,dcn:...`` codec additionally flips the scatter to
+    (ici, dcn) order so only the 1/n_ici shard crosses DCN, with each
+    allgather hop riding its own leg codec (``zero_apply`` parity)."""
+    from ..collectives.compression import is_hier_legs
+    from ..controller.fusion import hier_mesh_shape
     from ..optim import zero as _zero
 
     comp = meta.get("zero_compression")
@@ -309,22 +438,58 @@ def _expected_zero(leaves, meta: dict, world: int) -> ExpectedExchange:
             (f"unmodeled zero allgather codec: {comp.__name__}",))
     spec = _zero.plan_arena(leaves, world)
     use_rs = _zero._use_reducescatter()
+    two_level = hier_mesh_shape()
+    hier = is_hier_legs(comp) and two_level is not None
+    if hier and is_fp8(comp.dcn):
+        return _unsupported(("unmodeled zero DCN-leg codec: fp8 "
+                             "(quantized leader gather)",))
     ops, rows = [], []
+    notes = []
+    if two_level is not None:
+        n_dcn, n_ici = two_level
+        # Axis extents in the order the RS loop scatters over them.
+        rs_order = (n_ici, n_dcn) if hier else (n_dcn, n_ici)
+        notes.append(f"per-axis zero exchange on the ({n_dcn}, {n_ici}) "
+                     f"mesh{' (per-leg codec)' if hier else ''}")
     for i, buf in enumerate(spec.buffers):
         if buf.size < 1:
             continue
         dt = str(jnp.dtype(buf.dtype))
         tag = f"arena{i}({dt})"
         if use_rs:
-            ops.append(ExpectedOp("reduce_scatter", dt, buf.padded,
-                                  f"{tag}/reduce-scatter"))
+            if two_level is not None:
+                running = buf.padded
+                for j, n_a in enumerate(rs_order):
+                    ops.append(ExpectedOp("reduce_scatter", dt, running,
+                                          f"{tag}/reduce-scatter-ax{j}"))
+                    running //= n_a
+            else:
+                ops.append(ExpectedOp("reduce_scatter", dt, buf.padded,
+                                      f"{tag}/reduce-scatter"))
         else:
             ops.append(ExpectedOp("psum", dt, buf.padded,
                                   f"{tag}/allreduce"))
-        ops.append(ExpectedOp("all_gather", _wire_dtype(comp, buf.dtype),
-                              buf.shard, f"{tag}/allgather"))
+        if hier:
+            # compressed_allgather over (dcn,) then (ici,), each hop at
+            # its leg codec's wire dtype.
+            ops.append(ExpectedOp("all_gather",
+                                  _wire_dtype(comp.dcn, buf.dtype),
+                                  buf.shard, f"{tag}/allgather-dcn"))
+            ops.append(ExpectedOp("all_gather",
+                                  _wire_dtype(comp.ici, buf.dtype),
+                                  buf.shard * n_dcn, f"{tag}/allgather-ici"))
+        elif two_level is not None:
+            # ops.allgather gathers reversed(axes): ici first, dcn last.
+            wire = _wire_dtype(comp, buf.dtype)
+            ops.append(ExpectedOp("all_gather", wire, buf.shard,
+                                  f"{tag}/allgather-ici"))
+            ops.append(ExpectedOp("all_gather", wire, buf.shard * n_ici,
+                                  f"{tag}/allgather-dcn"))
+        else:
+            ops.append(ExpectedOp("all_gather", _wire_dtype(comp, buf.dtype),
+                                  buf.shard, f"{tag}/allgather"))
         rows.append({"bucket": i, "dtype": dt, "leaves": len(buf.leaves),
                      "elements": buf.size, "padded": buf.padded,
                      "shard": buf.shard, "codec": comp.__name__,
                      "kind": "zero-arena"})
-    return ExpectedExchange(ops=ops, plan_rows=rows)
+    return ExpectedExchange(ops=ops, plan_rows=rows, notes=tuple(notes))

@@ -233,6 +233,13 @@ def audit_step(fn, *args,
 
 STANDARD_CONFIGS = ("plain", "zero1", "powersgd_ef", "microbatch2")
 
+# Two-level reference configurations: same tiny tree, but the exchange
+# decomposes over the (dcn, ici) communicator -- plain per-leg hier,
+# hier composed with the ZeRO-1 arena, and hier with the EF codec scoped
+# to the DCN hop.  They require init() on a two-level mesh
+# (``build_mesh(devices, hierarchical=True, dcn_size=...)``).
+HIER_CONFIGS = ("hier", "hier_zero1", "hier_powersgd_ef")
+
 # Threshold chosen so the tiny parameter tree below splits into TWO f32
 # buckets (256 + 192 elements), exercising multi-bucket matching.
 _TINY_THRESHOLD = 1024
@@ -293,9 +300,34 @@ def build_standard_config(config: str):
         step = _training.make_train_step(_tiny_loss, opt, mesh=mesh,
                                          microbatches=2)
         opt_state = opt.init(params)
+    elif config in HIER_CONFIGS:
+        if len(mesh.axis_names) != 2:
+            raise ValueError(
+                f"config {config!r} needs the two-level (dcn, ici) mesh; "
+                f"init() with build_mesh(..., hierarchical=True, "
+                f"dcn_size=...) first (got axes {mesh.axis_names})")
+        if config == "hier":
+            opt = _dist.DistributedOptimizer(
+                optax.sgd(0.01), compression="ici:none,dcn:none",
+                fusion_threshold=_TINY_THRESHOLD)
+            step = _training.make_train_step(_tiny_loss, opt, mesh=mesh)
+            opt_state = opt.init(params)
+        elif config == "hier_zero1":
+            opt = optax.sgd(0.01)
+            step = _training.make_train_step(
+                _tiny_loss, opt, mesh=mesh, zero_stage=1,
+                zero_compression="ici:none,dcn:none")
+            opt_state = _zero.zero_init(opt, params, mesh=mesh,
+                                        compression="ici:none,dcn:none")
+        else:  # hier_powersgd_ef
+            opt = _dist.DistributedOptimizer(
+                optax.sgd(0.01), compression="ici:none,dcn:powersgd:2",
+                fusion_threshold=_TINY_THRESHOLD)
+            step = _training.make_train_step(_tiny_loss, opt, mesh=mesh)
+            opt_state = opt.init(params)
     else:
         raise ValueError(f"unknown standard config {config!r}; "
-                         f"pick from {STANDARD_CONFIGS}")
+                         f"pick from {STANDARD_CONFIGS + HIER_CONFIGS}")
     # donate_argnums mirrors make_train_step's own (0, 1) donation.
     return step, (params, opt_state, batch), (0, 1), f"step:{config}"
 

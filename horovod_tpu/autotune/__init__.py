@@ -87,13 +87,52 @@ MAX_SAMPLES = 12
 # in that comma list (see Autotuner.__init__).
 COMP_DEFAULT, COMP_BF16, COMP_FP16, COMP_FP8 = 0, 1, 2, 3
 COMP_CODEC_BASE = 4
+# Hierarchical DCN-leg codec axis encoding (grid member 8): what rides
+# the cross-slice hop of the two-level exchange when the hierarchical
+# axis is on.  0 keeps the sample's plain codec on every leg.
+HIER_DCN_NONE, HIER_DCN_BF16, HIER_DCN_FP16, HIER_DCN_FP8 = 0, 1, 2, 3
 
 
-def _grid(thresholds, cycles, hiers, comps, zeros, chunks, steps,
-          micros) -> List[Tuple[int, float, int, int, int, int, int, int]]:
-    return [(t, c, h, k, z, ch, sp, mb) for t in thresholds for c in cycles
-            for h in hiers for k in comps for z in zeros for ch in chunks
-            for sp in steps for mb in micros]
+def _grid(thresholds, cycles, hiers, comps, zeros, chunks, steps, micros,
+          hcodecs) -> List[Tuple[int, float, int, int, int, int, int, int,
+                                 int]]:
+    # A DCN-leg codec without the hierarchical schedule is meaningless
+    # (there is no separate DCN hop to compress), so those combinations
+    # are pruned rather than burning sample budget re-measuring the flat
+    # exchange.
+    return [(t, c, h, k, z, ch, sp, mb, hc) for t in thresholds
+            for c in cycles for h in hiers for k in comps for z in zeros
+            for ch in chunks for sp in steps for mb in micros
+            for hc in hcodecs if not (h == 0 and hc != 0)]
+
+
+def modeled_exchange_seconds(payload_bytes: float, *, n_dcn: int,
+                             n_ici: int, hierarchical: bool,
+                             ici_bw: float, dcn_bw: float,
+                             ici_wire_scale: float = 1.0,
+                             dcn_wire_scale: float = 1.0,
+                             quantize_s: float = 0.0,
+                             phase_overhead_s: float = 0.0) -> float:
+    """Analytic per-link ring cost of one gradient exchange.
+
+    The candidate scorer for the hierarchical/per-leg-codec axes when no
+    wall clock is trustworthy (dry runs, the committed autotune demo):
+    a flat ring moves ``2 (n-1)/n * bytes`` over the SLOWEST link it
+    crosses, while the two-level schedule moves the full payload over ICI
+    and only the ``1/n_ici`` shard over DCN -- with each leg's wire bytes
+    scaled by that leg's codec (``*_wire_scale``).  ``quantize_s`` prices
+    the codec's cast/quantize work, ``phase_overhead_s`` one collective
+    launch (the hierarchical schedule pays two extra phases).
+    """
+    n = n_dcn * n_ici
+    if hierarchical and n_dcn > 1:
+        return (2 * (n_ici - 1) / n_ici * payload_bytes * ici_wire_scale
+                / ici_bw
+                + 2 * (n_dcn - 1) / n_dcn
+                * (payload_bytes * dcn_wire_scale / n_ici) / dcn_bw
+                + 2 * phase_overhead_s + quantize_s)
+    return (2 * (n - 1) / n * payload_bytes * ici_wire_scale
+            / min(ici_bw, dcn_bw) + phase_overhead_s + quantize_s)
 
 
 def _mesh_is_two_level() -> bool:
@@ -196,16 +235,28 @@ class Autotuner:
             micros = sorted({1, 2, 4, configured_micro})
         else:
             micros = [configured_micro]
+        # Hierarchical DCN-leg codec axis (opt-in, HOROVOD_AUTOTUNE_HIER=1
+        # on a two-level mesh; it changes wire numerics on the cross-slice
+        # hop only): which codec rides the DCN leg of the two-level
+        # exchange (collectives/ops.py::hierarchical_allreduce's
+        # ``dcn_codec``).  The ICI legs keep the sample's plain codec --
+        # contended DCN with fast ICI is exactly where per-leg compression
+        # pays (the bench's contended_dcn scenario).
+        self.tunes_hier_codec = bool(_env_bool("AUTOTUNE_HIER")
+                                     and _mesh_is_two_level())
+        hcodecs = [HIER_DCN_NONE, HIER_DCN_BF16, HIER_DCN_FP16,
+                   HIER_DCN_FP8] if self.tunes_hier_codec \
+            else [HIER_DCN_NONE]
         self.grid = _grid(sorted(self.candidates), sorted(cycles), hiers,
-                          comps, zeros, chunks, steps, micros)
+                          comps, zeros, chunks, steps, micros, hcodecs)
         self.steps_per_sample = steps_per_sample
         self.max_samples = min(max_samples, len(self.grid))
         self.log_path = config.autotune_log
         self.warm_start_skipped = 0
         self._opt = BayesianOptimizer(
             [(float(t), c, float(h), float(k), float(z), float(ch),
-              float(sp), float(mb))
-             for t, c, h, k, z, ch, sp, mb in self.grid])
+              float(sp), float(mb), float(hc))
+             for t, c, h, k, z, ch, sp, mb, hc in self.grid])
         self._samples: List[tuple] = []
         self._best: Optional[Tuple[int, float]] = None
         self._step = 0
@@ -221,7 +272,8 @@ class Autotuner:
         self._idx = self._next_index()
 
     # -- current knobs ----------------------------------------------------
-    def _current(self) -> Tuple[int, float, int, int, int, int, int, int]:
+    def _current(self) -> Tuple[int, float, int, int, int, int, int, int,
+                                int]:
         return self._best or self.grid[self._idx]
 
     def fusion_threshold(self) -> int:
@@ -234,20 +286,46 @@ class Autotuner:
         """Use the explicit two-level (dcn, ici) allreduce schedule."""
         return bool(self._current()[2])
 
+    def hier_dcn_codec(self):
+        """DCN-leg codec of the current sample (None = no per-leg codec).
+        Only meaningful when the hierarchical axis is on -- the grid
+        prunes the other combinations."""
+        code = int(self._current()[8])
+        if not code or not self.hierarchical_explicit():
+            return None
+        from ..collectives.compression import Compression
+        return {HIER_DCN_BF16: Compression.bf16,
+                HIER_DCN_FP16: Compression.fp16,
+                HIER_DCN_FP8: Compression.fp8}[code]
+
     def compression_override(self, configured):
         """The codec this sample runs with (``configured`` unless the
-        opt-in compression axis picked another)."""
+        opt-in compression axis picked another).  When the hier DCN-codec
+        axis is active, the result is the per-leg composite: the plain
+        codec (psum-compatible) on the ICI legs, the axis's codec on the
+        DCN hop."""
         from ..collectives.compression import Compression
         k = self._current()[3]
         if k == COMP_BF16:
-            return Compression.bf16
-        if k == COMP_FP16:
-            return Compression.fp16
-        if k == COMP_FP8:
-            return Compression.fp8
-        if k >= COMP_CODEC_BASE:
-            return self._codec_axis[k]
-        return configured
+            override = Compression.bf16
+        elif k == COMP_FP16:
+            override = Compression.fp16
+        elif k == COMP_FP8:
+            override = Compression.fp8
+        elif k >= COMP_CODEC_BASE:
+            override = self._codec_axis[k]
+        else:
+            override = configured
+        hc = self.hier_dcn_codec()
+        if hc is not None:
+            from ..collectives.compression import (hier_leg_compressor,
+                                                   is_hier_legs)
+            if is_hier_legs(override):
+                return override  # configured per-leg codec wins
+            ici = override if (override is not None and getattr(
+                override, "wire_format", "") == "") else "none"
+            return hier_leg_compressor(ici, hc)
+        return override
 
     def zero_stage(self) -> int:
         """The ZeRO exchange value of the current sample (0 = allreduce
@@ -279,8 +357,8 @@ class Autotuner:
         ``_apply_to_batcher``, and keying on it would recompile an
         identical trace for every cycle-axis sample.  Steps-per-exec and
         microbatches are likewise excluded (build-time structural knobs)."""
-        thr, _cyc, hier, comp, zero, chunk, _sp, _mb = self._current()
-        return (thr, hier, comp, zero, chunk)
+        thr, _cyc, hier, comp, zero, chunk, _sp, _mb, hc = self._current()
+        return (thr, hier, comp, zero, chunk, hc)
 
     @property
     def done(self) -> bool:
@@ -385,18 +463,18 @@ class Autotuner:
                 try:
                     if len(parts) == 3:     # pre-round-3 log format
                         cfg = (int(float(parts[0])), float(parts[1]),
-                               0, COMP_DEFAULT, 0, 0, 1, 1)
+                               0, COMP_DEFAULT, 0, 0, 1, 1, 0)
                         score = float(parts[2])
                     elif len(parts) == 5:   # rounds 3-5: no zero axis
                         cfg = (int(float(parts[0])), float(parts[1]),
                                int(float(parts[2])),
-                               int(float(parts[3])), 0, 0, 1, 1)
+                               int(float(parts[3])), 0, 0, 1, 1, 0)
                         score = float(parts[4])
                     elif len(parts) == 6:   # PR-1: zero, no chunk/steps
                         cfg = (int(float(parts[0])), float(parts[1]),
                                int(float(parts[2])),
                                int(float(parts[3])),
-                               int(float(parts[4])), 0, 1, 1)
+                               int(float(parts[4])), 0, 1, 1, 0)
                         score = float(parts[5])
                     elif len(parts) == 8:   # PR-2: chunk + steps axes
                         cfg = (int(float(parts[0])), float(parts[1]),
@@ -404,17 +482,20 @@ class Autotuner:
                                int(float(parts[3])),
                                int(float(parts[4])),
                                int(float(parts[5])),
-                               int(float(parts[6])), 1)
+                               int(float(parts[6])), 1, 0)
                         score = float(parts[7])
-                    elif len(parts) == 9:   # PR-3: microbatch axis
+                    elif len(parts) in (9, 10):  # PR-3: microbatch axis;
+                        # PR-11 appends the hier DCN-codec axis
                         cfg = (int(float(parts[0])), float(parts[1]),
                                int(float(parts[2])),
                                int(float(parts[3])),
                                int(float(parts[4])),
                                int(float(parts[5])),
                                int(float(parts[6])),
-                               int(float(parts[7])))
-                        score = float(parts[8])
+                               int(float(parts[7])),
+                               int(float(parts[8]))
+                               if len(parts) == 10 else 0)
+                        score = float(parts[-1])
                     else:                   # unknown column count
                         skipped += 1
                         continue
@@ -449,9 +530,9 @@ class Autotuner:
         with open(self.log_path, "w") as f:
             f.write("fusion_threshold_bytes,cycle_time_ms,hierarchical,"
                     "compression,zero,exchange_chunk_bytes,steps_per_exec,"
-                    "microbatches,score_bytes_per_s\n")
-            for thr, cyc, hier, comp, zero, chunk, sp, mb, score \
+                    "microbatches,hier_dcn_codec,score_bytes_per_s\n")
+            for thr, cyc, hier, comp, zero, chunk, sp, mb, hc, score \
                     in self._samples:
                 f.write(f"{thr},{cyc},{hier},{comp},{zero},{chunk},{sp},"
-                        f"{mb},{score}\n")
+                        f"{mb},{hc},{score}\n")
             f.write("# best," + ",".join(str(v) for v in self._best) + "\n")

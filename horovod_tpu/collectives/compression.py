@@ -171,8 +171,62 @@ def is_topk(compression) -> bool:
     return getattr(compression, "wire_format", "") == "topk"
 
 
+class _HierLegCompressor(Compressor):
+    """Per-leg EXCHANGE-level codec for the two-level (DCN x ICI) path.
+
+    Carries one codec per hop: ``ici`` rides the fast intra-slice legs
+    (reduce-scatter + allgather), ``dcn`` only the slow cross-slice hop.
+    ``compress``/``decompress`` are identities -- like fp8, the collective
+    layer recognises ``wire_format == "hier_legs"`` and swaps the exchange
+    for ``ops.hierarchical_allreduce`` with the legs' codecs applied
+    inside.  The ICI leg must stay psum-compatible (none/fp16/bf16); the
+    DCN leg may additionally be fp8 or an error-feedback codec
+    (powersgd/topk), whose residual then lives in the DCN-shard domain.
+    """
+    wire_format = "hier_legs"
+    ici = NoneCompressor
+    dcn = NoneCompressor
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+def is_hier_legs(compression) -> bool:
+    return getattr(compression, "wire_format", "") == "hier_legs"
+
+
+def hier_leg_compressor(ici, dcn):
+    """Memoized per-leg codec class (see :class:`_HierLegCompressor`).
+
+    Registered on :class:`Compression` under its ``__name__`` like the
+    parameterized codecs, so join replay resolves it by name.
+    """
+    ici = parse_compression(ici)
+    dcn = parse_compression(dcn)
+    if is_hier_legs(ici) or is_hier_legs(dcn):
+        raise ValueError("per-leg codecs do not nest")
+    if getattr(ici, "wire_format", ""):
+        raise ValueError(
+            f"ICI leg codec must be psum-compatible (none|fp16|bf16), "
+            f"got {ici.__name__}")
+    name = f"Hier{ici.__name__}Dcn{dcn.__name__}"
+    cls = getattr(Compression, name, None)
+    if cls is None:
+        cls = type(name, (_HierLegCompressor,), {"ici": ici, "dcn": dcn})
+        setattr(Compression, name, cls)
+    return cls
+
+
 def is_error_feedback(compression) -> bool:
-    """True for codecs whose exchange needs error-feedback residual state."""
+    """True for codecs whose exchange needs error-feedback residual state.
+    A per-leg codec is error-feedback iff its DCN leg is."""
+    if is_hier_legs(compression):
+        return is_error_feedback(compression.dcn)
     return is_powersgd(compression) or is_topk(compression)
 
 
@@ -239,6 +293,10 @@ def resolve_compressor_name(name: str):
     m = re.fullmatch(r"TopK(.+)Compressor", name)
     if m:
         return topk_compressor(_parse_fraction_token(m.group(1)))
+    m = re.fullmatch(r"Hier(.+?)Dcn(.+)", name)
+    if m:
+        return hier_leg_compressor(resolve_compressor_name(m.group(1)),
+                                   resolve_compressor_name(m.group(2)))
     raise KeyError(f"unknown compressor {name!r}")
 
 
@@ -246,13 +304,30 @@ def parse_compression(spec):
     """``HOROVOD_COMPRESSION`` spec -> codec class.
 
     Accepts ``none``/``fp16``/``bf16``/``fp8``, ``powersgd:<rank>`` and
-    ``topk:<fraction>``; a codec class passes through unchanged.
+    ``topk:<fraction>``; a codec class passes through unchanged.  A
+    per-leg spec names a codec per hop of the two-level exchange, e.g.
+    ``ici:none,dcn:fp8`` (omitted legs default to ``none``).
     """
     if spec is None:
         return Compression.none
     if isinstance(spec, type):
         return spec
     s = str(spec).strip().lower()
+    if "ici:" in s or "dcn:" in s:
+        legs = {}
+        for part in s.split(","):
+            leg, sep, sub = part.strip().partition(":")
+            if leg not in ("ici", "dcn") or not sep:
+                raise ValueError(
+                    f"bad per-leg compression spec {spec!r}: expected "
+                    f"comma-separated ici:<codec>,dcn:<codec> entries")
+            if leg in legs:
+                raise ValueError(
+                    f"bad per-leg compression spec {spec!r}: duplicate "
+                    f"{leg} leg")
+            legs[leg] = sub
+        return hier_leg_compressor(legs.get("ici", "none"),
+                                   legs.get("dcn", "none"))
     plain = {"none": Compression.none, "fp16": Compression.fp16,
              "bf16": Compression.bf16, "fp8": Compression.fp8}
     if s in plain:
@@ -268,7 +343,7 @@ def parse_compression(spec):
             raise ValueError(f"bad compression spec {spec!r}: {e}") from None
     raise ValueError(
         f"bad compression spec {spec!r}: expected none|fp16|bf16|fp8|"
-        f"powersgd:<rank>|topk:<fraction>")
+        f"powersgd:<rank>|topk:<fraction>|ici:<codec>,dcn:<codec>")
 
 
 def powersgd_matrix_shape(size: int) -> Tuple[int, int]:
@@ -319,6 +394,14 @@ def wire_payload_bytes(compression, size: int,
     size = int(size)
     if size < 1:
         return 0
+    if is_hier_legs(compression):
+        # ``world`` carries the ICI extent here: the RS/AG legs move the
+        # full bucket at the ICI codec's wire width, the DCN hop only a
+        # 1/n_ici shard at the DCN codec's width.
+        n_ici = max(int(world), 1)
+        shard = max(1, (size + n_ici - 1) // n_ici)
+        return (wire_payload_bytes(compression.ici, size, itemsize)
+                + wire_payload_bytes(compression.dcn, shard, itemsize))
     if is_powersgd(compression):
         pw, qw = powersgd_factor_widths(size, compression.rank)
         return 4 * (pw + qw)
@@ -345,3 +428,4 @@ class Compression:
     fp8 = FP8Compressor
     powersgd = staticmethod(powersgd_compressor)
     topk = staticmethod(topk_compressor)
+    hier = staticmethod(hier_leg_compressor)

@@ -227,6 +227,9 @@ def hierarchical_allreduce(x,
                            *,
                            dcn_axis: str,
                            ici_axis: str,
+                           dcn_codec=None,
+                           ici_codec=None,
+                           dcn_residual=None,
                            prescale_factor: float = 1.0,
                            postscale_factor: float = 1.0):
     """Explicit two-level allreduce on a ``(dcn, ici)`` mesh
@@ -239,30 +242,126 @@ def hierarchical_allreduce(x,
     over the slow DCN links -- the reference's hierarchical algorithm --
     and is what the autotuner's ``hierarchical`` knob selects.  Sum and
     Average only (min/max/product don't scatter).
+
+    Codecs apply PER LEG.  ``ici_codec`` (none/fp16/bf16 cast codecs
+    only) sets the wire dtype of the intra-slice reduce-scatter and
+    allgather; ``dcn_codec`` touches only the cross-slice hop of the
+    1/n_ici shard and may additionally be fp8 (quantized gather-sum, f32
+    accumulation) or an error-feedback codec (powersgd/topk over the DCN
+    axis).  With an EF ``dcn_codec`` the return is
+    ``(out, new_dcn_residual)`` -- ``dcn_residual`` is the previous
+    step's unsent shard-domain f32 mass (``None`` = zeros), exactly the
+    :func:`powersgd_allreduce` contract scoped to the DCN leg.
+
+    The flat bucket is zero-padded to a multiple of
+    ``microbatch_pad_quantum(n_ici)`` so the per-leg wire payload is
+    mesh-invariant across every ``n_ici`` dividing 256 (what the scaling
+    bench gates on).  When the DCN axis has extent 1 (single slice) the
+    two-level decomposition would only add reduction-order noise, so the
+    op statically falls back to the flat ``psum`` over both axes --
+    bitwise identical to :func:`allreduce` on the same mesh.
     """
+    from .compression import (Compression, fp8_quantize, is_error_feedback,
+                              is_fp8, is_powersgd, is_topk,
+                              wire_payload_bytes)
     if op not in (Sum, Average):
         raise ValueError(
             f"hierarchical_allreduce supports Sum/Average, got {op}")
+    ici_codec = ici_codec or Compression.none
+    dcn_codec = dcn_codec or Compression.none
+    if getattr(ici_codec, "wire_format", ""):
+        raise ValueError(
+            f"ICI leg codec must be psum-compatible (none|fp16|bf16), "
+            f"got {ici_codec.__name__}")
     n_ici = lax.axis_size(ici_axis)
-    n = n_ici * lax.axis_size(dcn_axis)
+    n_dcn = lax.axis_size(dcn_axis)
+    n = n_ici * n_dcn
+    ef = is_error_feedback(dcn_codec)
+    floating = jnp.issubdtype(x.dtype, jnp.floating)
+    if not floating:
+        # Non-float buckets ride uncompressed (both legs).
+        ici_codec = Compression.none
+        dcn_codec = Compression.none
+    quantum = microbatch_pad_quantum(n_ici)
+    shard_len = (x.size + (-x.size) % quantum) // n_ici
+
+    if n_dcn == 1:
+        # Single slice: the DCN hop is an identity; the flat psum is both
+        # cheaper and bitwise identical to allreduce() on this mesh.
+        y = allreduce(x, op, axes=(dcn_axis, ici_axis),
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor)
+        if ef:
+            res = dcn_residual if dcn_residual is not None else \
+                jnp.zeros((shard_len,), jnp.float32)
+            return y, res
+        return y
+
     if prescale_factor != 1.0:
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
-    shape = x.shape
+    shape, dtype = x.shape, x.dtype
     flat = x.ravel()
-    pad = (-flat.size) % n_ici
+    pad = (-flat.size) % quantum
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    shard = lax.psum_scatter(flat, ici_axis, scatter_dimension=0,
+    padded = flat.size
+    itemsize = jnp.dtype(dtype).itemsize
+    ici_wire, ici_ctx = ici_codec.compress(flat)
+    ici_itemsize = jnp.dtype(ici_wire.dtype).itemsize
+    # Trace-time per-leg registration (fires once per trace): the RS/AG
+    # legs move the full padded bucket at the ICI wire width, the DCN hop
+    # only the 1/n_ici shard at the DCN codec's payload.
+    from ..timeline import spans as _spans
+    _spans.note_leg("hier/ici_rs", nbytes=padded * ici_itemsize)
+    _spans.note_leg("hier/dcn_ar",
+                    nbytes=wire_payload_bytes(dcn_codec, shard_len,
+                                              itemsize))
+    _spans.note_leg("hier/ici_ag", nbytes=padded * ici_itemsize)
+
+    shard = lax.psum_scatter(ici_wire, ici_axis, scatter_dimension=0,
                              tiled=True)
-    shard = lax.psum(shard, dcn_axis)
+    shard = ici_codec.decompress(shard, ici_ctx)
+
+    new_residual = None
+    if ef and floating:
+        # Compressed leader exchange: powersgd/topk of the shard over the
+        # DCN axis only; the residual lives in the shard domain.
+        if is_powersgd(dcn_codec):
+            shard, new_residual = powersgd_allreduce(
+                shard, Sum, rank=dcn_codec.rank, axes=(dcn_axis,),
+                residual=dcn_residual, note=False)
+        else:
+            shard, new_residual = topk_allreduce(
+                shard, Sum, fraction=dcn_codec.fraction, axes=(dcn_axis,),
+                residual=dcn_residual, note=False)
+    elif is_fp8(dcn_codec):
+        # Quantized gather-sum: e4m3 on the DCN wire, exact f32
+        # accumulation on chip (a psum would reduce IN fp8).
+        q, scale = fp8_quantize(shard.astype(jnp.float32))
+        gq = lax.all_gather(q[None], dcn_axis, axis=0, tiled=True)
+        gs = lax.all_gather(scale.reshape(1), dcn_axis, axis=0,
+                            tiled=True)
+        shard = jnp.sum(gq.reshape(n_dcn, -1).astype(jnp.float32)
+                        * gs[:, None], axis=0).astype(dtype)
+    else:
+        dcn_wire, dcn_ctx = dcn_codec.compress(shard)
+        dcn_wire = lax.psum(dcn_wire, dcn_axis)
+        shard = dcn_codec.decompress(dcn_wire, dcn_ctx)
     if op is Average:
         shard = _divide_in_dtype(shard, n)
-    y = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    ag_wire, ag_ctx = ici_codec.compress(shard)
+    y = lax.all_gather(ag_wire, ici_axis, axis=0, tiled=True)
+    y = ici_codec.decompress(y, ag_ctx)
     if pad:
         y = y[:-pad]
     y = y.reshape(shape)
     if postscale_factor != 1.0:
         y = y * jnp.asarray(postscale_factor, dtype=y.dtype)
+    if ef:
+        if new_residual is None:  # non-float bucket: nothing was unsent
+            new_residual = dcn_residual if dcn_residual is not None else \
+                jnp.zeros((shard_len,), jnp.float32)
+        return y, new_residual
     return y
 
 
@@ -814,7 +913,8 @@ def powersgd_allreduce(x,
                        axes: Optional[AxisSpec] = None,
                        residual=None,
                        prescale_factor: float = 1.0,
-                       postscale_factor: float = 1.0):
+                       postscale_factor: float = 1.0,
+                       note: bool = True):
     """Rank-``rank`` PowerSGD allreduce (Vogels et al., 2019): low-rank
     factor exchange with f32 on-chip arithmetic.
 
@@ -868,9 +968,10 @@ def powersgd_allreduce(x,
         if pad else acc
     mat = flat.reshape(m, c)
     r = max(1, min(int(rank), m, c))
-    # Trace-time leg registration: two f32 factor allreduces on the wire.
-    from ..timeline import spans as _spans
-    _spans.note_leg("powersgd_allreduce", nbytes=2 * r * (m + c) * 4)
+    if note:
+        # Trace-time leg registration: two f32 factor allreduces.
+        from ..timeline import spans as _spans
+        _spans.note_leg("powersgd_allreduce", nbytes=2 * r * (m + c) * 4)
 
     p = mat @ _powersgd_seed_matrix(c, r)          # [m, r]
     p = lax.psum(p, axes if len(axes) > 1 else axes[0]) / n
@@ -894,7 +995,8 @@ def topk_allreduce(x,
                    axes: Optional[AxisSpec] = None,
                    residual=None,
                    prescale_factor: float = 1.0,
-                   postscale_factor: float = 1.0):
+                   postscale_factor: float = 1.0,
+                   note: bool = True):
     """Top-``fraction`` sparsified allreduce (DGC-style, Lin et al., 2018).
 
     Each rank keeps its ``k = ceil(fraction * size)`` largest-magnitude
@@ -930,9 +1032,10 @@ def topk_allreduce(x,
         acc = acc + residual.astype(jnp.float32).ravel()
     size = acc.size
     k = min(topk_count(size, fraction), size)
-    # Trace-time leg registration: (value f32, index int32) pairs gathered.
-    from ..timeline import spans as _spans
-    _spans.note_leg("topk_allreduce", nbytes=8 * k)
+    if note:
+        # Trace-time leg registration: (value f32, index int32) pairs.
+        from ..timeline import spans as _spans
+        _spans.note_leg("topk_allreduce", nbytes=8 * k)
 
     _, idx = lax.top_k(jnp.abs(acc), k)            # int32 indices
     vals = jnp.take(acc, idx)

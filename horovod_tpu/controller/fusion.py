@@ -251,6 +251,146 @@ def fused_tree_collective(tree, collective_fn,
     return jax.tree.unflatten(treedef, unpack(reduced, spec))
 
 
+# -- explicit leg planning (two-level exchange) ----------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeLeg:
+    """One hop of a bucket's exchange: which mesh axis it moves over,
+    which collective it emits, the codec riding that hop, and the
+    closed-form operand/wire accounting the spans and the bench gate on.
+
+    ``elements`` is the collective's first-operand element count (what
+    the jaxpr auditor records); ``nbytes`` the wire payload bytes the
+    matching ``spans.note_leg`` call reports for the leg.
+    """
+    tag: str          # span tag: hier/ici_rs | hier/dcn_ar | hier/ici_ag
+    axis: str         # mesh axis name the leg moves over
+    collective: str   # reduce_scatter | psum | all_gather | fp8_gather |
+                      # powersgd | topk
+    codec: str        # codec name applied on this leg
+    wire_dtype: str
+    elements: int
+    nbytes: int
+
+
+def hier_mesh_shape() -> Optional[Tuple[int, int]]:
+    """``(n_dcn, n_ici)`` when the world mesh is the two-level
+    ``(dcn, ici)`` communicator, else ``None``."""
+    st = global_state()
+    m = st.mesh
+    if m is None:
+        return None
+    names = tuple(m.axis_names)
+    if len(names) != 2:
+        return None
+    return (int(m.shape[names[0]]), int(m.shape[names[1]]))
+
+
+def hier_requested(compression=None) -> bool:
+    """Whether the two-level exchange is in effect for the gradient path:
+    a per-leg codec always requests it; otherwise the config flag /
+    topology spec or the autotuner's hierarchical axis."""
+    from ..collectives.compression import is_hier_legs
+    if compression is not None and is_hier_legs(compression):
+        return True
+    st = global_state()
+    cfg = st.config
+    if cfg is not None and cfg.hierarchical_allreduce:
+        return True
+    if cfg is not None and getattr(cfg, "hierarchical", None):
+        from ..parallel.mesh import parse_topology_spec
+        try:
+            if parse_topology_spec(cfg.hierarchical)[0]:
+                return True
+        except ValueError:
+            pass
+    if st.autotuner is not None:
+        return bool(st.autotuner.hierarchical_explicit())
+    return False
+
+
+def plan_hier_legs(size: int, dtype, *, n_dcn: int, n_ici: int,
+                   compression=None, dcn_axis: str = "dcn",
+                   ici_axis: str = "ici") -> List[ExchangeLeg]:
+    """Closed-form leg plan for one bucket of the two-level exchange.
+
+    Mirrors ``ops.hierarchical_allreduce`` exactly -- padding quantum,
+    per-leg wire dtypes, and the ``note_leg`` byte accounting -- so the
+    bench's payload gate and the auditor's ``stepmodel`` consume the SAME
+    arithmetic the exchange emits.  ``compression`` may be ``None``, a
+    cast codec (the bucket is cast before the exchange: every leg rides
+    the wire dtype), or a per-leg ``ici:...,dcn:...`` codec.
+    """
+    from ..collectives.compression import (Compression, is_error_feedback,
+                                           is_fp8, is_hier_legs,
+                                           is_powersgd, parse_compression,
+                                           wire_payload_bytes)
+    from ..collectives.ops import microbatch_pad_quantum
+    size = int(size)
+    dt = jnp.dtype(dtype)
+    floating = jnp.issubdtype(dt, jnp.floating)
+    comp = parse_compression(compression) if compression is not None \
+        else Compression.none
+    if is_hier_legs(comp):
+        ici_c, dcn_c = comp.ici, comp.dcn
+    elif getattr(comp, "wire_format", ""):
+        raise ValueError(
+            f"{comp.__name__} is an exchange-level codec; the two-level "
+            f"path takes it per leg (ici:...,dcn:...)")
+    else:
+        # A flat cast codec compresses the bucket BEFORE the exchange:
+        # the op sees the already-cast buffer, so every leg (padding,
+        # shard, and wire accounting included) lives in the wire domain.
+        wd = getattr(comp, "wire_dtype", None)
+        if (floating and wd is not None
+                and jnp.dtype(wd).itemsize < dt.itemsize):
+            dt = jnp.dtype(wd)
+        ici_c, dcn_c = Compression.none, Compression.none
+    if not floating:
+        ici_c, dcn_c = Compression.none, Compression.none
+    if n_dcn <= 1:
+        # Single slice: the op statically falls back to the flat psum.
+        return [ExchangeLeg(tag="flat_ar", axis=f"{dcn_axis},{ici_axis}",
+                            collective="psum", codec="none",
+                            wire_dtype=str(dt), elements=size,
+                            nbytes=size * dt.itemsize)]
+    quantum = microbatch_pad_quantum(n_ici)
+    padded = size + (-size) % quantum
+    shard = padded // n_ici
+    itemsize = dt.itemsize
+    ici_itemsize = itemsize
+    ici_dt = str(dt)
+    wd = getattr(ici_c, "wire_dtype", None)
+    if floating and wd is not None and jnp.dtype(wd).itemsize < itemsize:
+        ici_itemsize = jnp.dtype(wd).itemsize
+        ici_dt = str(jnp.dtype(wd))
+    if is_powersgd(dcn_c):
+        dcn_coll, dcn_dt = "powersgd", "float32"
+    elif is_error_feedback(dcn_c):
+        dcn_coll, dcn_dt = "topk", "float32"
+    elif is_fp8(dcn_c):
+        dcn_coll, dcn_dt = "fp8_gather", "float8_e4m3fn"
+    else:
+        dcn_coll = "psum"
+        dwd = getattr(dcn_c, "wire_dtype", None)
+        dcn_dt = str(jnp.dtype(dwd)) if floating and dwd is not None \
+            and jnp.dtype(dwd).itemsize < itemsize else str(dt)
+    return [
+        ExchangeLeg(tag="hier/ici_rs", axis=ici_axis,
+                    collective="reduce_scatter", codec=ici_c.__name__,
+                    wire_dtype=ici_dt, elements=padded,
+                    nbytes=padded * ici_itemsize),
+        ExchangeLeg(tag="hier/dcn_ar", axis=dcn_axis, collective=dcn_coll,
+                    codec=dcn_c.__name__, wire_dtype=dcn_dt,
+                    elements=shard,
+                    nbytes=wire_payload_bytes(dcn_c, shard, itemsize)),
+        ExchangeLeg(tag="hier/ici_ag", axis=ici_axis,
+                    collective="all_gather", codec=ici_c.__name__,
+                    wire_dtype=ici_dt, elements=shard,
+                    nbytes=padded * ici_itemsize),
+    ]
+
+
 # -- plan introspection ----------------------------------------------------
 
 def _fence_policy() -> str:
@@ -303,14 +443,26 @@ def explain_plan(params, threshold_bytes: Optional[int] = None,
                         extra=plan_extra)
     codec = comp.__name__ if comp is not None else "none"
     fence = _fence_policy()
+    hier_shape = hier_mesh_shape() if hier_requested(comp) else None
     rows = []
     for i, (dt, lspecs) in enumerate(spec.buffers):
         dtype = str(jnp.dtype(dt))
         size = sum(s.size for s in lspecs)
         itemsize = jnp.dtype(dt).itemsize
         raw = size * itemsize
-        wire = wire_payload_bytes(comp, size, itemsize) \
-            if comp is not None else raw
+        legs = None
+        if hier_shape is not None:
+            try:
+                legs = plan_hier_legs(size, dt, n_dcn=hier_shape[0],
+                                      n_ici=hier_shape[1], compression=comp)
+            except ValueError:
+                legs = None  # codec the two-level path doesn't route
+        if legs is not None:
+            wire = sum(l.nbytes for l in legs)
+        elif comp is not None:
+            wire = wire_payload_bytes(comp, size, itemsize)
+        else:
+            wire = raw
         rows.append({
             "bucket": i, "dtype": dtype, "leaves": len(lspecs),
             "elements": int(size), "bytes": int(raw),
@@ -318,6 +470,8 @@ def explain_plan(params, threshold_bytes: Optional[int] = None,
             "fuse_key": "|".join(
                 [dtype, f"thr={int(threshold_bytes)}", codec]
                 + (["rev"] if reverse else [])),
+            "legs": [dataclasses.asdict(l) for l in legs]
+            if legs is not None else None,
         })
     if register:
         register_plan_gauges(rows)
@@ -356,6 +510,12 @@ def render_plan(rows: List[dict]) -> str:
     lines = ["  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
              for row in table]
     lines.insert(1, "  ".join("-" * w for w in widths))
+    for r in rows:
+        for leg in r.get("legs") or ():
+            lines.append(
+                f"    bucket {r['bucket']} leg {leg['tag']}: "
+                f"{leg['collective']}@{leg['axis']} codec={leg['codec']} "
+                f"{leg['wire_dtype']} {leg['elements']}el {leg['nbytes']}B")
     total_raw = sum(r["bytes"] for r in rows)
     total_wire = sum(r["wire_bytes"] for r in rows)
     ratio = f" (ratio {total_raw / total_wire:.1f}x)" \

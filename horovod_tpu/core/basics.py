@@ -114,13 +114,20 @@ def init(
             jax.distributed.initialize(addr, **kwargs)
             st.owns_distributed = True
 
-        if hierarchical is None:
-            hierarchical = cfg.hierarchical_allreduce or jax.process_count() > 1
         if devices is None:
             devices = jax.devices()
+        # Topology spec (HOROVOD_HIERARCHICAL=auto|rows,cols) pins the
+        # two-level mesh shape; the legacy boolean only turns it on with
+        # the process-grouped layout.
+        spec_hier, dcn_size = _mesh.parse_topology_spec(
+            cfg.hierarchical, len(devices))
+        if hierarchical is None:
+            hierarchical = (spec_hier or cfg.hierarchical_allreduce
+                            or jax.process_count() > 1)
         st.config = cfg
         st.mesh = mesh if mesh is not None else \
-            _mesh.build_mesh(devices, hierarchical=hierarchical)
+            _mesh.build_mesh(devices, hierarchical=hierarchical,
+                             dcn_size=dcn_size if hierarchical else None)
         st.initialized = True
         _ps._install_global_set()
         if process_sets:

@@ -71,6 +71,14 @@ class Config:
     # Two-level DCN x ICI reduction (NCCLHierarchicalAllreduce analogue).
     hierarchical_allreduce: bool = False
 
+    # Two-level mesh topology spec (HOROVOD_HIERARCHICAL):
+    # ``auto`` derives the slice axis from the process grouping /
+    # elastic assignment; ``rows,cols`` pins explicit (dcn, ici)
+    # extents (virtual multi-slice dry runs).  Setting it implies
+    # hierarchical_allreduce.  Parsed by
+    # ``parallel.mesh.parse_topology_spec``.
+    hierarchical: Optional[str] = None
+
     # Chrome-trace timeline output path (HOROVOD_TIMELINE).
     timeline: Optional[str] = None
     timeline_mark_cycles: bool = False
@@ -276,6 +284,7 @@ def load_config() -> Config:
         cache_capacity=_env_int("CACHE_CAPACITY", 1024),
         cycle_time=_env_float("CYCLE_TIME", 1.0),
         hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE"),
+        hierarchical=_env("HIERARCHICAL"),
         timeline=_env("TIMELINE"),
         timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES"),
         autotune=_env_bool("AUTOTUNE"),

@@ -26,7 +26,8 @@ import optax
 
 from ..collectives import ops as _ops
 from ..collectives.compression import (Compression, is_error_feedback,
-                                       is_powersgd, parse_compression,
+                                       is_hier_legs, is_powersgd,
+                                       parse_compression,
                                        wire_payload_bytes)
 from ..collectives.reduce_op import ReduceOp, Average
 from ..controller.fusion import fused_tree_collective
@@ -54,6 +55,18 @@ def _ef_enabled() -> bool:
     return cfg.ef_residual if cfg is not None else True
 
 
+def _hier_axes(axes):
+    """Resolve ``axes`` to the two-level ``(dcn, ici)`` pair, or ``None``
+    when the effective mesh is flat (single axis)."""
+    from ..core.state import global_state
+    if axes is None:
+        mesh = global_state().mesh
+        ax = tuple(mesh.axis_names) if mesh is not None else ()
+    else:
+        ax = tuple((axes,) if isinstance(axes, str) else axes)
+    return ax if len(ax) == 2 else None
+
+
 def _stateless_ef_collective(buf, compression, op, axes,
                              prescale_factor, postscale_factor):
     """One EF-codec exchange with no residual (autotune sampling, direct
@@ -63,6 +76,19 @@ def _stateless_ef_collective(buf, compression, op, axes,
         return _ops.allreduce(buf, op, axes=axes,
                               prescale_factor=prescale_factor,
                               postscale_factor=postscale_factor)
+    if is_hier_legs(compression):
+        pair = _hier_axes(axes)
+        if pair is None:
+            # Flat mesh: the DCN hop degenerates; run the EF codec over
+            # the whole (single-axis) world instead.
+            compression = compression.dcn
+        else:
+            out, _ = _ops.hierarchical_allreduce(
+                buf, op, dcn_axis=pair[0], ici_axis=pair[1],
+                dcn_codec=compression.dcn, ici_codec=compression.ici,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            return out
     if is_powersgd(compression):
         out, _ = _ops.powersgd_allreduce(
             buf, op, rank=compression.rank, axes=axes,
@@ -120,6 +146,15 @@ def allreduce_gradients(grads,
         explicit_hier = tuner.hierarchical_explicit()
     else:
         explicit_hier = bool(st.config and st.config.hierarchical_allreduce)
+        if not explicit_hier and st.config is not None \
+                and st.config.hierarchical:
+            # HOROVOD_HIERARCHICAL topology spec implies the two-level
+            # exchange (not just the two-level mesh).
+            from ..parallel.mesh import parse_topology_spec
+            try:
+                explicit_hier = parse_topology_spec(st.config.hierarchical)[0]
+            except ValueError:
+                pass
 
     def resolved_axes():
         if axes is not None:
@@ -159,8 +194,26 @@ def allreduce_gradients(grads,
                 buf, op, axes=axes, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor)
         c, ctx = compression.compress(buf)
-        if (explicit_hier and process_set is None and len(ax) == 2
-                and op in (_ops.Sum, Average)):
+        hier_ok = (process_set is None and len(ax) == 2
+                   and op in (_ops.Sum, Average))
+        if is_hier_legs(compression):
+            # Per-leg codec (ici:...,dcn:...): the exchange itself is the
+            # two-level decomposition with each hop's codec applied on
+            # that hop only.  On a flat mesh the DCN hop degenerates, so
+            # ride the psum-compatible ICI codec on the flat exchange.
+            if hier_ok:
+                r = _ops.hierarchical_allreduce(
+                    c, op, dcn_axis=ax[0], ici_axis=ax[1],
+                    dcn_codec=compression.dcn, ici_codec=compression.ici,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+                return r
+            ci, ictx = compression.ici.compress(c)
+            r = _ops.allreduce(ci, op, axes=axes, process_set=process_set,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor)
+            return compression.ici.decompress(r, ictx)
+        if explicit_hier and hier_ok:
             r = _ops.hierarchical_allreduce(
                 c, op, dcn_axis=ax[0], ici_axis=ax[1],
                 prescale_factor=prescale_factor,
@@ -246,14 +299,37 @@ def ef_bucket_plan(leaves, fusion_threshold: Optional[int], compression):
                         extra=("ef", compression.__name__))
 
 
+def ef_residual_shape(size: int, compression) -> tuple:
+    """Per-bucket residual row shape (no leading world axis).
+
+    Flat EF codecs carry ``(size,)`` -- the whole bucket's unsent error.
+    Per-leg codecs carry ``(2, shard)`` -- one row per leg of the
+    two-level exchange, where ``shard`` is the DCN hop's operand width
+    (``padded / n_ici``).  The ICI legs are exact reduce-scatter /
+    allgather, so leg 0 stays identically zero; leg 1 holds the DCN
+    codec's unsent residual.  The leg axis keeps the state
+    self-describing for join replay and elastic resize.
+    """
+    if is_hier_legs(compression):
+        from ..core.state import global_state
+        mesh = global_state().mesh
+        names = tuple(mesh.axis_names) if mesh is not None else ()
+        n_ici = int(mesh.shape[names[-1]]) if len(names) == 2 else 1
+        quantum = _ops.microbatch_pad_quantum(n_ici)
+        padded = size + (-size) % quantum
+        return (2, padded // n_ici)
+    return (int(size),)
+
+
 def ef_init_residuals(params, fusion_threshold: Optional[int], compression):
     """Zero residual carry matching the EF bucket plan of ``params``-shaped
-    gradients: one ``[world, bucket_size]`` f32 array per bucket."""
+    gradients: one ``[world, *ef_residual_shape]`` f32 array per bucket."""
     leaves = jax.tree.leaves(params)
     spec = ef_bucket_plan(leaves, fusion_threshold, compression)
     world = _ef_world()
     return tuple(
-        jnp.zeros((world, sum(s.size for s in lspecs)), jnp.float32)
+        jnp.zeros((world,) + ef_residual_shape(
+            sum(s.size for s in lspecs), compression), jnp.float32)
         for _dt, lspecs in spec.buffers)
 
 
@@ -264,13 +340,20 @@ def _note_compression_ratio(spec, compression) -> None:
     unconditionally -- the gauges are set (not incremented) because this
     fires once per trace, not per step; per-step totals come from the
     StepReport instrumentation."""
+    from ..controller.fusion import hier_mesh_shape, plan_hier_legs
     from ..core.state import global_state
+    hier_shape = hier_mesh_shape() if is_hier_legs(compression) else None
     raw = wire = 0
     for dt, lspecs in spec.buffers:
         size = sum(s.size for s in lspecs)
         itemsize = jnp.dtype(dt).itemsize
         raw += size * itemsize
-        wire += wire_payload_bytes(compression, size, itemsize)
+        if hier_shape is not None:
+            wire += sum(l.nbytes for l in plan_hier_legs(
+                size, dt, n_dcn=hier_shape[0], n_ici=hier_shape[1],
+                compression=compression))
+        else:
+            wire += wire_payload_bytes(compression, size, itemsize)
     if wire <= 0:
         return
     tl = global_state().timeline
@@ -316,19 +399,42 @@ def ef_exchange(grads, residuals, *, compression, op=Average,
     # Trace-time leg registration for the straggler report (fires once
     # per trace, exactly like _note_compression_ratio below).
     from ..timeline import spans as _spans
+    hier = is_hier_legs(compression)
+    hier_pair = _hier_axes(axes) if hier else None
+    if hier and hier_pair is None:
+        raise NotImplementedError(
+            "per-leg error-feedback compression (ici:...,dcn:powersgd/topk)"
+            " needs the two-level (dcn, ici) mesh; set HOROVOD_HIERARCHICAL"
+            " or use the flat codec spec instead")
     out_bufs, new_res = [], []
     for i, (buf, res, (dt, _ls)) in enumerate(
             zip(buffers, residuals, spec.buffers)):
-        _spans.note_leg(
-            "ef_exchange",
-            nbytes=wire_payload_bytes(compression, int(buf.size),
-                                      jnp.dtype(buf.dtype).itemsize),
-            bucket_id=i)
+        if not hier:
+            # The two-level path notes its own hier/* legs per hop.
+            _spans.note_leg(
+                "ef_exchange",
+                nbytes=wire_payload_bytes(compression, int(buf.size),
+                                          jnp.dtype(buf.dtype).itemsize),
+                bucket_id=i)
         if not jnp.issubdtype(buf.dtype, jnp.floating):
             out_bufs.append(_ops.allreduce(
                 buf, op, axes=axes, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor))
             new_res.append(res)
+            continue
+        if hier:
+            # Residual row is [2, shard]: leg 0 (ICI) is exact and stays
+            # zero, leg 1 carries the DCN hop's unsent error.
+            r_in = res[1] if feed else None
+            out, r_out = _ops.hierarchical_allreduce(
+                buf, op, dcn_axis=hier_pair[0], ici_axis=hier_pair[1],
+                dcn_codec=compression.dcn, ici_codec=compression.ici,
+                dcn_residual=r_in,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            out_bufs.append(out)
+            new_res.append(jnp.stack([jnp.zeros_like(r_out), r_out])
+                           if feed else res)
             continue
         r_in = res if feed else None
         if is_powersgd(compression):
@@ -538,14 +644,18 @@ def ef_resize_residuals(residuals, params, old_world: int, new_world: int,
         comp = _resolve_compression(compression)
         spec = ef_bucket_plan(jax.tree.leaves(params), fusion_threshold,
                               comp)
-        expected = [sum(s.size for s in lspecs)
+        # Row shape under the NEW mesh: flat codecs (size,), per-leg
+        # codecs (2, shard) -- a slice-boundary resize that changes the
+        # shard width shows up here as an irreconcilable shape and the
+        # residual is zeroed (counted) rather than silently misaligned.
+        expected = [ef_residual_shape(sum(s.size for s in lspecs), comp)
                     for _dt, lspecs in spec.buffers]
 
-    def _zeroed(size: int):
+    def _zeroed(shape):
         from ..optim.zero import _count_zeroed_residual
         _count_zeroed_residual()
         report["zeroed_buckets"] += 1
-        return jnp.zeros((new_world, size), jnp.float32)
+        return jnp.zeros((new_world,) + tuple(shape), jnp.float32)
 
     res_list = list(residuals)
     if expected is not None and len(res_list) != len(expected):
@@ -558,18 +668,18 @@ def ef_resize_residuals(residuals, params, old_world: int, new_world: int,
     out = []
     for i, r in enumerate(res_list):
         arr = np.asarray(jax.device_get(r), dtype=np.float32)
-        size = expected[i] if expected is not None else (
-            arr.shape[1] if arr.ndim == 2 else -1)
-        if arr.ndim != 2 or arr.shape[1] != size:
+        shape = tuple(expected[i]) if expected is not None else (
+            arr.shape[1:] if arr.ndim >= 2 else None)
+        if arr.ndim < 2 or shape is None or arr.shape[1:] != shape:
             logger.warning(
                 "ef_resize_residuals: bucket %d shape %s irreconcilable "
-                "with planned size %d -- zeroing it", i,
-                getattr(arr, "shape", None), size)
-            out.append(_zeroed(max(size, 0)))
+                "with planned row shape %s -- zeroing it", i,
+                getattr(arr, "shape", None), shape)
+            out.append(_zeroed(shape if shape is not None else (0,)))
             continue
         rows = arr.shape[0]
         keep = min(rows, new_world)
-        newr = np.zeros((new_world, size), np.float32)
+        newr = np.zeros((new_world,) + shape, np.float32)
         newr[:keep] = arr[:keep] * (new_world / rows)
         if rows > new_world:
             newr += arr[new_world:].sum(axis=0) / rows

@@ -52,8 +52,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..collectives import ops as _ops
 from ..collectives.compression import (Compression, fp8_quantize, is_fp8,
-                                       is_error_feedback, is_powersgd,
-                                       parse_compression, powersgd_factor_widths,
+                                       is_error_feedback, is_hier_legs,
+                                       is_powersgd, parse_compression,
+                                       powersgd_factor_widths,
                                        powersgd_matrix_shape, topk_count)
 from ..collectives.reduce_op import Average
 from ..controller.fusion import _LeafSpec
@@ -287,6 +288,22 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
     p_arenas = arena_pack(p_leaves, spec)
     idx = _ops.axis_index(axes)
     use_rs = _use_reducescatter()
+    ax = tuple((axes,) if isinstance(axes, str) else axes)
+    hier = is_hier_legs(comp) and len(ax) == 2
+    if hier:
+        # Per-leg codec on the two-level mesh: intra-slice RS FIRST so
+        # only the 1/n_ici shard ever crosses DCN, compressed leader
+        # exchange over the slice axis, allgather back in the inverse
+        # order.  The rank->shard bijection becomes (ici, dcn)-major to
+        # match that scatter order -- a bijection either way, so pack/
+        # unpack stay consistent as long as the same index is used
+        # throughout (zero_init mirrors it).
+        dcn_ax, ici_ax = ax
+        rs_axes = (ici_ax, dcn_ax)
+        idx = (lax.axis_index(ici_ax) * lax.axis_size(dcn_ax)
+               + lax.axis_index(dcn_ax))
+    else:
+        rs_axes = axes
     # Trace-time leg registration (fires once per trace, like
     # _note_compression_ratio): attributes the compiled step's exchange
     # bytes to the ZeRO RS/AG legs for the cross-rank straggler report.
@@ -297,7 +314,7 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
                         nbytes=int(g.size) * jnp.dtype(g.dtype).itemsize,
                         bucket_id=i)
         if use_rs:
-            gs = _ops.reducescatter(g, Average, axes=axes)
+            gs = _ops.reducescatter(g, Average, axes=rs_axes)
         else:
             red = _ops.allreduce(g, Average, axes=axes)
             gs = lax.dynamic_slice_in_dim(red, idx * buf.shard, buf.shard, 0)
@@ -321,14 +338,15 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
                 bucket_id=i)
             if (not jnp.issubdtype(buf.dtype, jnp.floating)
                     or buf.shard < 1):
-                full.append(_ops.allgather(new, axes=axes))
+                full.append(_ops.allgather(new, axes=rs_axes))
                 new_res.append(res)
                 continue
             delta = (new.astype(jnp.float32) - old.astype(jnp.float32))
             if feed:
                 delta = delta + res
-            recon, own = ef_delta_allgather(delta, axes=axes,
-                                            compression=comp)
+            recon, own = ef_delta_allgather(
+                delta, axes=rs_axes,
+                compression=comp.dcn if hier else comp)
             full.append(
                 (arena.astype(jnp.float32) + recon.ravel())
                 .astype(buf.dtype))
@@ -342,7 +360,16 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
         _spans.note_leg(
             "zero_ag", nbytes=int(s.size) * jnp.dtype(s.dtype).itemsize,
             bucket_id=i)
-        full.append(compressed_allgather(s, axes=axes, compression=comp))
+        if hier:
+            # Leader exchange over the slice axis rides the DCN codec;
+            # the intra-slice reassembly rides the (psum-compatible) ICI
+            # codec.
+            block = compressed_allgather(s, axes=(dcn_ax,),
+                                         compression=comp.dcn)
+            full.append(compressed_allgather(block, axes=(ici_ax,),
+                                             compression=comp.ici))
+        else:
+            full.append(compressed_allgather(s, axes=axes, compression=comp))
     new_params = jax.tree.unflatten(treedef, arena_unpack(full, spec))
     return new_params, jax.tree.map(lambda v: v[None], inner)
 
@@ -375,7 +402,12 @@ def zero_init(optimizer, params, mesh: Optional[Mesh] = None,
         leaves = jax.tree.leaves(params)
         spec = plan_arena(leaves, world)
         arenas = arena_pack(leaves, spec)
-        idx = _ops.axis_index(axes)
+        if is_hier_legs(comp) and len(axes) == 2:
+            # Match zero_apply's (ici, dcn)-major shard bijection.
+            idx = (lax.axis_index(axes[1]) * lax.axis_size(axes[0])
+                   + lax.axis_index(axes[0]))
+        else:
+            idx = _ops.axis_index(axes)
         shards = [lax.dynamic_slice_in_dim(a, idx * b.shard, b.shard, 0)
                   for a, b in zip(arenas, spec.buffers)]
         inner = optimizer.init(shards)

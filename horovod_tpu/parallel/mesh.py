@@ -35,6 +35,45 @@ FLAT_AXES: Tuple[str, ...] = (HVD_AXIS,)
 HIER_AXES: Tuple[str, ...] = (DCN_AXIS, ICI_AXIS)
 
 
+def parse_topology_spec(spec: Optional[str],
+                        n: Optional[int] = None
+                        ) -> Tuple[bool, Optional[int]]:
+    """``HOROVOD_HIERARCHICAL`` topology spec -> ``(hierarchical, dcn_size)``.
+
+    - unset / ``""`` / ``off``/``0``/``false``: not hierarchical;
+    - ``auto``/``on``/``1``/``true``: two-level, outer axis derived from
+      the process grouping (the elastic assignment's device layout);
+    - ``rows,cols``: explicit ``(dcn, ici)`` extents -- ``rows`` slices of
+      ``cols`` chips.  ``rows * cols`` must equal the device count when
+      ``n`` is known.
+
+    ``dcn_size is None`` means "group by owning process" (see
+    :func:`build_mesh`).
+    """
+    if spec is None:
+        return False, None
+    s = str(spec).strip().lower()
+    if s in ("", "0", "off", "false", "no"):
+        return False, None
+    if s in ("auto", "1", "on", "true", "yes"):
+        return True, None
+    parts = [p.strip() for p in s.split(",")]
+    if len(parts) == 2 and all(p.isdigit() for p in parts):
+        rows, cols = int(parts[0]), int(parts[1])
+        if rows < 1 or cols < 1:
+            raise ValueError(
+                f"bad HOROVOD_HIERARCHICAL spec {spec!r}: extents must "
+                f"be >= 1")
+        if n is not None and rows * cols != n:
+            raise ValueError(
+                f"HOROVOD_HIERARCHICAL={spec!r} names a {rows}x{cols} "
+                f"topology but the mesh has {n} devices")
+        return True, rows
+    raise ValueError(
+        f"bad HOROVOD_HIERARCHICAL spec {spec!r}: expected "
+        f"auto|off|<rows>,<cols>")
+
+
 def build_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     hierarchical: bool = False,

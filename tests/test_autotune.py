@@ -236,7 +236,7 @@ def test_autotuner_steps_axis_is_opt_in_and_build_time(monkeypatch):
     monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_EXEC", "1")
     t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
     assert {cfg[6] for cfg in t2.grid} == {1, 4, 16}
-    assert len(t2.trace_key()) == 5  # thr, hier, comp, zero, chunk -- no k
+    assert len(t2.trace_key()) == 6  # thr, hier, comp, zero, chunk, hc -- no k
     for want in (1, 4, 16):
         for i, cfg in enumerate(t2.grid):
             if cfg[6] == want:
@@ -256,7 +256,7 @@ def test_autotuner_pr1_log_format_warm_starts(tmp_path):
         "zero,score_bytes_per_s\n"
         f"{thr},{Config().cycle_time},0,0,0,456.0\n")
     t = Autotuner(cfg, steps_per_sample=1)
-    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 456.0) in [
+    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 0, 456.0) in [
         tuple(s) for s in t._samples]
 
 
@@ -451,7 +451,7 @@ def test_autotuner_old_log_format_warm_starts(tmp_path):
     log.write_text("fusion_threshold_bytes,cycle_time_ms,score\n"
                    f"{thr},{Config().cycle_time},123.0\n")
     t = Autotuner(cfg, steps_per_sample=1)
-    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 123.0) in [
+    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 0, 123.0) in [
         tuple(s) for s in t._samples]
 
 
@@ -472,7 +472,7 @@ def test_autotuner_microbatch_axis_is_opt_in_and_build_time(monkeypatch):
     monkeypatch.setenv("HOROVOD_AUTOTUNE_MICROBATCH", "1")
     t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
     assert {cfg[7] for cfg in t2.grid} == {1, 2, 4}
-    assert len(t2.trace_key()) == 5  # thr, hier, comp, zero, chunk only
+    assert len(t2.trace_key()) == 6  # thr, hier, comp, zero, chunk, hc only
     for want in (1, 2, 4):
         for i, cfg in enumerate(t2.grid):
             if cfg[7] == want:
@@ -506,7 +506,7 @@ def test_autotuner_warm_start_skips_unusable_rows(tmp_path):
     with pytest.warns(RuntimeWarning, match="skipped 4 unusable row"):
         t = Autotuner(cfg, steps_per_sample=1)
     assert t.warm_start_skipped == 4
-    assert (thr, ct, 0, 0, 0, 0, 1, 1, 123.0) in [
+    assert (thr, ct, 0, 0, 0, 0, 1, 1, 0, 123.0) in [
         tuple(s) for s in t._samples]
 
 

@@ -1144,3 +1144,142 @@ def test_serving_guard_trips_on_bad_entries(tmp_path):
     assert "slots" in why
     assert "vs_baseline" in why
     assert "headline value" in why
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical exchange entries (PR 11)
+# ---------------------------------------------------------------------------
+
+def scan_hier_entries(bench_dir):
+    """Return [(path, why), ...] for malformed hierarchical-exchange
+    entries.
+
+    A hier entry records the rn50-hier bench round: per-leg wire bytes
+    planned by ``plan_hier_legs`` and confirmed against the trace-time
+    span recorder on two virtual two-level meshes.  The legs must be
+    positive and sum to the recorded total, the DCN hop must undercut
+    the flat all-reduce wire (that is the point of the decomposition),
+    the plan-match and mesh-invariance flags must both hold, and
+    vs_baseline must be null (a wire-shape round on the CPU mesh has no
+    throughput peer)."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            hs = parsed.get("hier")
+            if not hs:
+                continue
+            legs = hs.get("legs")
+            if not (isinstance(legs, dict) and legs and all(
+                    isinstance(v, int) and v > 0 for v in legs.values())):
+                bad.append((path, f"legs must be a non-empty dict of "
+                                  f"positive byte counts, got {legs!r}"))
+                continue
+            total = hs.get("total_wire_bytes")
+            if sum(legs.values()) != total:
+                bad.append((path, f"per-leg bytes {sum(legs.values())} "
+                                  f"!= total_wire_bytes {total!r}"))
+            dcn = legs.get("hier/dcn_ar")
+            flat = hs.get("flat_allreduce_bytes")
+            if dcn is None:
+                bad.append((path, "no hier/dcn_ar leg: nothing crossed "
+                                  "the DCN hop"))
+            elif not (isinstance(flat, int) and 0 < dcn < flat):
+                bad.append((path, f"DCN leg {dcn!r} must undercut the "
+                                  f"flat all-reduce wire {flat!r}"))
+            if not hs.get("legs_match_plan"):
+                bad.append((path, "recorded legs diverged from "
+                                  "plan_hier_legs"))
+            if not hs.get("mesh_invariant"):
+                bad.append((path, "per-leg bytes varied across meshes "
+                                  "sharing the ICI extent"))
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "hier entries must carry a null "
+                                  "vs_baseline on the CPU mesh"))
+    return bad
+
+
+def test_committed_hier_entries_well_formed():
+    assert scan_hier_entries(REPO) == []
+
+
+def test_committed_hier_round_undercuts_flat_wire():
+    """Acceptance gate: a committed bench round must record the two-level
+    exchange with plan-matched, mesh-invariant legs whose DCN hop carries
+    less than the flat all-reduce would."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            hs = (entry.get("parsed") or {}).get("hier")
+            if hs:
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries a hier block"
+    for path, parsed in found:
+        hs = parsed["hier"]
+        assert parsed["metric"] == "hier_dcn_wire_reduction", path
+        assert hs["legs_match_plan"] and hs["mesh_invariant"], (path, hs)
+        assert 0 < hs["legs"]["hier/dcn_ar"] \
+            < hs["flat_allreduce_bytes"], (path, hs)
+        assert len(hs["ns"]) >= 2, (path, hs["ns"])
+
+
+def _write_hier(tmp_path, name, hs, vs_baseline=None):
+    parsed = {"metric": "hier_dcn_wire_reduction", "value": 128.0,
+              "unit": "x", "vs_baseline": vs_baseline,
+              "config": "rn50_hier_ici32_fp8dcn",
+              "baseline_config": "batch256_s2d_bf16", "hier": hs}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 12, "cmd": "bench_scaling.py --models rn50-hier", "rc": 0,
+         "tail": "", "parsed": parsed}))
+
+
+def _good_hier_block():
+    return {"dcn_codec": "fp8", "ns": [64, 256],
+            "meshes": {"64": [2, 32], "256": [8, 32]},
+            "legs": {"hier/ici_rs": 102228992, "hier/dcn_ar": 798664,
+                     "hier/ici_ag": 102228992},
+            "total_wire_bytes": 205256648,
+            "flat_allreduce_bytes": 102228128,
+            "dcn_vs_flat_ratio": 128.0,
+            "legs_match_plan": True, "mesh_invariant": True, "buckets": 2}
+
+
+def test_hier_guard_accepts_good_entry(tmp_path):
+    _write_hier(tmp_path, "BENCH_r85.json", _good_hier_block())
+    assert scan_hier_entries(str(tmp_path)) == []
+
+
+def test_hier_guard_trips_on_bad_entries(tmp_path):
+    bad = _good_hier_block()
+    bad.update({"total_wire_bytes": 1,        # legs don't sum to total
+                "legs_match_plan": False,     # recorder/planner diverged
+                "mesh_invariant": False})     # legs moved across meshes
+    bad["legs"] = dict(bad["legs"],
+                       **{"hier/dcn_ar": bad["flat_allreduce_bytes"] * 2})
+    _write_hier(tmp_path, "BENCH_r86.json", bad)
+    _write_hier(tmp_path, "BENCH_r87.json",
+                dict(_good_hier_block(), legs={}))   # nothing recorded
+    _write_hier(tmp_path, "BENCH_r88.json",
+                dict(_good_hier_block(),
+                     legs={"hier/ici_rs": 204457984},
+                     total_wire_bytes=204457984))    # DCN leg missing
+    _write_hier(tmp_path, "BENCH_r89.json", _good_hier_block(),
+                vs_baseline=1.0)                     # must be null on CPU
+    why = " ".join(w for _, w in scan_hier_entries(str(tmp_path)))
+    assert "total_wire_bytes" in why
+    assert "undercut the flat all-reduce" in why
+    assert "diverged from" in why
+    assert "varied across meshes" in why
+    assert "non-empty dict" in why
+    assert "nothing crossed" in why
+    assert "vs_baseline" in why

@@ -425,9 +425,12 @@ def test_alltoallv_strict_mode_raises_on_drop(hvd, n_devices):
                                   max_count=max_count, strict=True)
         return recv[None], rc[None]
 
+    # check_vma off: shard_map has no replication rule for checkify's
+    # check primitive, and rejecting it at trace time would preempt the
+    # functionalized error this test is about.
     fs = checkify.checkify(jax.jit(jax.shard_map(
         f, mesh=mesh, in_specs=(P(axes), P(axes)),
-        out_specs=(P(axes),) * 2)))
+        out_specs=(P(axes),) * 2, check_vma=False)))
 
     # Lossless strict exchange: no error.
     x, c = build([1] * n)
@@ -474,7 +477,8 @@ def test_alltoallv_strict_env_default(hvd, n_devices, monkeypatch):
         return recv[None], rc[None]
 
     fs = jax.jit(jax.shard_map(
-        f, mesh=mesh, in_specs=(P(axes), P(axes)), out_specs=(P(axes),) * 2))
+        f, mesh=mesh, in_specs=(P(axes), P(axes)),
+        out_specs=(P(axes),) * 2, check_vma=False))
     with pytest.raises(Exception, match="(?i)checkify|functionaliz"):
         fs(jnp.asarray(datas), jnp.asarray(splits))
 
