@@ -112,6 +112,20 @@ SERVING_BENCH = _env_on("BENCH_SERVING")
 SERVING_REQUESTS = int(os.environ.get("BENCH_SERVING_REQUESTS", "24"))
 SERVING_RATE = float(os.environ.get("BENCH_SERVING_RATE", "50"))
 SERVING_SLOTS = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+# BENCH_AUTOSCALE=1 runs the SLO-driven elastic serving drill: the same
+# LLAMA_SERVE decoder behind the ServingControlPlane, with a kill@ +
+# slow@ chaos spec fired virtually under the Poisson load.  The closed
+# loop must shrink off the dead rank, auto-evict the slow one, and carry
+# every in-flight request across both transitions (drain/re-prefill);
+# the recorded SLO-violation seconds are gated against the budget by
+# tests/test_bench_guard.py::scan_autoscale_entries.
+AUTOSCALE_BENCH = _env_on("BENCH_AUTOSCALE")
+AUTOSCALE_REQUESTS = int(os.environ.get("BENCH_AUTOSCALE_REQUESTS", "48"))
+AUTOSCALE_RATE = float(os.environ.get("BENCH_AUTOSCALE_RATE", "40"))
+AUTOSCALE_SPEC = os.environ.get(
+    "BENCH_AUTOSCALE_SPEC",
+    "kill@step=20,rank=7;slow@step=35,rank=2,secs=0.2")
+AUTOSCALE_BUDGET_S = float(os.environ.get("BENCH_AUTOSCALE_BUDGET_S", "30"))
 
 
 def _config() -> str:
@@ -310,6 +324,84 @@ def _main_serving():
     os._exit(0)
 
 
+def _main_autoscale():
+    """BENCH_AUTOSCALE=1: closed-loop elastic serving chaos drill."""
+    from horovod_tpu.utils.platform import force_host_device_count
+    force_host_device_count(8, cpu=True)  # before jax touches the backend
+    import jax
+    import jax.numpy as jnp
+    from horovod_tpu import serving
+    from horovod_tpu.models.transformer import LLAMA_SERVE, LlamaLM
+
+    cfg = LLAMA_SERVE
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    policy_cfg = serving.PolicyConfig(
+        interval_s=0.05, ttft_slo_s=2.0, queue_high=20,
+        occupancy_low=0.15, hysteresis=2, cooldown_s=0.3,
+        evict_lateness_s=0.05, drain_steps=8)
+    plane = serving.ServingControlPlane(
+        cfg, params, devices=jax.devices()[:8], initial_tp=8,
+        policy_config=policy_cfg, chaos_spec=AUTOSCALE_SPEC,
+        slots=SERVING_SLOTS, page_size=8, max_len=64)
+    spec = serving.LoadSpec(num_requests=AUTOSCALE_REQUESTS,
+                            rate_rps=AUTOSCALE_RATE,
+                            prompt_lens=(4, 8, 16), output_lens=(8, 16, 24),
+                            vocab_size=cfg.vocab_size, seed=11)
+    rep = plane.serve(serving.generate(spec))
+
+    config = (f"llama_serve_ctl_w8_slots{SERVING_SLOTS}_"
+              + AUTOSCALE_SPEC.replace("@", "").replace("=", "")
+                .replace(",", "_").replace(";", "_").replace(".", "p"))
+    result = {
+        "metric": "autoscale_slo_violation_seconds",
+        "value": round(rep.slo_violation_s, 3),
+        "unit": "s",
+        "vs_baseline": None,  # closed-loop drill: no throughput peer
+        "config": config,
+        "baseline_config": f"llama_serve_w8_slots{SERVING_SLOTS}",
+        "autoscale": {
+            "world": 8,
+            "initial_tp": rep.mesh_size_initial,
+            "final_tp": rep.mesh_size_final,
+            "chaos_spec": AUTOSCALE_SPEC,
+            "decisions": rep.decision_counts,
+            "resizes": rep.resizes,
+            "evicted_ranks": rep.evicted_ranks,
+            "dead_ranks": rep.dead_ranks,
+            "drained_completed": rep.drained_completed,
+            "drained_reprefilled": rep.drained_reprefilled,
+            "drain_leaked_pages": rep.drain_leaked_pages,
+            "lost_requests": rep.lost_requests,
+            "slo_violation_s": round(rep.slo_violation_s, 3),
+            "slo_budget_s": AUTOSCALE_BUDGET_S,
+            "requests": rep.serving.num_requests,
+            "completed": rep.serving.completed,
+            "rejected": rep.serving.rejected,
+            "new_tokens": rep.serving.new_tokens,
+            "decode_steps": rep.serving.decode_steps,
+            "tokens_per_s": round(rep.serving.tokens_per_s, 2),
+            "policy": {
+                "interval_s": policy_cfg.interval_s,
+                "ttft_slo_s": policy_cfg.ttft_slo_s,
+                "queue_high": policy_cfg.queue_high,
+                "occupancy_low": policy_cfg.occupancy_low,
+                "hysteresis": policy_cfg.hysteresis,
+                "cooldown_s": policy_cfg.cooldown_s,
+                "evict_lateness_s": policy_cfg.evict_lateness_s,
+                "drain_steps": policy_cfg.drain_steps,
+            },
+            "load": {"rate_rps": AUTOSCALE_RATE,
+                     "num_requests": AUTOSCALE_REQUESTS,
+                     "prompt_lens": list(spec.prompt_lens),
+                     "output_lens": list(spec.output_lens),
+                     "seed": spec.seed},
+        },
+    }
+    print(json.dumps(result), flush=True)
+    os._exit(0)
+
+
 def state_batch_after_restore(batch_at_fault: int, commit_every: int) -> int:
     """The batch counter the restore rolled back to (last commit)."""
     return (batch_at_fault // commit_every) * commit_every
@@ -446,6 +538,8 @@ def main():
         _main_chaos()
     if SERVING_BENCH:
         _main_serving()
+    if AUTOSCALE_BENCH:
+        _main_autoscale()
     if OVERLAP and ZERO:
         sys.exit("BENCH_OVERLAP / HOROVOD_MICROBATCHES>1 is incompatible "
                  "with HOROVOD_ZERO=1 (the ZeRO arena exchange is already "

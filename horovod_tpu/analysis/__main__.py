@@ -4,10 +4,11 @@ Modes (default ``--all``):
 
 - ``--lint``: AST rules over the ``horovod_tpu/`` source tree;
 - ``--step-audit``: trace-audit the reference step configurations
-  (plain DP, ZeRO-1, powersgd+EF, microbatches=2 on the flat mesh, then
-  the hierarchical trio -- plain hier, hier+ZeRO-1, hier+EF-on-DCN -- on
-  a two-level remesh of the same virtual CPU devices) and cross-check
-  emitted collectives against their plans;
+  (plain DP, ZeRO-1, powersgd+EF, microbatches=2 on the flat mesh, the
+  serving tp-decode step at full tp and on the post-shrink resized
+  mesh, then the hierarchical trio -- plain hier, hier+ZeRO-1,
+  hier+EF-on-DCN -- on a two-level remesh of the same virtual CPU
+  devices) and cross-check emitted collectives against their plans;
 - ``--all``: both.
 
 Findings matching ``analysis_baseline.txt`` (``--baseline`` to override)
@@ -61,9 +62,13 @@ def _run_step_audit(devices: int):
     force_host_device_count(devices, cpu=True)
     import horovod_tpu as hvd
     hvd.init()
-    from .trace_audit import HIER_CONFIGS, audit_standard_configs
+    from .trace_audit import (HIER_CONFIGS, SERVING_CONFIGS,
+                              audit_standard_configs)
     try:
         reports = audit_standard_configs()
+        # Serving decode contract, at full tp and on the post-shrink
+        # mesh the elastic control plane leaves behind.
+        reports.update(audit_standard_configs(SERVING_CONFIGS))
     finally:
         hvd.shutdown()
     if devices >= 4 and devices % 2 == 0:

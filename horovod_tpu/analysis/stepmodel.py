@@ -337,9 +337,18 @@ def _expected_serving_decode(meta: dict) -> ExpectedExchange:
     rows = [{"bucket": 0, "dtype": dtype, "leaves": 2 * layers,
              "elements": 2 * layers * elements,
              "kind": "serving-tp-decode"}]
-    return ExpectedExchange(ops=ops, plan_rows=rows, notes=(
-        f"serving decode: 2 row-parallel allreduces/layer x {layers} "
-        f"layer(s), {elements} elements each",))
+    notes = [f"serving decode: 2 row-parallel allreduces/layer x {layers} "
+             f"layer(s), {elements} elements each"]
+    # A rebuilt step after an elastic resize carries provenance; the
+    # contract is mesh-size invariant (the psum payload is the full
+    # residual activation regardless of how many ranks reduce it), so
+    # the SAME expected ops must match on the post-shrink mesh.
+    if meta.get("resized_from"):
+        notes.append(
+            f"resized decode mesh: tp {meta['resized_from']} -> "
+            f"{meta.get('tp', meta.get('world'))}; activation contract "
+            "is mesh-size invariant")
+    return ExpectedExchange(ops=ops, plan_rows=rows, notes=tuple(notes))
 
 
 def _ef_ops(rows: List[dict], comp,

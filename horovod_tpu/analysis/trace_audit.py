@@ -240,6 +240,13 @@ STANDARD_CONFIGS = ("plain", "zero1", "powersgd_ef", "microbatch2")
 # (``build_mesh(devices, hierarchical=True, dcn_size=...)``).
 HIER_CONFIGS = ("hier", "hier_zero1", "hier_powersgd_ef")
 
+# Serving decode configurations: the tensor-parallel decode step on the
+# full tp ladder and on the post-shrink mesh the elastic control plane
+# leaves behind, so the exchange contract (2 row-parallel psums per
+# layer of slots*d_model at the activation dtype) is gated across
+# resizes, not only at the size serving happened to start at.
+SERVING_CONFIGS = ("serving_decode", "serving_decode_resized")
+
 # Threshold chosen so the tiny parameter tree below splits into TWO f32
 # buckets (256 + 192 elements), exercising multi-bucket matching.
 _TINY_THRESHOLD = 1024
@@ -325,11 +332,58 @@ def build_standard_config(config: str):
                 fusion_threshold=_TINY_THRESHOLD)
             step = _training.make_train_step(_tiny_loss, opt, mesh=mesh)
             opt_state = opt.init(params)
+    elif config in SERVING_CONFIGS:
+        return _build_serving_config(config)
     else:
-        raise ValueError(f"unknown standard config {config!r}; "
-                         f"pick from {STANDARD_CONFIGS + HIER_CONFIGS}")
+        raise ValueError(
+            f"unknown standard config {config!r}; pick from "
+            f"{STANDARD_CONFIGS + HIER_CONFIGS + SERVING_CONFIGS}")
     # donate_argnums mirrors make_train_step's own (0, 1) donation.
     return step, (params, opt_state, batch), (0, 1), f"step:{config}"
+
+
+def _build_serving_config(config: str):
+    """``(step, args, None, name)`` for the serving decode audits.
+
+    ``serving_decode`` builds on the largest valid tp size the device
+    pool allows; ``serving_decode_resized`` on the next size down --
+    the mesh the control plane's shrink path lands on -- with
+    ``resized_from`` provenance in the step meta so the expected model
+    notes the transition.  No donation: the decode step's pool aliasing
+    is the engine's business, not the trainer's.
+    """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..models.transformer import LLAMA_SERVE, LlamaLM
+    from ..serving import (CacheConfig, PagedKVCache, build_decode_step,
+                           cache_sharding)
+    from ..serving.policy import valid_tp_sizes
+
+    cfg = LLAMA_SERVE
+    sizes = valid_tp_sizes(cfg, len(jax.devices()))
+    tp = sizes[-1]
+    resized_from = None
+    if config == "serving_decode_resized" and len(sizes) > 1:
+        resized_from, tp = sizes[-1], sizes[-2]
+    mesh = Mesh(np.asarray(jax.devices()[:tp], dtype=object).reshape(tp),
+                ("tp",))
+    ccfg = CacheConfig(
+        num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim, slots=4, page_size=8, max_len=64)
+    cache = PagedKVCache(ccfg, cache_sharding(mesh))
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 4), jnp.int32))
+    step = build_decode_step(cfg, mesh, slots=ccfg.slots,
+                             page_size=ccfg.page_size,
+                             pages_per_slot=ccfg.pages_per_slot)
+    if resized_from is not None:
+        step._meta["resized_from"] = resized_from
+    args = (params, cache.k, cache.v,
+            jnp.zeros((ccfg.slots,), jnp.int32), cache.lengths_device(),
+            cache.table_device(), jnp.zeros((ccfg.slots,), bool))
+    return step, args, None, f"step:{config}"
 
 
 def audit_standard_configs(configs: Optional[Sequence[str]] = None

@@ -8,7 +8,7 @@ Gloo rendezvous rounds.
 """
 
 from .state import State, ObjectState, JaxState  # noqa: F401
-from .run_loop import run, check_for_host_updates  # noqa: F401
+from .run_loop import run, check_for_host_updates, apply_resize  # noqa: F401
 from .sampler import ElasticSampler  # noqa: F401
 from .discovery import HostDiscoveryScript  # noqa: F401
 from . import chaos  # noqa: F401

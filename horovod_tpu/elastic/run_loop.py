@@ -244,6 +244,43 @@ def _desync_max_retries() -> int:
     return load_config().desync_max_retries
 
 
+def apply_resize(state, old_size, new_size) -> None:
+    """World-size transition sequence, shared by the training loop and
+    the serving control plane.
+
+    Exactly the reset/resize steps ``_elastic_loop`` runs after a
+    re-rendezvous, with no training assumptions: account lost ranks in
+    ``horovod_elastic_ranks_lost``, hand the transition to
+    ``state.resize`` when the carrier implements it (checkpointless
+    repartition for a training carry, drain/re-prefill for a serving
+    mesh), fall back to plain sync semantics when resize fails, and
+    finish with ``state.on_reset()``.  ``old_size`` may be ``None``
+    (first rendezvous -- nothing to resize).
+    """
+    if old_size is not None and new_size != old_size:
+        from ..timeline import metrics as _metrics
+        if new_size < old_size:
+            _metrics.registry().counter(
+                "horovod_elastic_ranks_lost",
+                "Ranks lost across elastic recoveries").inc(
+                    old_size - new_size)
+        if hasattr(state, "resize"):
+            try:
+                report = state.resize(old_size, new_size)
+                logger.info(
+                    "checkpointless resize %d -> %d: %s",
+                    old_size, new_size, report)
+            except Exception:
+                # sync() still rebroadcasts whatever rank 0
+                # holds; worst case the optimizer state is
+                # re-derived instead of carried.
+                logger.exception(
+                    "checkpointless resize %d -> %d failed; "
+                    "falling back to plain sync", old_size,
+                    new_size)
+    state.on_reset()
+
+
 def _elastic_loop(func, state, notifier, args, kwargs):
     from . import preemption
 
@@ -273,28 +310,7 @@ def _elastic_loop(func, state, notifier, args, kwargs):
                 # stopped the metadata poll; re-arm it for the new life.
                 preemption.start_gce_poll()
             new_size = _basics.size()
-            if old_size is not None and new_size != old_size:
-                from ..timeline import metrics as _metrics
-                if new_size < old_size:
-                    _metrics.registry().counter(
-                        "horovod_elastic_ranks_lost",
-                        "Ranks lost across elastic recoveries").inc(
-                            old_size - new_size)
-                if hasattr(state, "resize"):
-                    try:
-                        report = state.resize(old_size, new_size)
-                        logger.info(
-                            "checkpointless resize %d -> %d: %s",
-                            old_size, new_size, report)
-                    except Exception:
-                        # sync() still rebroadcasts whatever rank 0
-                        # holds; worst case the optimizer state is
-                        # re-derived instead of carried.
-                        logger.exception(
-                            "checkpointless resize %d -> %d failed; "
-                            "falling back to plain sync", old_size,
-                            new_size)
-            state.on_reset()
+            apply_resize(state, old_size, new_size)
             reset_required = False
         try:
             # sync() ends in commit(), which may itself raise

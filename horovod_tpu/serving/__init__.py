@@ -5,9 +5,14 @@ the tensor-parallel decode step routes its activation collectives
 through ``collectives/ops.py`` (fusion planner / span recorder / static
 auditor all see them), per-request lifecycle lands in the PR 6
 MetricsRegistry, and per-leg decode time is attributed by the PR 9
-span layer exactly like training time.
+span layer exactly like training time.  On top sits the SLO-driven
+control plane (``controlplane``/``policy``): autoscale, graceful drain,
+and straggler eviction closed-loop over the same elastic resize path
+the training loop uses.
 """
 
+from .controlplane import (ControlPlaneReport,  # noqa: F401
+                           ServingControlPlane)
 from .decode import (build_decode_step, decode_param_specs,  # noqa: F401
                      greedy_sample, prefill_forward, stack_adapters,
                      ServingDecodeStep)
@@ -16,4 +21,6 @@ from .engine import (RequestPrefetcher, ServingEngine,  # noqa: F401
 from .kvcache import (CacheConfig, PagedKVCache,  # noqa: F401
                       cache_sharding)
 from .loadgen import LoadSpec, generate  # noqa: F401
+from .policy import (Decision, PolicyConfig, ScalePolicy,  # noqa: F401
+                     SLOSample, valid_tp_sizes)
 from .scheduler import ContinuousBatchScheduler, Request  # noqa: F401

@@ -184,6 +184,54 @@ class ServingEngine:
             first = int(greedy_sample(logits[:, -1, :])[0])
         return first
 
+    # -- elastic resize hooks (driven by serving.controlplane) -------------
+    def rebuild_mesh(self, mesh) -> None:
+        """Swap the decode data plane onto a new tp mesh.
+
+        The cache LAYOUT is mesh-size invariant by contract
+        (``CacheConfig.layout``), so a resize is: fresh page pool with
+        the new kv-head sharding, same scheduler (queue and in-flight
+        request identity survive), and a rebuilt decode step.  The
+        jitted ``_prefill`` is replicated math and carries over as-is --
+        suspended requests are re-prefilled through it onto the new
+        pool via :meth:`re_prefill`.
+        """
+        old_tp = int(self.mesh.devices.size)
+        self.mesh = mesh
+        self.cache = PagedKVCache(self.cache_config, cache_sharding(mesh))
+        self.scheduler.cache = self.cache
+        self.step = build_decode_step(
+            self.config, mesh, slots=self.slots, page_size=self.page_size,
+            pages_per_slot=self.cache_config.pages_per_slot,
+            dtype=self.dtype, with_lora=self.adapters is not None,
+            lora_alpha=self.lora_alpha)
+        # The auditor's serving branch notes resize provenance so the
+        # post-shrink gate can assert the exchange contract held.
+        self.step._meta["resized_from"] = old_tp
+
+    def re_prefill(self, slot: int, req: Request) -> int:
+        """Rebuild a suspended request's KV on the CURRENT mesh from its
+        prompt + emitted tokens; returns the next decode input token.
+
+        All emitted tokens except the last are part of the restored
+        context (their K/V must be resident); the last token is the one
+        the next decode step consumes, exactly as if it had just been
+        sampled on this mesh.
+        """
+        if not req.tokens:
+            raise ValueError(f"request {req.rid} has no emitted tokens")
+        full = np.concatenate([
+            np.asarray(req.prompt, np.int32),
+            np.asarray(req.tokens[:-1], np.int32)])
+        with _spans.recorder().span("dispatch", name="reprefill",
+                                    leg="serving_reprefill"):
+            aid = jnp.int32(req.adapter_id) if self.adapters is not None \
+                else None
+            _, kl, vl = self._prefill(
+                self.params, jnp.asarray(full)[None], self.adapters, aid)
+            self.cache.write_prefill(slot, kl[:, 0], vl[:, 0])
+        return int(req.tokens[-1])
+
     # -- the serve loop ----------------------------------------------------
     def serve(self, requests: Sequence[Request]) -> ServingReport:
         """Run the open-loop request stream to completion."""

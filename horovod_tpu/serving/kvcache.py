@@ -112,6 +112,12 @@ class PagedKVCache:
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def allocated_pages(self) -> int:
+        """Pages currently held by slots (free_pages + allocated_pages
+        == num_pages is the pool invariant the drain tests assert)."""
+        return int(self._allocated.sum())
+
     def can_admit(self, length: int) -> bool:
         """Whether a sequence of ``length`` tokens fits the pool now."""
         need = -(-max(int(length), 1) // self.config.page_size)
@@ -142,6 +148,22 @@ class PagedKVCache:
             self._free.append(int(self.page_table[slot, i]))
         self._allocated[slot] = 0
         self.lengths[slot] = 0
+
+    def release_all(self) -> int:
+        """Free every slot and return how many pages that recovered.
+
+        The drain path frees each suspended slot individually, so a
+        healthy shrink sees ``release_all() == 0`` afterwards -- the
+        control-plane tests use that as the exact-release check (a
+        non-zero return means a slot leaked its pages past the drain).
+        """
+        freed = 0
+        for slot in range(self.config.slots):
+            n = int(self._allocated[slot])
+            if n:
+                freed += n
+                self.free_slot(slot)
+        return freed
 
     # -- device writes -----------------------------------------------------
     def write_prefill(self, slot: int, k_layers, v_layers) -> None:

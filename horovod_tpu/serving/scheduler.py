@@ -8,14 +8,21 @@ request finishes, and evicts nothing by default (admission is gated on
 KV page availability via :meth:`PagedKVCache.can_admit`, so an admitted
 request can always run to completion).
 
-Lifecycle: ``queued -> prefill -> decode -> done``.  Every transition is
-instrumented through the PR 6 :class:`MetricsRegistry` --
+Lifecycle: ``queued -> prefill -> decode -> done``, with a ``draining``
+detour used by the elastic control plane: a draining slot stops
+admitting follow-on work and its request either runs to completion
+(``completed``) or is ``suspended`` -- popped off the batch with its KV
+pages freed -- to be restored and re-prefilled on the post-resize
+mesh.  Every transition is instrumented through the PR 6
+:class:`MetricsRegistry` --
 
 * ``horovod_serving_requests_total{event}`` -- submitted / admitted /
-  completed / rejected transitions,
+  completed / rejected / draining / suspended / reprefill transitions,
 * ``horovod_serving_tokens_total{phase}`` -- prefill vs decode tokens,
 * ``horovod_serving_queue_depth`` / ``horovod_serving_batch_occupancy``
-  gauges,
+  gauges plus ``horovod_serving_slot_states{state}`` (active / draining
+  / free slot counts, so dashboards can tell a draining batch from an
+  idle one),
 * ``horovod_serving_ttft_seconds`` / ``horovod_serving_token_latency_seconds``
   histograms (time-to-first-token, per-output-token latency)
 
@@ -48,7 +55,7 @@ class Request:
     max_new_tokens: int
     adapter_id: int = 0
     arrival_s: float = 0.0             # open-loop arrival offset
-    state: str = "queued"              # queued|prefill|decode|done
+    state: str = "queued"              # queued|prefill|decode|draining|done
     slot: int = -1
     tokens: List[int] = dataclasses.field(default_factory=list)
     admit_s: Optional[float] = None
@@ -82,6 +89,7 @@ class ContinuousBatchScheduler:
         self.queue: "collections.deque[Request]" = collections.deque()
         self.active: dict[int, Request] = {}
         self._free_slots = list(range(slots - 1, -1, -1))  # pop() -> 0, 1...
+        self.admitting = True
         reg = _registry()
         self._m_requests = reg.counter(
             "horovod_serving_requests_total",
@@ -100,15 +108,28 @@ class ContinuousBatchScheduler:
         self._m_tok_lat = reg.histogram(
             "horovod_serving_token_latency_seconds",
             "Per-output-token latency", buckets=LATENCY_BUCKETS)
+        self._m_slot_states = reg.gauge(
+            "horovod_serving_slot_states",
+            "Decode-batch slots by lifecycle state",
+            labelnames=("state",))
 
     # -- state gauges ------------------------------------------------------
     @property
     def occupancy(self) -> float:
         return len(self.active) / self.slots
 
+    @property
+    def draining_slots(self) -> List[int]:
+        return [s for s, r in self.active.items() if r.state == "draining"]
+
     def _update_gauges(self) -> None:
         self._m_queue.set(len(self.queue))
         self._m_occ.set(self.occupancy)
+        draining = len(self.draining_slots)
+        self._m_slot_states.labels(state="draining").set(draining)
+        self._m_slot_states.labels(state="active").set(
+            len(self.active) - draining)
+        self._m_slot_states.labels(state="free").set(len(self._free_slots))
 
     # -- transitions -------------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -129,6 +150,9 @@ class ContinuousBatchScheduler:
         ``(slot, request)`` pairs the engine must now prefill.
         """
         out: List[Tuple[int, Request]] = []
+        if not self.admitting:
+            self._update_gauges()
+            return out
         while self.queue and self._free_slots:
             req = self.queue[0]
             # +1: room for at least one generated token beyond the prompt.
@@ -174,6 +198,55 @@ class ContinuousBatchScheduler:
             event="completed" if completed else "evicted").inc()
         self._update_gauges()
         return req
+
+    # -- drain lifecycle (elastic control plane) ---------------------------
+    def pause_admission(self) -> None:
+        """Stop moving queued requests into slots (drain is starting).
+        Queued requests keep accumulating and admit again on resume."""
+        self.admitting = False
+        self._update_gauges()
+
+    def resume_admission(self) -> None:
+        self.admitting = True
+        self._update_gauges()
+
+    def mark_draining(self, slot: int) -> Request:
+        """decode -> draining: the slot finishes its request but admits
+        no successor; the mesh under it is about to change."""
+        req = self.active[slot]
+        req.state = "draining"
+        self._m_requests.labels(event="draining").inc()
+        self._update_gauges()
+        return req
+
+    def suspend(self, slot: int) -> Request:
+        """draining -> suspended: pull the request out of the batch with
+        its progress intact (prompt + emitted tokens) and free the
+        slot's KV pages.  The request is NOT done -- it must be
+        restored and re-prefilled on the surviving mesh."""
+        req = self.active.pop(slot)
+        req.state = "suspended"
+        req.slot = -1
+        self._free_slots.append(slot)
+        if self.cache is not None:
+            self.cache.free_slot(slot)
+        self._m_requests.labels(event="suspended").inc()
+        self._update_gauges()
+        return req
+
+    def restore(self, req: Request) -> int:
+        """suspended -> decode on the post-resize mesh: assign a free
+        slot; the engine re-prefills prompt + emitted tokens into it."""
+        if not self._free_slots:
+            raise RuntimeError(
+                f"no free slot to restore request {req.rid}")
+        slot = self._free_slots.pop()
+        req.slot = slot
+        req.state = "decode"
+        self.active[slot] = req
+        self._m_requests.labels(event="reprefill").inc()
+        self._update_gauges()
+        return slot
 
     def has_work(self) -> bool:
         return bool(self.queue or self.active)

@@ -606,9 +606,81 @@ def install_default_metrics() -> None:
                 "failure")
     reg.counter("horovod_autotune_samples_total",
                 "Autotuner samples scored (one per sample window)")
+    # Serving control-plane decision families (serving.controlplane).
+    reg.counter("horovod_ctl_decisions_total",
+                "Serving control-plane decisions by action",
+                labelnames=("action",))
+    reg.counter("horovod_ctl_resizes_total",
+                "Decode-mesh resizes executed by the control plane",
+                labelnames=("direction",))
+    reg.counter("horovod_ctl_evictions_total",
+                "Ranks removed from the serving fleet by the control "
+                "plane", labelnames=("reason",))
+    reg.counter("horovod_ctl_drained_requests_total",
+                "In-flight requests carried through a resize, by drain "
+                "path", labelnames=("path",))
+    reg.counter("horovod_ctl_slo_violation_seconds_total",
+                "Seconds the sampled SLO (TTFT p99 / queue depth) was "
+                "in violation")
+    reg.gauge("horovod_ctl_mesh_size",
+              "Current decode-mesh tensor-parallel size")
+    reg.gauge("horovod_ctl_healthy_ranks",
+              "Devices the control plane still considers usable")
+    reg.gauge("horovod_ctl_ttft_p99_seconds",
+              "Windowed TTFT p99 as sampled by the control plane")
     reg.add_collector(_collect_plan_cache)
     reg.add_collector(_collect_deferred_fuse)
     reg.add_collector(_collect_eager)
+
+
+# -- histogram arithmetic --------------------------------------------------
+
+def histogram_window(curr: dict, base: Optional[dict]) -> dict:
+    """Subtract a baseline cumulative snapshot from a newer one.
+
+    Both arguments are ``Histogram.snapshot()`` dicts.  The result
+    covers only the observations made after ``base`` was taken -- how
+    the serving control plane turns the process-lifetime TTFT histogram
+    into a per-sampling-window distribution (the registry is
+    append-only, so windows are diffs, as with PromQL ``increase()``).
+    """
+    if not base:
+        return curr
+    base_buckets = base.get("buckets", {})
+    return {
+        "buckets": {le: int(c) - int(base_buckets.get(le, 0))
+                    for le, c in curr["buckets"].items()},
+        "sum": float(curr.get("sum", 0.0)) - float(base.get("sum", 0.0)),
+        "count": int(curr.get("count", 0)) - int(base.get("count", 0)),
+    }
+
+
+def histogram_quantile(snap: dict, q: float) -> Optional[float]:
+    """Quantile estimate from a cumulative ``Histogram.snapshot()``.
+
+    Prometheus ``histogram_quantile`` semantics: find the first bucket
+    whose cumulative count covers rank ``q * count`` and interpolate
+    linearly inside it; observations in the ``+Inf`` overflow clamp to
+    the highest finite bound.  Returns ``None`` on an empty snapshot.
+    """
+    total = int(snap.get("count", 0))
+    if total <= 0:
+        return None
+    items = sorted(
+        (float("inf") if le == "+Inf" else float(le), int(c))
+        for le, c in snap.get("buckets", {}).items())
+    rank = max(0.0, min(1.0, float(q))) * total
+    prev_bound, prev_count = 0.0, 0
+    for bound, count in items:
+        if count >= rank and count > prev_count:
+            if bound == float("inf"):
+                return prev_bound
+            frac = (rank - prev_count) / (count - prev_count)
+            return prev_bound + (bound - prev_bound) * frac
+        prev_count = count
+        if bound != float("inf"):
+            prev_bound = bound
+    return None
 
 
 # -- bench integration -----------------------------------------------------

@@ -25,6 +25,14 @@ Exports through the PR-6 metrics registry::
 and logs a stall warning when a rank that has reported before goes
 silent for longer than ``HOROVOD_STALL_CHECK_TIME_SECONDS`` (the same
 knob the core stall inspector honours).
+
+The serving control plane attaches an *eviction hook*
+(:meth:`StragglerMonitor.add_eviction_hook`): when the straggler's
+lateness EWMA crosses the hook's threshold the callback fires once per
+rank (latched), outside the monitor lock, and the controller answers by
+draining that rank out of the decode mesh and calling
+:meth:`StragglerMonitor.evict` so attribution continues over the
+survivors instead of pinning the dead EWMA as straggler forever.
 """
 
 from __future__ import annotations
@@ -63,6 +71,9 @@ class StragglerMonitor:
         self._steps: "OrderedDict[int, Dict[int, float]]" = OrderedDict()
         self._warned_stalled: set = set()
         self.observations = 0
+        self._evict_hooks: list = []   # (threshold_s, callback)
+        self._evict_fired: set = set()  # ranks a hook already fired for
+        self._evict_streak: tuple = (None, 0)  # (rank, consecutive evals)
 
     # -- ingestion --------------------------------------------------------
     def observe(self, summary: dict, now: Optional[float] = None) -> None:
@@ -91,6 +102,66 @@ class StragglerMonitor:
                     if len(walls) >= 2 else None)
         self._export(skew)
         self._check_stalled(mono)
+        self._check_eviction()
+
+    # -- eviction hook (serving control plane) ----------------------------
+    def add_eviction_hook(self, threshold_s: float, callback) -> None:
+        """Fire ``callback(rank, lateness_s)`` once per rank when that
+        rank SUSTAINS a lateness EWMA >= ``threshold_s``.
+
+        Sustained means the rank stayed the over-threshold straggler
+        through ``world`` consecutive evaluations (one evaluation per
+        ``observe``), i.e. a full round of fleet reports.  Summaries
+        arrive one rank at a time, so mid-round the EWMAs are unevenly
+        updated and a shared transient (a recompile spike decaying out)
+        makes each rank in turn look late -- the streak requirement
+        filters that rotation, a genuinely slow rank keeps the flag
+        while everyone else reports.  Callbacks run outside the monitor
+        lock (they may call back into :meth:`evict` / :meth:`report`)
+        and fire once per rank (latched)."""
+        self._evict_hooks.append((float(threshold_s), callback))
+
+    def evict(self, rank: int) -> None:
+        """Forget a rank the controller removed from the fleet so the
+        lateness attribution tracks the survivors.  The per-rank hook
+        latch stays set -- an evicted rank is never re-flagged."""
+        with self._lock:
+            self._ewma.pop(rank, None)
+            self._last_summary.pop(rank, None)
+            self._last_seen.pop(rank, None)
+            self._warned_stalled.discard(rank)
+            for walls in self._steps.values():
+                walls.pop(rank, None)
+        if self._evict_streak[0] == rank:
+            self._evict_streak = (None, 0)
+
+    def _check_eviction(self) -> None:
+        if not self._evict_hooks:
+            return
+        rep = self.report()
+        rank = rep["straggler_rank"]
+        lateness = float(rep["lateness_s"])
+        min_thr = min(t for t, _ in self._evict_hooks)
+        if rank is None or lateness < min_thr:
+            self._evict_streak = (None, 0)
+            return
+        prev_rank, streak = self._evict_streak
+        streak = streak + 1 if rank == prev_rank else 1
+        self._evict_streak = (rank, streak)
+        if rank in self._evict_fired or streak < self.world:
+            return
+        fired = False
+        for threshold_s, callback in self._evict_hooks:
+            if lateness >= threshold_s:
+                fired = True
+                try:
+                    callback(rank, lateness)
+                except Exception:  # hooks must never break the feed
+                    logger.exception(
+                        "straggler eviction hook failed for rank %d",
+                        rank)
+        if fired:
+            self._evict_fired.add(rank)
 
     # -- metrics ----------------------------------------------------------
     def _export(self, skew: Optional[float]) -> None:

@@ -1283,3 +1283,162 @@ def test_hier_guard_trips_on_bad_entries(tmp_path):
     assert "non-empty dict" in why
     assert "nothing crossed" in why
     assert "vs_baseline" in why
+
+
+# ---------------------------------------------------------------------------
+# Autoscale (closed-loop elastic serving) entries: BENCH_AUTOSCALE=1
+# ---------------------------------------------------------------------------
+
+
+def scan_autoscale_entries(bench_dir):
+    """Return [(path, why), ...] for malformed autoscale entries.
+
+    An autoscale entry records the SLO-driven control-plane chaos drill
+    (BENCH_AUTOSCALE=1): a kill@ + slow@ spec fired under Poisson load
+    against the ServingControlPlane.  The closed loop must visibly act
+    (at least one shrink decision for the dead rank and one eviction for
+    the slow one), carry every in-flight request (zero lost, zero leaked
+    KV pages, completed == requests - rejected), end on a smaller mesh
+    than it started on, and keep the accrued SLO-violation seconds
+    within the recorded budget.  vs_baseline must be null (a CPU-mesh
+    drill has no wall-clock peer)."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            a = parsed.get("autoscale")
+            if not a:
+                continue
+            decisions = a.get("decisions") or {}
+            if decisions.get("shrink", 0) < 1:
+                bad.append((path, "no shrink decision recorded: the dead "
+                                  "rank was never resized away"))
+            if decisions.get("evict", 0) < 1:
+                bad.append((path, "no evict decision recorded: the slow "
+                                  "rank was never removed"))
+            if a.get("lost_requests") != 0:
+                bad.append((path, f"lost_requests must be 0, got "
+                                  f"{a.get('lost_requests')!r}: the drain "
+                                  f"dropped in-flight requests"))
+            if a.get("drain_leaked_pages") != 0:
+                bad.append((path, f"drain_leaked_pages must be 0, got "
+                                  f"{a.get('drain_leaked_pages')!r}: "
+                                  f"suspension left KV pages allocated"))
+            n_req, done = a.get("requests"), a.get("completed")
+            rejected = a.get("rejected", 0)
+            if not isinstance(n_req, int) or done != n_req - rejected:
+                bad.append((path, f"completed {done!r} != requests "
+                                  f"{n_req!r} - rejected {rejected!r}"))
+            init, final = a.get("initial_tp"), a.get("final_tp")
+            if not (isinstance(init, int) and isinstance(final, int)
+                    and 1 <= final < init):
+                bad.append((path, f"mesh must shrink across the drill: "
+                                  f"initial_tp {init!r} -> final_tp "
+                                  f"{final!r}"))
+            viol, budget = a.get("slo_violation_s"), a.get("slo_budget_s")
+            if not (isinstance(viol, (int, float))
+                    and isinstance(budget, (int, float))
+                    and 0 <= viol <= budget):
+                bad.append((path, f"slo_violation_s {viol!r} must sit in "
+                                  f"[0, slo_budget_s {budget!r}]"))
+            elif parsed.get("value") != viol:
+                bad.append((path, f"headline value {parsed.get('value')!r}"
+                                  f" != autoscale.slo_violation_s "
+                                  f"{viol!r}"))
+            if not a.get("dead_ranks"):
+                bad.append((path, "dead_ranks empty: the kill@ fault "
+                                  "never fired"))
+            if not a.get("evicted_ranks"):
+                bad.append((path, "evicted_ranks empty: the slow@ rank "
+                                  "was never evicted"))
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "autoscale entries must carry a null "
+                                  "vs_baseline on the CPU mesh"))
+    return bad
+
+
+def test_committed_autoscale_entries_well_formed():
+    assert scan_autoscale_entries(REPO) == []
+
+
+def test_committed_autoscale_round_exists():
+    """Acceptance gate: a committed bench round must record the
+    closed-loop drill -- shrink + evict decisions, zero lost requests,
+    SLO-violation seconds under the budget."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            a = (entry.get("parsed") or {}).get("autoscale")
+            if a:
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries an autoscale block"
+    for path, parsed in found:
+        a = parsed["autoscale"]
+        assert parsed["metric"] == "autoscale_slo_violation_seconds", path
+        assert a["decisions"]["shrink"] >= 1, (path, a)
+        assert a["decisions"]["evict"] >= 1, (path, a)
+        assert a["lost_requests"] == 0, (path, a)
+        assert a["slo_violation_s"] <= a["slo_budget_s"], (path, a)
+
+
+def _write_autoscale(tmp_path, name, a, vs_baseline=None, value=None):
+    parsed = {"metric": "autoscale_slo_violation_seconds",
+              "value": a.get("slo_violation_s") if value is None else value,
+              "unit": "s", "vs_baseline": vs_baseline,
+              "config": "llama_serve_ctl_w8_slots8",
+              "baseline_config": "llama_serve_w8_slots8", "autoscale": a}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 13, "cmd": "BENCH_AUTOSCALE=1 bench.py", "rc": 0, "tail": "",
+         "parsed": parsed}))
+
+
+def _good_autoscale_block():
+    return {"world": 8, "initial_tp": 8, "final_tp": 4,
+            "chaos_spec": "kill@step=20,rank=7;slow@step=35,rank=2,secs=0.2",
+            "decisions": {"hold": 18, "shrink": 1, "evict": 1},
+            "resizes": 2, "evicted_ranks": [2], "dead_ranks": [7],
+            "drained_completed": 4, "drained_reprefilled": 11,
+            "drain_leaked_pages": 0, "lost_requests": 0,
+            "slo_violation_s": 15.982, "slo_budget_s": 30.0,
+            "requests": 48, "completed": 48, "rejected": 0}
+
+
+def test_autoscale_guard_accepts_good_entry(tmp_path):
+    _write_autoscale(tmp_path, "BENCH_r94.json", _good_autoscale_block())
+    assert scan_autoscale_entries(str(tmp_path)) == []
+
+
+def test_autoscale_guard_trips_on_bad_entries(tmp_path):
+    bad = _good_autoscale_block()
+    bad.update({"decisions": {"hold": 20},      # loop never acted
+                "lost_requests": 3,             # dropped in-flight work
+                "drain_leaked_pages": 2,        # pages left allocated
+                "completed": 45,                # accounting mismatch
+                "final_tp": 8,                  # never shrank
+                "dead_ranks": [], "evicted_ranks": []})
+    _write_autoscale(tmp_path, "BENCH_r95.json", bad)
+    _write_autoscale(tmp_path, "BENCH_r96.json",
+                     dict(_good_autoscale_block(),
+                          slo_violation_s=45.0))  # budget blown
+    _write_autoscale(tmp_path, "BENCH_r97.json", _good_autoscale_block(),
+                     vs_baseline=1.0)             # must be null on CPU
+    _write_autoscale(tmp_path, "BENCH_r98.json", _good_autoscale_block(),
+                     value=0.0)                   # headline/block mismatch
+    why = " ".join(w for _, w in scan_autoscale_entries(str(tmp_path)))
+    assert "no shrink decision" in why and "no evict decision" in why
+    assert "lost_requests must be 0" in why
+    assert "drain_leaked_pages must be 0" in why
+    assert "mesh must shrink" in why
+    assert "slo_violation_s" in why and "slo_budget_s" in why
+    assert "headline value" in why
+    assert "vs_baseline" in why

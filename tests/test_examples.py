@@ -130,6 +130,25 @@ def test_serving_probe_example_cpu(tmp_path):
 
 
 @pytest.mark.integration
+def test_autoscale_probe_example_cpu(tmp_path):
+    """Closed-loop chaos drill: kill@ forces a drain + shrink, slow@
+    gets the rank auto-evicted, zero requests lost; the probe asserts
+    the horovod_ctl_* families against its own /metrics endpoint
+    (internally) and the bench entry is validated here."""
+    bench = tmp_path / "BENCH_r99.json"
+    out = _run([os.path.join(REPO, "examples", "autoscale_probe.py"),
+                "--requests", "32", "--bench-json", str(bench)])
+    assert "autoscale probe OK" in out
+    assert "0 lost" in out
+    doc = json.loads(bench.read_text())
+    a = doc["parsed"]["autoscale"]
+    assert a["lost_requests"] == 0 and a["drain_leaked_pages"] == 0
+    assert a["final_tp"] < a["initial_tp"]
+    from test_bench_guard import scan_autoscale_entries
+    assert scan_autoscale_entries(str(tmp_path)) == []
+
+
+@pytest.mark.integration
 def test_torch_resnet50_example_cpu():
     out = _run([os.path.join(REPO, "examples", "torch_resnet50.py"),
                 "--cpu-devices", "2", "--image-size", "64",
