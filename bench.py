@@ -126,6 +126,16 @@ AUTOSCALE_SPEC = os.environ.get(
     "BENCH_AUTOSCALE_SPEC",
     "kill@step=20,rank=7;slow@step=35,rank=2,secs=0.2")
 AUTOSCALE_BUDGET_S = float(os.environ.get("BENCH_AUTOSCALE_BUDGET_S", "30"))
+# BENCH_ROOFLINE=1 runs the single-chip kernel roofline drill instead of
+# training: each HOROVOD_PALLAS family (flash-decoding, fused PowerSGD
+# update, fused BN backward) timed kernel-on vs the XLA reference on the
+# same shapes, with per-family flop/byte accounting against the v5e
+# peaks.  On CPU the kernels run in the Pallas interpreter, so the
+# on/off ratio measures PARITY PLUMBING (the dispatch really switches
+# and agrees numerically), not speed -- the block says which backend
+# produced it, and the speedup column is only meaningful on TPU.
+ROOFLINE_BENCH = _env_on("BENCH_ROOFLINE")
+ROOFLINE_ITERS = int(os.environ.get("BENCH_ROOFLINE_ITERS", "5"))
 
 
 def _config() -> str:
@@ -137,6 +147,7 @@ def _config() -> str:
             + (f"_{comp}" if comp else ""))
 FLOPS_PER_IMAGE = 12.3e9  # RN50 fwd+bwd estimate
 V5E_BF16_PEAK = 197e12
+V5E_HBM = 819e9  # bytes/s, same figure examples/bn_bwd_probe.py uses
 
 
 def _watchdog():
@@ -402,6 +413,168 @@ def _main_autoscale():
     os._exit(0)
 
 
+def _main_roofline():
+    """BENCH_ROOFLINE=1: single-chip Pallas kernel roofline drill.
+
+    Times each HOROVOD_PALLAS family against the XLA reference on its
+    hot shape and accounts flops/bytes against the v5e single-chip peaks
+    (197 bf16 TFLOP/s, 819 GB/s HBM).  Off-TPU the kernel leg runs the
+    Pallas interpreter, so ``speedup`` is parity plumbing, not perf; the
+    ``backend`` field keys which reading applies.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    os.environ.pop("HOROVOD_PALLAS", None)
+
+    def timed(fn, *args):
+        out = jax.block_until_ready(fn(*args))
+        best = float("inf")
+        for _ in range(ROOFLINE_ITERS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    def leg(family, shape_tag, flops, nbytes, on_fn, off_fn, args,
+            atol):
+        env = ("HOROVOD_PALLAS_DECODE" if family == "flash_decode"
+               else "HOROVOD_PALLAS_FUSED_UPDATE"
+               if family == "fused_update" else "HOROVOD_PALLAS_BN")
+        os.environ[env] = "1"
+        on_s, on_out = timed(jax.jit(on_fn), *args)
+        os.environ[env] = "0"
+        off_s, off_out = timed(jax.jit(off_fn), *args)
+        del os.environ[env]
+        ref = jnp.asarray(off_out, jnp.float32)
+        err = float(jnp.max(jnp.abs(jnp.asarray(on_out, jnp.float32)
+                                    - ref))
+                    / jnp.maximum(1.0, jnp.max(jnp.abs(ref))))
+        if not err <= atol:
+            print(json.dumps({"metric": "pallas_roofline_speedup_geomean",
+                              "value": 0.0, "unit": "x",
+                              "vs_baseline": None,
+                              "error": f"{family} parity {err} > {atol}"}),
+                  flush=True)
+            os._exit(2)
+        return {
+            "family": family, "shape": shape_tag,
+            "on_ms": round(on_s * 1e3, 3),
+            "off_ms": round(off_s * 1e3, 3),
+            "speedup": round(off_s / on_s, 4),
+            "flops": int(flops), "bytes": int(nbytes),
+            "achieved_tflops": round(flops / on_s / 1e12, 4),
+            "achieved_gbps": round(nbytes / on_s / 1e9, 3),
+            "pct_peak_flops": round(flops / on_s / V5E_BF16_PEAK * 100,
+                                    4),
+            "pct_peak_hbm": round(nbytes / on_s / V5E_HBM * 100, 4),
+            "max_rel_err": err,
+        }
+
+    kernels = []
+    key = jax.random.PRNGKey(0)
+
+    # -- flash-decoding: split-KV cache read, GQA 8q/2kv ------------------
+    from horovod_tpu.ops.attention import decode_attention
+    b, h, h_kv, s, d = 8, 8, 2, 1024, 64
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (b, h, 1, d), jnp.float32)
+    kc = jax.random.normal(ks[1], (b, h_kv, s, d), jnp.float32)
+    vc = jax.random.normal(ks[2], (b, h_kv, s, d), jnp.float32)
+    lengths = jax.random.randint(ks[3], (b,), 1, s + 1)
+    kernels.append(leg(
+        "flash_decode", f"b{b}_h{h}kv{h_kv}_s{s}_d{d}",
+        flops=4 * b * h * s * d,
+        nbytes=2 * b * h_kv * s * d * 4,
+        on_fn=lambda q, k, v, l: decode_attention(q, k, v, lengths=l),
+        off_fn=lambda q, k, v, l: decode_attention(q, k, v, lengths=l,
+                                                   force_reference=True),
+        args=(q, kc, vc, lengths), atol=1e-4))
+
+    # -- fused optimizer+codec update: the three stages around the psums --
+    from horovod_tpu.collectives.ops import (_orthonormalize_columns,
+                                             _powersgd_seed_matrix)
+    from horovod_tpu.ops import fused_update as _fused
+    m = c = 512
+    r = 4
+    xk = jax.random.split(key, 2)
+    x_mat = jax.random.normal(xk[0], (m, c), jnp.float32)
+    res_mat = jax.random.normal(xk[1], (m, c), jnp.float32)
+    q0 = _powersgd_seed_matrix(c, r)
+
+    def fused_chain(x_mat, res_mat):
+        acc, p = _fused.matricize_p(x_mat, res_mat, q0)
+        po, ql = _fused.orthonormalize_q(acc, p)
+        out, res2 = _fused.reconstruct_residual(acc, po, ql, ql)
+        return out + res2
+
+    def unfused_chain(x_mat, res_mat):
+        acc = x_mat.astype(jnp.float32) + res_mat
+        p = acc @ q0
+        po = _orthonormalize_columns(p)
+        ql = acc.T @ po
+        out = po @ ql.T
+        res2 = acc - po @ ql.T
+        return out + res2
+
+    kernels.append(leg(
+        "fused_update", f"m{m}_c{c}_r{r}",
+        flops=8 * m * c * r,
+        nbytes=5 * m * c * 4,
+        on_fn=fused_chain, off_fn=unfused_chain,
+        args=(x_mat, res_mat), atol=1e-4))
+
+    # -- fused BN backward: two-pass 7N floor -----------------------------
+    from horovod_tpu.ops import bn as _bn
+    n_, side, feat = 32, 16, 256
+    bk = jax.random.split(key, 3)
+    xb = jax.random.normal(bk[0], (n_, side, side, feat), jnp.float32)
+    dyb = jax.random.normal(bk[1], (n_, side, side, feat), jnp.float32)
+    scale = jax.random.normal(bk[2], (feat,), jnp.float32) + 1.0
+
+    def bn_bwd(x, dy, scale):
+        mean, var = _bn.batch_stats(x)
+        dx, dg, db = _bn.fused_bn_backward(x, scale, mean, var, dy,
+                                           eps=1e-5)
+        return dx + dg + db
+
+    # Distinct wrappers per leg: jax caches traces by function identity,
+    # and the env flag is read at trace time.
+    kernels.append(leg(
+        "bn_bwd", f"n{n_}_hw{side}_c{feat}",
+        flops=10 * xb.size,
+        nbytes=7 * xb.size * 4,
+        on_fn=lambda x, dy, s: bn_bwd(x, dy, s),
+        off_fn=lambda x, dy, s: bn_bwd(x, dy, s),
+        args=(xb, dyb, scale), atol=1e-4))
+
+    speedups = [k["speedup"] for k in kernels]
+    geomean = float(np.exp(np.mean(np.log(speedups))))
+    config = f"pallas_roofline_{backend}_" + "_".join(
+        k["family"] for k in kernels)
+    result = {
+        "metric": "pallas_roofline_speedup_geomean",
+        "value": round(geomean, 4),
+        "unit": "x",
+        "vs_baseline": None,  # CPU interpreter drill: no perf peer
+        "config": config,
+        "baseline_config": config,
+        "roofline": {
+            "backend": backend,
+            "interpreted": backend != "tpu",
+            "peak_tflops": V5E_BF16_PEAK / 1e12,
+            "peak_hbm_gbps": V5E_HBM / 1e9,
+            "iters": ROOFLINE_ITERS,
+            "kernels": kernels,
+        },
+    }
+    print(json.dumps(result), flush=True)
+    os._exit(0)
+
+
 def state_batch_after_restore(batch_at_fault: int, commit_every: int) -> int:
     """The batch counter the restore rolled back to (last commit)."""
     return (batch_at_fault // commit_every) * commit_every
@@ -540,6 +713,8 @@ def main():
         _main_serving()
     if AUTOSCALE_BENCH:
         _main_autoscale()
+    if ROOFLINE_BENCH:
+        _main_roofline()
     if OVERLAP and ZERO:
         sys.exit("BENCH_OVERLAP / HOROVOD_MICROBATCHES>1 is incompatible "
                  "with HOROVOD_ZERO=1 (the ZeRO arena exchange is already "

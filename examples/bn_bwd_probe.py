@@ -22,6 +22,7 @@ XLA is already at the memory roof; a large ratio is the case for Pallas.
 Usage::
 
     python examples/bn_bwd_probe.py [--batch 256] [--shapes 56x64 28x512]
+        [--kernel]   # time the Pallas two-pass kernels instead of XLA
 """
 
 import sys as _sys
@@ -46,16 +47,28 @@ def main():
                    help="scan-length spread; raise for sub-0.3ms ops so "
                         "the slope clears the tunnel's dispatch jitter")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--kernel", action="store_true",
+                   help="route the BN backward through the Pallas "
+                        "two-pass kernels (ops.bn.bn_train, "
+                        "HOROVOD_PALLAS_BN=1) instead of XLA's compiled "
+                        "chain -- the direct A/B for the round-5 "
+                        "refutation")
     args = p.parse_args()
+
+    if args.kernel:
+        import os
+        os.environ["HOROVOD_PALLAS_BN"] = "1"
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from _harness import differential_bench, nonlinear_tap
+    from horovod_tpu.ops import bn as _bn
 
     dt = jnp.dtype(args.dtype)
-    print(f"# devices: {jax.devices()}")
+    print(f"# devices: {jax.devices()}"
+          + (" | BN backward: Pallas kernels" if args.kernel else ""))
     print("| shape | fwd ms | fwd+bwd ms | bwd ms | floor ms | "
           "bwd/floor |")
     print("|---|---|---|---|---|---|")
@@ -72,11 +85,14 @@ def main():
         beta = jnp.zeros((ch,), jnp.float32)
 
         def block(x, shortcut, g, b):
-            x32 = x.astype(jnp.float32)
-            mean = jnp.mean(x32, axis=(0, 1, 2))
-            var = jnp.var(x32, axis=(0, 1, 2))
-            xhat = (x32 - mean) / jnp.sqrt(var + 1e-5)
-            y = (xhat * g + b).astype(x.dtype) + shortcut
+            if args.kernel:
+                y = _bn.bn_train(x, g, b, 1e-5) + shortcut
+            else:
+                x32 = x.astype(jnp.float32)
+                mean = jnp.mean(x32, axis=(0, 1, 2))
+                var = jnp.var(x32, axis=(0, 1, 2))
+                xhat = (x32 - mean) / jnp.sqrt(var + 1e-5)
+                y = (xhat * g + b).astype(x.dtype) + shortcut
             return jax.nn.relu(y)
 
         # sc/dy ride in the CARRY, not as closures: closed-over arrays

@@ -20,7 +20,7 @@ Usage (defaults match bench.py's config: batch 256, 224x224, bf16,
 space-to-depth stem)::
 
     python examples/rn50_op_roofline.py [--batch 256] [--iters 12]
-        [--precision default|highest] [--markdown]
+        [--precision default|highest] [--markdown] [--kernel]
 """
 
 import sys as _sys
@@ -52,7 +52,16 @@ def main():
     p.add_argument("--cap", type=int, default=14,
                    help="benchmark only the top-N configs by FLOPs")
     p.add_argument("--markdown", action="store_true")
+    p.add_argument("--kernel", action="store_true",
+                   help="HOROVOD_PALLAS_BN=1: swap the model's BN sites "
+                        "to ops.bn.BatchNorm and measure the fwd+bwd leg "
+                        "in train mode, so the backward runs the fused "
+                        "Pallas kernels instead of XLA's compiled chain")
     args = p.parse_args()
+
+    if args.kernel:
+        import os
+        os.environ["HOROVOD_PALLAS_BN"] = "1"
 
     import jax
     import jax.numpy as jnp
@@ -164,9 +173,16 @@ def main():
     params0 = variables["params"]
 
     def loss_of(p, xb):
-        logits = model.apply({"params": p,
-                              "batch_stats": variables["batch_stats"]},
-                             xb, train=False)
+        # --kernel measures train mode (the BN-backward kernels only
+        # exist there); stat mutation is computed and discarded.
+        if args.kernel:
+            logits, _ = model.apply(
+                {"params": p, "batch_stats": variables["batch_stats"]},
+                xb, train=True, mutable=["batch_stats"])
+        else:
+            logits = model.apply(
+                {"params": p, "batch_stats": variables["batch_stats"]},
+                xb, train=False)
         l32 = logits.astype(jnp.float32)
         return jnp.sum(l32 * l32) * 1e-6
 
@@ -207,7 +223,8 @@ def main():
           f" ms ({max(0, 1-total_conv_time/max(fwd_secs,1e-9)):.0%} "
           f"of forward)")
     print(f"forward-only throughput: {args.batch/fwd_secs:.0f} img/s")
-    print(f"fwd+bwd (eval-BN): {fb_secs*1e3:.1f} ms "
+    bn_tag = "train-BN, Pallas bwd" if args.kernel else "eval-BN"
+    print(f"fwd+bwd ({bn_tag}): {fb_secs*1e3:.1f} ms "
           f"({args.batch/fb_secs:.0f} img/s; bwd = "
           f"{(fb_secs-fwd_secs)*1e3:.1f} ms = "
           f"{(fb_secs-fwd_secs)/max(fwd_secs,1e-9):.1f}x fwd)")

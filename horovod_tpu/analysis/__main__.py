@@ -58,6 +58,10 @@ def _run_step_audit(devices: int):
     devices).  Must run before any jax backend initialization in this
     process."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Audit with the Pallas kernel families forced on (interpreter mode
+    # on this CPU backend) so the gate traces the kernel paths the TPU
+    # runs -- the exchange contract must match with kernels enabled.
+    os.environ.setdefault("HOROVOD_PALLAS", "1")
     from ..utils.platform import force_host_device_count
     force_host_device_count(devices, cpu=True)
     import horovod_tpu as hvd

@@ -133,6 +133,35 @@ def collect_collectives(closed: ClosedJaxpr) -> List[CollectiveRecord]:
     return records
 
 
+def collectives_in_kernels(closed: ClosedJaxpr) -> List[CollectiveRecord]:
+    """Collectives hiding INSIDE ``pallas_call`` kernel bodies.
+
+    The kernel contract registry (``ops.pallas.KERNEL_CONTRACTS``)
+    declares every Pallas family collective-free: a collective inside an
+    opaque custom call would be invisible to XLA's collective scheduling
+    (deadlock risk under any reordering) and to the planner's wire
+    accounting, so the auditor treats any hit as an error rather than
+    trying to price it.  Returns one record per offending equation, with
+    the enclosing kernel in the path.
+    """
+    records: List[CollectiveRecord] = []
+
+    def walk(jaxpr: Jaxpr, path: str, in_kernel: bool) -> None:
+        for i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            if in_kernel and name in COLLECTIVE_PRIMS:
+                records.append(
+                    _collective_record(eqn, f"{path}/eqn{i}:{name}",
+                                       False))
+                continue
+            inside = in_kernel or name == "pallas_call"
+            for key, sub in _param_jaxprs(eqn):
+                walk(sub, f"{path}/{name}.{key}", inside)
+
+    walk(closed.jaxpr, "", False)
+    return records
+
+
 # -- taint (desync) analysis ------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)

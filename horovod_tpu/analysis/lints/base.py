@@ -80,9 +80,11 @@ def all_rules() -> List[LintRule]:
     from .envreg import EnvRegistryRule
     from .locks import UnlockedSharedStateRule
     from .nondeterminism import NondeterminismInStepRule
+    from .pallas_tests import PallasInterpretTestRule
     from .planner import CollectiveOutsidePlannerRule
     return [UnlockedSharedStateRule(), NondeterminismInStepRule(),
-            CollectiveOutsidePlannerRule(), EnvRegistryRule()]
+            CollectiveOutsidePlannerRule(), EnvRegistryRule(),
+            PallasInterpretTestRule()]
 
 
 def run_lints(pkg_dir: Optional[str] = None,

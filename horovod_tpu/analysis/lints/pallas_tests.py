@@ -1,0 +1,84 @@
+"""``lint-pallas-needs-interpret-test``: every ``pl.pallas_call`` site
+needs an interpreter-mode parity test module.
+
+Pallas kernels lower to custom calls that CI's CPU tier never executes
+natively -- the ONLY coverage they get before a TPU run is the Pallas
+interpreter (``interpret=...`` resolves true off-TPU, see
+``ops.pallas.interpret_mode``).  A kernel module without an interpreter
+test is dead weight that first executes in production, so this rule
+requires, for every ``horovod_tpu`` source file invoking
+``pallas_call``, a ``tests/test_*.py`` module that (a) carries the
+kernel module's stem in its filename and (b) imports it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List
+
+from ..findings import Finding
+from .base import LintContext, LintRule
+
+
+def _pallas_call_lines(tree: ast.AST) -> List[int]:
+    lines = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else \
+            func.id if isinstance(func, ast.Name) else None
+        if name == "pallas_call":
+            lines.append(node.lineno)
+    return sorted(lines)
+
+
+def _test_sources(ctx: LintContext) -> Dict[str, str]:
+    """``{filename: source}`` for every ``tests/test_*.py``."""
+    tests_dir = os.path.join(ctx.repo_root, "tests")
+    out: Dict[str, str] = {}
+    if not os.path.isdir(tests_dir):
+        return out
+    for fname in sorted(os.listdir(tests_dir)):
+        if not (fname.startswith("test_") and fname.endswith(".py")):
+            continue
+        with open(os.path.join(tests_dir, fname)) as f:
+            out[fname] = f.read()
+    return out
+
+
+class PallasInterpretTestRule(LintRule):
+    id = "lint-pallas-needs-interpret-test"
+    severity = "error"
+    description = ("pallas_call site without an interpreter-mode parity "
+                   "test module (tests/test_*<module>*.py importing the "
+                   "kernel module)")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        tests = None
+        for sf in ctx.files:
+            lines = _pallas_call_lines(sf.tree)
+            if not lines:
+                continue
+            if tests is None:
+                tests = _test_sources(ctx)
+            stem = os.path.splitext(os.path.basename(sf.relpath))[0]
+            dotted = os.path.splitext(sf.relpath)[0].replace("/", ".")
+            # "imports it": a plain module import or a from-import of the
+            # stem both leave one of these two literal forms.
+            imports = (dotted, f"import {stem}")
+            covered = any(
+                stem in fname and any(pat in src for pat in imports)
+                for fname, src in tests.items())
+            if covered:
+                continue
+            findings.append(self.finding(
+                sf, stem,
+                f"{len(lines)} pallas_call site(s) at line(s) "
+                f"{', '.join(map(str, lines))} but no tests/test_*"
+                f"{stem}*.py imports {dotted}; Pallas kernels are only "
+                "CI-covered through an interpreter-mode parity test",
+                line=lines[0]))
+        return findings

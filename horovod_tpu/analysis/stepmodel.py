@@ -74,6 +74,13 @@ class ExpectedExchange:
     plan_rows: List[dict]
     supported: bool = True
     notes: Tuple[str, ...] = ()
+    # Pallas kernel families active while the step traces (from
+    # ``ops.pallas.active_kernels()``).  Informational: every registered
+    # contract is collective-free with zero wire delta, so the exchange
+    # contract above is identical with kernels on or off; a future
+    # family that DID declare collective legs would have them appended
+    # to ``ops`` (priced, not declined) by ``_attach_kernel_contracts``.
+    kernels: Tuple[str, ...] = ()
 
 
 def _wire_dtype(comp, dtype) -> str:
@@ -134,8 +141,37 @@ def meta_from_step(step) -> Optional[dict]:
     return dict(meta) if isinstance(meta, dict) else None
 
 
+def _attach_kernel_contracts(expected: ExpectedExchange
+                             ) -> ExpectedExchange:
+    """Make the expectation kernel-aware instead of declining.
+
+    Active Pallas families are recorded on ``expected.kernels``; any
+    collective legs a family's contract registers are appended to the
+    priced ops (today every contract is collective-free with zero wire
+    delta, so this only annotates).  ``trace_audit`` separately enforces
+    the collective-free claim by walking ``pallas_call`` sub-jaxprs.
+    """
+    from ..ops import pallas as _pallas
+    active = _pallas.active_kernels()
+    if not active or not expected.supported:
+        return expected
+    expected.kernels = active
+    for family in active:
+        contract = _pallas.kernel_contract(family)
+        for kind, dtype, elements in contract["collectives"]:
+            expected.ops.append(ExpectedOp(
+                kind, str(dtype), int(elements),
+                f"kernel:{family}/{kind}"))
+    return expected
+
+
 def expected_exchange(params, meta: dict) -> ExpectedExchange:
-    """Derive the collective contract for a step built with ``meta``."""
+    """Derive the collective contract for a step built with ``meta``
+    (kernel-aware: see :func:`_attach_kernel_contracts`)."""
+    return _attach_kernel_contracts(_expected_exchange(params, meta))
+
+
+def _expected_exchange(params, meta: dict) -> ExpectedExchange:
     from ..controller.fusion import exchange_chunk_bytes, explain_plan
     from ..core.state import global_state
     from ..optim import distributed as _dist

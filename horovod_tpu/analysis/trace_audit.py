@@ -18,7 +18,12 @@ and reports :class:`~horovod_tpu.analysis.findings.Finding` rows for:
   array;
 - ``audit-fence`` (error): a TPU-backed mesh whose eager fence policy
   degrades to CPU-style barrier+block, or a barrier-signature collective
-  (scalar int32 psum) traced into a TPU step body.
+  (scalar int32 psum) traced into a TPU step body;
+- ``audit-collective-in-kernel`` (error): a collective primitive traced
+  inside a ``pallas_call`` kernel body -- every registered kernel family
+  (``ops.pallas.KERNEL_CONTRACTS``) contracts to keep its exchanges in
+  XLA, where the fusion planner, this auditor, and the span recorder can
+  see them.
 """
 
 from __future__ import annotations
@@ -199,6 +204,16 @@ def audit_step(fn, *args,
             summary.update(counts)
             summary["planned_buckets"] = len(expected.plan_rows)
             summary["expected_ops"] = len(expected.ops)
+
+    for r in _walk.collectives_in_kernels(closed):
+        findings.append(Finding(
+            rule="audit-collective-in-kernel", severity=ERROR, path=name,
+            ident=r.path,
+            message=f"collective {r.kind} {r.dtype}[{r.elements}] traced "
+                    "inside a pallas_call kernel body; kernel contracts "
+                    "declare every family collective-free (in-kernel "
+                    "collectives are invisible to XLA's scheduler and the "
+                    "planner's wire accounting)"))
 
     for d in _walk.find_rank_dependent_branches(closed):
         findings.append(Finding(

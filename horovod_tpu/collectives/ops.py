@@ -956,22 +956,56 @@ def powersgd_allreduce(x,
 
     n = math.prod(lax.axis_size(ax) for ax in axes)
     shape, dtype = x.shape, x.dtype
-    acc = x.astype(jnp.float32).ravel()
-    if prescale_factor != 1.0:
-        acc = acc * prescale_factor
-    if residual is not None:
-        acc = acc + residual.astype(jnp.float32).ravel()
-    size = acc.size
+    size = x.size
     m, c = powersgd_matrix_shape(size)
     pad = m * c - size
-    flat = jnp.concatenate([acc, jnp.zeros((pad,), jnp.float32)]) \
-        if pad else acc
-    mat = flat.reshape(m, c)
     r = max(1, min(int(rank), m, c))
     if note:
         # Trace-time leg registration: two f32 factor allreduces.
         from ..timeline import spans as _spans
         _spans.note_leg("powersgd_allreduce", nbytes=2 * r * (m + c) * 4)
+
+    from ..ops import pallas as _pallas
+    if _pallas.pallas_enabled("fused_update"):
+        # Fused path (PR 13): the three HBM passes between the factor
+        # psums run as Pallas kernels (ops.fused_update); the psums
+        # themselves stay HERE in XLA, so the wire contract -- two f32
+        # allreduces of r*m and r*c elements -- and the _EFState carry
+        # are identical to the unfused path below.
+        from ..ops import fused_update as _fused
+        if note:
+            from ..timeline import spans as _spans
+            _spans.note_leg("pallas/fused_update", nbytes=size * 4)
+        xf = x.ravel()
+        xp = jnp.concatenate([xf, jnp.zeros((pad,), xf.dtype)]) \
+            if pad else xf
+        res_mat = None
+        if residual is not None:
+            rf = residual.astype(jnp.float32).ravel()
+            rp = jnp.concatenate([rf, jnp.zeros((pad,), jnp.float32)]) \
+                if pad else rf
+            res_mat = rp.reshape(m, c)
+        acc_mat, p_local = _fused.matricize_p(
+            xp.reshape(m, c), res_mat, _powersgd_seed_matrix(c, r),
+            prescale=prescale_factor)
+        p = lax.psum(p_local, axes if len(axes) > 1 else axes[0]) / n
+        p_orth, q_local = _fused.orthonormalize_q(acc_mat, p)
+        q = lax.psum(q_local, axes if len(axes) > 1 else axes[0]) / n
+        out_mat, res_out = _fused.reconstruct_residual(
+            acc_mat, p_orth, q, q_local,
+            n_scale=float(n) if op is Sum else 1.0,
+            postscale=postscale_factor)
+        return (out_mat.ravel()[:size].reshape(shape).astype(dtype),
+                res_out.ravel()[:size])
+
+    acc = x.astype(jnp.float32).ravel()
+    if prescale_factor != 1.0:
+        acc = acc * prescale_factor
+    if residual is not None:
+        acc = acc + residual.astype(jnp.float32).ravel()
+    flat = jnp.concatenate([acc, jnp.zeros((pad,), jnp.float32)]) \
+        if pad else acc
+    mat = flat.reshape(m, c)
 
     p = mat @ _powersgd_seed_matrix(c, r)          # [m, r]
     p = lax.psum(p, axes if len(axes) > 1 else axes[0]) / n

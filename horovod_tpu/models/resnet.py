@@ -17,6 +17,8 @@ from typing import Any, Callable, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops import bn as _bn
+
 ModuleDef = Any
 
 
@@ -111,7 +113,12 @@ class ResNet(nn.Module):
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        padding="SAME")
-        norm = partial(nn.BatchNorm, use_running_average=not train,
+        # HOROVOD_PALLAS / HOROVOD_PALLAS_BN routes every BN site through
+        # ops.bn.BatchNorm (fused two-pass backward); the module mirrors
+        # flax's class name, param names, and batch_stats layout, so the
+        # variable tree is identical either way.
+        norm_cls = _bn.BatchNorm if _bn.use_pallas_bn() else nn.BatchNorm
+        norm = partial(norm_cls, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
         if self.space_to_depth:

@@ -32,7 +32,6 @@ Design notes (see /opt/skills/guides/pallas_guide.md):
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -60,12 +59,10 @@ DEFAULT_BLOCK_KV = 512
 
 
 def _use_pallas() -> bool:
-    flag = os.environ.get("HVD_TPU_FLASH", "auto")
-    if flag == "0":
-        return False
-    if flag == "1":
-        return True
-    return jax.default_backend() == "tpu"
+    # Unified switch (PR 13): HOROVOD_PALLAS / HOROVOD_PALLAS_FLASH,
+    # with the legacy HVD_TPU_FLASH honored behind a deprecation note.
+    from . import pallas as _pallas
+    return _pallas.pallas_enabled("flash")
 
 
 def _interpret() -> bool:
@@ -149,7 +146,9 @@ def attention_reference(q, k, v, *, causal: bool = False,
     return out.astype(v.dtype)
 
 
-def decode_attention(q, k, v, *, lengths, scale: Optional[float] = None):
+def decode_attention(q, k, v, *, lengths, scale: Optional[float] = None,
+                     block_kv: int = DEFAULT_BLOCK_KV,
+                     force_reference: bool = False):
     """Single-token decode attention over a length-masked KV cache.
 
     ``q``: ``(b, h, 1, d)`` -- the current token's query per slot.
@@ -163,8 +162,17 @@ def decode_attention(q, k, v, *, lengths, scale: Optional[float] = None):
     No causal mask is needed: the current token sits at position
     ``lengths - 1`` and every cached key is at a position ``< lengths``,
     so the length mask IS the bottom-right-aligned causal mask for a
-    one-token query.  Runs the XLA reference path (decode batches are
-    tiny on the q axis; a Pallas grid would idle the MXU).
+    one-token query.
+
+    Dispatch: the split-KV flash-decoding kernel when the ``flash_decode``
+    family is enabled (``HOROVOD_PALLAS`` / ``HOROVOD_PALLAS_DECODE``) and
+    the cache length has a block divisor; the XLA reference otherwise.
+    The kernel grids over KV page-blocks with the grouped query heads of
+    one kv head as the MXU tile, carrying online-softmax partials
+    (running max / normalizer / accumulator) across the sequential block
+    axis -- the log-sum-exp merge of the split-KV partials.  Pages past
+    ``lengths`` are either whole-block predicated off or masked per
+    column, so recycled-page garbage never contributes.
     """
     if q.shape[2] != 1:
         raise ValueError(f"decode_attention expects a single-token query, "
@@ -175,16 +183,116 @@ def decode_attention(q, k, v, *, lengths, scale: Optional[float] = None):
     if lengths.shape != (q.shape[0],):
         raise ValueError(f"lengths must be ({q.shape[0]},), got "
                          f"{lengths.shape}")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    from . import pallas as _pallas
+    s = k.shape[2]
+    bk = _block(s, block_kv)
+    if (not force_reference and bk >= _MIN_BLOCK
+            and _pallas.pallas_enabled("flash_decode")):
+        return _flash_decode(q, k, v, lengths, float(scale), bk)
     rep = q.shape[1] // k.shape[1]
     if rep > 1:
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    s = k.shape[2]
     kv_seg = (jnp.arange(s)[None, :]
               < lengths[:, None]).astype(jnp.int32)
     q_seg = jnp.ones((q.shape[0], 1), jnp.int32)
     return attention_reference(q, k, v, causal=False, scale=scale,
                                segment_ids=q_seg, kv_segment_ids=kv_seg)
+
+
+# ---------------------------------------------------------------------------
+# Flash-decoding: split-KV kernel for the single-token cache read.
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, bk, nk):
+    """Grid ``(batch, kv_heads, kv_blocks)``; the last axis is sequential
+    on TPU, so VMEM scratch carries the online-softmax state across KV
+    blocks and the final block folds the partials -- the split-KV
+    log-sum-exp merge without a second kernel launch.
+
+    The q tile is the ``rep`` grouped query heads of this kv head
+    (``(rep, d)``): decode has one token per slot, so the head group is
+    the only MXU row dimension available.  Blocks wholly past
+    ``lengths[b]`` are predicated off; the straddling block masks per
+    column.  A dead slot (``lengths == 0``) runs no live block and
+    finishes with ``l == 0`` -> exactly zero output.
+    """
+    ki = pl.program_id(2)
+    length = len_ref[0, 0]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki * bk < length)
+    def _step():
+        qg = q_ref[0, 0].astype(jnp.float32)          # (rep, d)
+        kb = k_ref[0, 0].astype(jnp.float32)          # (bk, d)
+        s = jax.lax.dot_general(qg, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = ki * bk + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < length, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                         # (rep, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                        # (rep, bk)
+        alpha = jnp.exp(m_prev - m_new)               # (rep, 1)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o = acc_scr[:] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _flash_decode(q, k, v, lengths, scale: float, bk: int):
+    """Split-KV decode dispatch: ``q (b, h, 1, d)``, ``k/v (b, h_kv, s,
+    d)`` -> ``(b, h, 1, d)``.  GQA folds the query-head group onto the
+    sublane axis (``q4[b, kv, rep, d]``) instead of repeating K/V in HBM,
+    matching the training kernels' ``h // rep`` index-map broadcast."""
+    b, h, _, d = q.shape
+    h_kv, s = k.shape[1], k.shape[2]
+    rep = h // h_kv
+    nk = s // bk
+    q4 = q.reshape(b, h_kv, rep, d)
+    len2 = lengths.astype(jnp.int32).reshape(b, 1)
+    from ..timeline import spans as _spans
+    _spans.note_leg("pallas/flash_decode",
+                    nbytes=k.size * k.dtype.itemsize * 2)
+    kernel = functools.partial(_decode_kernel, scale=scale, bk=bk, nk=nk)
+    o = pl.pallas_call(
+        kernel,
+        grid=(b, h_kv, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bi, hi, j: (bi, 0)),
+            pl.BlockSpec((1, 1, rep, d), lambda bi, hi, j: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, j: (bi, hi, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, j: (bi, hi, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, d),
+                               lambda bi, hi, j: (bi, hi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, rep, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(len2, q4, k, v)
+    return o.reshape(b, h, 1, d)
 
 
 def _causal_mask(s, qi, ki, bq, bk, off):
@@ -604,10 +712,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``segment_ids`` when the key sequence has the same length; it is
     required for cross-length attention.  Composes with ``causal``.
 
-    Dispatch: Pallas kernels when running on TPU (or ``HVD_TPU_FLASH=1``,
-    which uses the interpreter off-TPU -- slow, for tests), XLA reference
-    otherwise.  Sequence lengths with no block-divisor >= 8 (e.g. primes)
-    fall back to the reference implementation.
+    Dispatch: Pallas kernels when running on TPU (or ``HOROVOD_PALLAS=1``
+    / ``HOROVOD_PALLAS_FLASH=1``, which use the interpreter off-TPU --
+    slow, for tests; the legacy ``HVD_TPU_FLASH`` is still honored with a
+    deprecation note), XLA reference otherwise.  Sequence lengths with no
+    block-divisor >= 8 (e.g. primes) fall back to the reference
+    implementation.
     """
     if q.shape[1] % k.shape[1]:
         raise ValueError(f"query heads {q.shape[1]} not a multiple of "

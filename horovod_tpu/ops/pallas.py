@@ -1,0 +1,146 @@
+"""Unified Pallas kernel switch + the kernel contract registry.
+
+One environment flag, ``HOROVOD_PALLAS`` (``HVD_TPU_PALLAS``), gates
+every Pallas kernel family in the package:
+
+- ``auto`` (default): kernels run on TPU, the XLA reference runs
+  elsewhere;
+- ``1``: force the kernels everywhere (off-TPU they run in the Pallas
+  interpreter -- slow, but numerically the kernel path; this is what the
+  CPU parity tests and the CI step audit use);
+- ``0``: force the XLA reference everywhere.
+
+Per-family overrides (``HOROVOD_PALLAS_FLASH``, ``HOROVOD_PALLAS_DECODE``,
+``HOROVOD_PALLAS_FUSED_UPDATE``, ``HOROVOD_PALLAS_BN``) take the same
+values and win over the global flag, so a single family can be pinned
+on/off while the rest follow ``HOROVOD_PALLAS``.
+
+The legacy ``HVD_TPU_FLASH`` flag (PR 10) is subsumed: it is still
+honored for the ``flash`` family (with a one-shot ``DeprecationWarning``)
+but loses to ``HOROVOD_PALLAS_FLASH`` when both are set.
+
+Kernel contracts
+----------------
+
+Pallas kernels lower to custom calls that are opaque to anything reading
+the step at the HLO level, so each family registers its collective/wire
+contract here: the collectives it is allowed to emit (none -- every
+exchange stays in XLA where the planner, the PR 8 auditor, and the PR 9
+span recorder can see it) and whether it changes any exchange's wire
+bytes (never).  ``analysis.stepmodel`` reads this registry to annotate
+audited steps instead of declining them, and ``analysis.trace_audit``
+enforces the collective-free claim by walking every ``pallas_call``
+sub-jaxpr in the traced step.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import jax
+
+# Kernel family -> contract.  ``collectives`` is the multiset of
+# collective legs the kernel itself may emit (empty: the exchange stays
+# in XLA); ``wire_delta_bytes`` is how the family changes any exchange's
+# on-wire payload (always 0 -- e.g. fused_update keeps the PowerSGD P/Q
+# factor psums outside the kernels, untouched).
+KERNEL_CONTRACTS = {
+    "flash": {
+        "collectives": (),
+        "wire_delta_bytes": 0,
+        "site": "ops.attention.flash_attention",
+        "note": "flash fwd/bwd kernels; exchange untouched",
+    },
+    "flash_decode": {
+        "collectives": (),
+        "wire_delta_bytes": 0,
+        "site": "ops.attention.decode_attention",
+        "note": "split-KV decode kernel; the serving step's two "
+                "row-parallel psums per layer stay in XLA",
+    },
+    "fused_update": {
+        "collectives": (),
+        "wire_delta_bytes": 0,
+        "site": "collectives.ops.powersgd_allreduce",
+        "note": "matricize/orthonormalize/EF-residual fused; the two "
+                "P/Q factor psums stay in XLA between the kernels",
+    },
+    "bn_bwd": {
+        "collectives": (),
+        "wire_delta_bytes": 0,
+        "site": "ops.bn.fused_bn_backward",
+        "note": "two-pass BN backward; gradient exchange untouched",
+    },
+}
+
+# Per-family override env suffix (``HOROVOD_PALLAS_<suffix>``).
+_FAMILY_ENV = {
+    "flash": "PALLAS_FLASH",
+    "flash_decode": "PALLAS_DECODE",
+    "fused_update": "PALLAS_FUSED_UPDATE",
+    "bn_bwd": "PALLAS_BN",
+}
+
+_warned_legacy = False
+
+
+def _read(name: str):
+    """Read ``HVD_TPU_<name>`` then ``HOROVOD_<name>`` (the package's
+    standard env precedence, mirroring ``core.config._env``)."""
+    v = os.environ.get("HVD_TPU_" + name)
+    if v is None:
+        v = os.environ.get("HOROVOD_" + name)
+    return v
+
+
+def _legacy_flash_flag():
+    """The pre-unification ``HVD_TPU_FLASH`` flag, deprecation-warned."""
+    global _warned_legacy
+    v = os.environ.get("HVD_TPU_FLASH")
+    if v is not None and not _warned_legacy:
+        _warned_legacy = True
+        warnings.warn(
+            "HVD_TPU_FLASH is deprecated; use HOROVOD_PALLAS (all kernel "
+            "families) or HOROVOD_PALLAS_FLASH (this family only)",
+            DeprecationWarning, stacklevel=3)
+    return v
+
+
+def pallas_enabled(family: str) -> bool:
+    """Whether the ``family`` kernels should run for the current call.
+
+    Resolution order: the per-family override, then (for ``flash``) the
+    legacy ``HVD_TPU_FLASH`` flag, then the global ``HOROVOD_PALLAS``,
+    then ``auto`` (TPU only).  Read per call: tests flip the env between
+    traces.
+    """
+    if family not in KERNEL_CONTRACTS:
+        raise ValueError(f"unknown pallas kernel family {family!r}; "
+                         f"known: {sorted(KERNEL_CONTRACTS)}")
+    flag = _read(_FAMILY_ENV[family])
+    if flag is None and family == "flash":
+        flag = _legacy_flash_flag()
+    if flag is None:
+        flag = _read("PALLAS")
+    if flag in (None, "", "auto"):
+        return jax.default_backend() == "tpu"
+    return flag != "0"
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels interpret off-TPU (CPU tests, the CI step audit)."""
+    return jax.default_backend() != "tpu"
+
+
+def registered_kernels():
+    return tuple(sorted(KERNEL_CONTRACTS))
+
+
+def kernel_contract(family: str) -> dict:
+    return dict(KERNEL_CONTRACTS[family])
+
+
+def active_kernels():
+    """The families whose kernels would dispatch right now."""
+    return tuple(k for k in registered_kernels() if pallas_enabled(k))

@@ -337,3 +337,97 @@ def test_cli_lint_flags_exit_code(tmp_path):
         cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "lint-" in proc.stdout
+
+
+# -- Pallas kernel awareness (PR 13) ----------------------------------------
+
+def test_collectives_in_kernels_flags_in_kernel_psum(hvd):
+    """A psum smuggled into a pallas_call body is caught by the kernel
+    walk and surfaces as audit-collective-in-kernel (the contract every
+    registered family declares it keeps)."""
+    from jax.experimental import pallas as pl
+    from horovod_tpu.analysis import jaxpr_walk as _walk
+
+    mesh = _basics.mesh()
+    axes = tuple(mesh.axis_names)
+
+    def bad_kernel(x_ref, o_ref):
+        o_ref[...] = jax.lax.psum(x_ref[...], axes[0])
+
+    def local(x):
+        return pl.pallas_call(
+            bad_kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True)(x)
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=P(axes),
+                       out_specs=P(axes), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((8, 4)))
+    hits = _walk.collectives_in_kernels(closed)
+    assert hits and hits[0].kind == "psum"
+    assert "pallas_call" in hits[0].path
+
+    report = audit_step(fn, jnp.ones((8, 4)), name="fixture:in-kernel")
+    assert not report.ok()
+    assert "audit-collective-in-kernel" in _rules(report.findings)
+
+
+def test_expected_exchange_kernel_aware(hvd, monkeypatch):
+    """With HOROVOD_PALLAS=1 the model annotates active families on
+    ExpectedExchange.kernels (no notes -> no warnings) and the audited
+    contract still matches -- the fused kernels keep the wire identical."""
+    monkeypatch.setenv("HOROVOD_PALLAS", "1")
+    step, args, donate, name = build_standard_config("powersgd_ef")
+    report = audit_step(step, *args, donate_argnums=donate, name=name)
+    assert report.ok(), report.render()
+    assert report.expected.kernels == ("bn_bwd", "flash", "flash_decode",
+                                       "fused_update")
+    assert not report.expected.notes
+    assert report.summary["unaccounted_ops"] == 0
+
+    monkeypatch.setenv("HOROVOD_PALLAS", "0")
+    step, args, donate, name = build_standard_config("powersgd_ef")
+    report_off = audit_step(step, *args, donate_argnums=donate, name=name)
+    assert report_off.ok(), report_off.render()
+    assert report_off.expected.kernels == ()
+    # Same contract either way: op multiset is unchanged by the kernels.
+    assert sorted(op.sig() for op in report.expected.ops) == \
+        sorted(op.sig() for op in report_off.expected.ops)
+
+
+def test_pallas_lint_needs_interpret_test(tmp_path):
+    from horovod_tpu.analysis.lints.pallas_tests import \
+        PallasInterpretTestRule
+    pkg = tmp_path / "horovod_tpu" / "ops"
+    pkg.mkdir(parents=True)
+    (pkg / "mykern.py").write_text(textwrap.dedent("""
+        from jax.experimental import pallas as pl
+
+        def f(x):
+            return pl.pallas_call(lambda x_ref, o_ref: None,
+                                  out_shape=x)(x)
+        """))
+    ctx = LintContext(pkg_dir=str(tmp_path / "horovod_tpu"),
+                      repo_root=str(tmp_path))
+    findings = list(PallasInterpretTestRule().run(ctx))
+    assert len(findings) == 1
+    assert findings[0].rule == "lint-pallas-needs-interpret-test"
+    assert findings[0].ident == "mykern"
+
+    # A tests/test_*<stem>*.py importing the module clears it...
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_ops_mykern.py").write_text(
+        "from horovod_tpu.ops import mykern\n")
+    assert not list(PallasInterpretTestRule().run(ctx))
+
+    # ...but a name-matching file that never imports it does not.
+    (tests / "test_ops_mykern.py").write_text("x = 1\n")
+    assert list(PallasInterpretTestRule().run(ctx))
+
+
+def test_pallas_lint_clean_on_repo_tree():
+    """Every committed pallas_call module ships its interpreter-mode
+    test (the lint this PR adds must hold on the tree that adds it)."""
+    from horovod_tpu.analysis.lints.pallas_tests import \
+        PallasInterpretTestRule
+    assert not list(PallasInterpretTestRule().run(LintContext()))

@@ -1442,3 +1442,224 @@ def test_autoscale_guard_trips_on_bad_entries(tmp_path):
     assert "slo_violation_s" in why and "slo_budget_s" in why
     assert "headline value" in why
     assert "vs_baseline" in why
+
+
+# ---------------------------------------------------------------------------
+# Pallas roofline entries (PR 13)
+# ---------------------------------------------------------------------------
+
+def scan_roofline_entries(bench_dir):
+    """Return [(path, why), ...] for malformed Pallas-roofline entries.
+
+    A roofline entry records the single-chip kernel drill
+    (BENCH_ROOFLINE=1): every HOROVOD_PALLAS family timed kernel-on vs
+    the XLA reference on the same shape, with flop/byte accounting
+    against the recorded v5e peaks.  All three families must be present
+    with positive timings, each kernel's parity error must clear the
+    1e-4 relative bound (the drill's whole reason to exist), the
+    achieved-rate and percent-of-peak arithmetic must recompute from
+    flops/bytes/on_ms, the geomean headline must recompute from the
+    per-kernel speedups, and vs_baseline must be null (off-TPU the
+    kernel leg runs the Pallas interpreter, so the ratio is parity
+    plumbing, not perf)."""
+    required = ("flash_decode", "fused_update", "bn_bwd")
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            rf = parsed.get("roofline")
+            if not rf:
+                continue
+            kernels = rf.get("kernels") or []
+            families = [k.get("family") for k in kernels]
+            missing = [f for f in required if f not in families]
+            if missing:
+                bad.append((path, f"families missing from the drill: "
+                                  f"{missing}"))
+            peak_tf = rf.get("peak_tflops")
+            peak_bw = rf.get("peak_hbm_gbps")
+            if not all(isinstance(v, (int, float)) and v > 0
+                       for v in (peak_tf, peak_bw)):
+                bad.append((path, f"bad peaks: tflops {peak_tf!r} hbm "
+                                  f"{peak_bw!r}"))
+                continue
+            speedups = []
+            for k in kernels:
+                fam = k.get("family")
+                on_ms, off_ms = k.get("on_ms"), k.get("off_ms")
+                if not all(isinstance(v, (int, float)) and v > 0
+                           for v in (on_ms, off_ms)):
+                    bad.append((path, f"{fam}: non-positive timings "
+                                      f"{on_ms!r}/{off_ms!r}"))
+                    continue
+                err = k.get("max_rel_err")
+                if not (isinstance(err, (int, float))
+                        and 0 <= err <= 1e-4):
+                    bad.append((path, f"{fam}: parity error {err!r} "
+                                      f"outside [0, 1e-4] -- the kernel "
+                                      f"disagrees with the XLA reference"))
+                sp = k.get("speedup")
+                if not (isinstance(sp, (int, float))
+                        and abs(sp - off_ms / on_ms) <= 0.02 * sp):
+                    bad.append((path, f"{fam}: speedup {sp!r} != "
+                                      f"off_ms/on_ms"))
+                else:
+                    speedups.append(sp)
+                flops, nbytes = k.get("flops"), k.get("bytes")
+                on_s = on_ms / 1e3
+                checks = (("achieved_tflops", flops, 1e12),
+                          ("achieved_gbps", nbytes, 1e9))
+                for key, work, scale in checks:
+                    got = k.get(key)
+                    if not isinstance(work, int) or work <= 0:
+                        bad.append((path, f"{fam}: bad {key} work "
+                                          f"accounting: {work!r}"))
+                        continue
+                    want = work / on_s / scale
+                    if not (isinstance(got, (int, float))
+                            and abs(got - want) <= 0.02 * want + 1e-4):
+                        bad.append((path, f"{fam}: {key} {got!r} does not "
+                                          f"recompute from work/on_ms "
+                                          f"({want:.4g})"))
+                for key, work, peak in (
+                        ("pct_peak_flops", flops, peak_tf * 1e12),
+                        ("pct_peak_hbm", nbytes, peak_bw * 1e9)):
+                    got = k.get(key)
+                    if not isinstance(work, int) or work <= 0:
+                        continue  # already flagged above
+                    want = work / on_s / peak * 100
+                    if not (isinstance(got, (int, float))
+                            and abs(got - want) <= 0.02 * want + 1e-4):
+                        bad.append((path, f"{fam}: {key} {got!r} does not "
+                                          f"recompute against the peak "
+                                          f"({want:.4g})"))
+            if speedups and len(speedups) == len(kernels):
+                import math
+                geo = math.exp(sum(math.log(s) for s in speedups)
+                               / len(speedups))
+                got = parsed.get("value")
+                if not (isinstance(got, (int, float))
+                        and abs(got - geo) <= 0.02 * geo):
+                    bad.append((path, f"headline geomean {got!r} does not "
+                                      f"recompute from per-kernel "
+                                      f"speedups ({geo:.4g})"))
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "roofline entries must carry a null "
+                                  "vs_baseline (interpreter drill off-TPU"
+                                  ", not a perf peer)"))
+    return bad
+
+
+def test_committed_roofline_entries_well_formed():
+    assert scan_roofline_entries(REPO) == []
+
+
+def test_committed_roofline_round_exists():
+    """Acceptance gate: a committed bench round must record the kernel
+    drill with all three families in parity."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            rf = (entry.get("parsed") or {}).get("roofline")
+            if rf:
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries a roofline block"
+    for path, parsed in found:
+        assert parsed["metric"] == "pallas_roofline_speedup_geomean", path
+        fams = sorted(k["family"] for k in parsed["roofline"]["kernels"])
+        assert fams == ["bn_bwd", "flash_decode", "fused_update"], (
+            path, fams)
+        for k in parsed["roofline"]["kernels"]:
+            assert k["max_rel_err"] <= 1e-4, (path, k)
+
+
+def _write_roofline(tmp_path, name, kernels, vs_baseline=None, value=None):
+    import math
+    if value is None:
+        sps = [k["speedup"] for k in kernels]
+        value = round(math.exp(sum(math.log(s) for s in sps) / len(sps)), 4)
+    parsed = {"metric": "pallas_roofline_speedup_geomean", "value": value,
+              "unit": "x", "vs_baseline": vs_baseline,
+              "config": "pallas_roofline_cpu",
+              "baseline_config": "pallas_roofline_cpu",
+              "roofline": {"backend": "cpu", "interpreted": True,
+                           "peak_tflops": 197.0, "peak_hbm_gbps": 819.0,
+                           "iters": 5, "kernels": kernels}}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 14, "cmd": "BENCH_ROOFLINE=1 bench.py", "rc": 0, "tail": "",
+         "parsed": parsed}))
+
+
+def _roofline_kernel(family, on_ms, off_ms, flops, nbytes, err=1e-7):
+    on_s = on_ms / 1e3
+    return {"family": family, "shape": "probe",
+            "on_ms": on_ms, "off_ms": off_ms,
+            "speedup": round(off_ms / on_ms, 4),
+            "flops": flops, "bytes": nbytes,
+            "achieved_tflops": round(flops / on_s / 1e12, 4),
+            "achieved_gbps": round(nbytes / on_s / 1e9, 3),
+            "pct_peak_flops": round(flops / on_s / 197e12 * 100, 4),
+            "pct_peak_hbm": round(nbytes / on_s / 819e9 * 100, 4),
+            "max_rel_err": err}
+
+
+def _good_roofline_kernels():
+    return [_roofline_kernel("flash_decode", 50.0, 9.0, 2 ** 24, 2 ** 23),
+            _roofline_kernel("fused_update", 3.3, 1.2, 2 ** 23, 2 ** 22),
+            _roofline_kernel("bn_bwd", 170.0, 43.0, 2 ** 24, 2 ** 25)]
+
+
+def test_roofline_guard_accepts_good_entry(tmp_path):
+    _write_roofline(tmp_path, "BENCH_r90.json", _good_roofline_kernels())
+    assert scan_roofline_entries(str(tmp_path)) == []
+    # ...and the >=0.98 gate ignores it (vs_baseline null).
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_roofline_guard_trips_on_bad_entries(tmp_path):
+    ks = _good_roofline_kernels()
+    ks[0]["max_rel_err"] = 5e-3            # parity broken
+    ks[1]["speedup"] = 9.9                 # does not recompute
+    ks[2]["achieved_tflops"] = 123.0       # does not recompute
+    _write_roofline(tmp_path, "BENCH_r91.json", ks)
+    _write_roofline(tmp_path, "BENCH_r92.json",
+                    _good_roofline_kernels()[:2])   # bn_bwd missing
+    _write_roofline(tmp_path, "BENCH_r93.json", _good_roofline_kernels(),
+                    vs_baseline=1.0)                # must be null
+    _write_roofline(tmp_path, "BENCH_r94.json", _good_roofline_kernels(),
+                    value=99.0)                     # headline mismatch
+    why = " ".join(w for _, w in scan_roofline_entries(str(tmp_path)))
+    assert "parity error" in why
+    assert "speedup" in why
+    assert "achieved_tflops" in why
+    assert "families missing" in why and "bn_bwd" in why
+    assert "vs_baseline" in why
+    assert "headline geomean" in why
+
+
+def test_bench_roofline_mode_flags(monkeypatch):
+    """BENCH_ROOFLINE=1 selects the kernel drill; BENCH_ROOFLINE_ITERS
+    sizes the timing loop."""
+    import importlib
+
+    import bench
+    monkeypatch.setenv("BENCH_ROOFLINE", "1")
+    b = importlib.reload(bench)
+    assert b.ROOFLINE_BENCH and b.ROOFLINE_ITERS == 5
+    monkeypatch.setenv("BENCH_ROOFLINE_ITERS", "9")
+    b = importlib.reload(bench)
+    assert b.ROOFLINE_ITERS == 9
+    monkeypatch.delenv("BENCH_ROOFLINE")
+    monkeypatch.delenv("BENCH_ROOFLINE_ITERS")
+    b = importlib.reload(bench)
+    assert not b.ROOFLINE_BENCH

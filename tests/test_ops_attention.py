@@ -375,3 +375,146 @@ def test_segment_ids_cross_length_decode(causal):
                      32, 32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Flash-decoding: split-KV decode kernel (HOROVOD_PALLAS / _PALLAS_DECODE).
+# ---------------------------------------------------------------------------
+
+from horovod_tpu.ops.attention import _flash_decode, decode_attention
+
+
+def _decode_case(key, b=4, h=8, h_kv=8, s=128, d=32):
+    keys = jax.random.split(key, 4)
+    q = _rand((b, h, 1, d), keys[0])
+    k = _rand((b, h_kv, s, d), keys[1])
+    v = _rand((b, h_kv, s, d), keys[2])
+    lengths = jax.random.randint(keys[3], (b,), 1, s + 1)
+    return q, k, v, lengths
+
+
+@pytest.mark.parametrize("h_kv", [8, 2])  # MHA and GQA (rep=4)
+def test_flash_decode_matches_reference(h_kv):
+    q, k, v, lengths = _decode_case(jax.random.PRNGKey(20), h_kv=h_kv)
+    ref = decode_attention(q, k, v, lengths=lengths, force_reference=True)
+    got = _flash_decode(q, k, v, lengths, q.shape[-1] ** -0.5, 32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_block_straddles_length():
+    """Lengths that cut a KV block mid-way exercise the per-column mask
+    (not just the whole-block predication)."""
+    q, k, v, _ = _decode_case(jax.random.PRNGKey(21))
+    lengths = jnp.asarray([1, 31, 33, 128], jnp.int32)
+    ref = decode_attention(q, k, v, lengths=lengths, force_reference=True)
+    got = _flash_decode(q, k, v, lengths, q.shape[-1] ** -0.5, 32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_dead_slot_exactly_zero():
+    """An idle batch slot (lengths == 0) runs no live KV block, so the
+    finish step's l == 0 guard must yield EXACTLY zero -- not a uniform
+    average over garbage keys."""
+    q, k, v, _ = _decode_case(jax.random.PRNGKey(22))
+    lengths = jnp.asarray([0, 64, 0, 128], jnp.int32)
+    got = _flash_decode(q, k, v, lengths, q.shape[-1] ** -0.5, 32)
+    np.testing.assert_array_equal(np.asarray(got[0]), 0.0)
+    np.testing.assert_array_equal(np.asarray(got[2]), 0.0)
+    ref = decode_attention(q, k, v, lengths=lengths, force_reference=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_masked_page_reuse():
+    """Cache positions past lengths hold recycled-page garbage; poisoning
+    them with huge values must not change the output (the mask, not the
+    data, decides)."""
+    q, k, v, _ = _decode_case(jax.random.PRNGKey(23))
+    lengths = jnp.asarray([17, 40, 96, 5], jnp.int32)
+    live = jnp.arange(k.shape[2])[None, None, :, None] < \
+        lengths[:, None, None, None]
+    k_poison = jnp.where(live, k, 1e4)
+    v_poison = jnp.where(live, v, -1e4)
+    clean = _flash_decode(q, k, v, lengths, q.shape[-1] ** -0.5, 32)
+    poisoned = _flash_decode(q, k_poison, v_poison, lengths,
+                             q.shape[-1] ** -0.5, 32)
+    np.testing.assert_allclose(np.asarray(poisoned), np.asarray(clean),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_decode_attention_env_dispatch(monkeypatch):
+    """HOROVOD_PALLAS_DECODE=1 routes decode_attention through the kernel
+    (interpreter off-TPU); =0 pins the XLA reference; both agree."""
+    q, k, v, lengths = _decode_case(jax.random.PRNGKey(24), h_kv=2)
+    monkeypatch.setenv("HOROVOD_PALLAS_DECODE", "0")
+    ref = decode_attention(q, k, v, lengths=lengths)
+    monkeypatch.setenv("HOROVOD_PALLAS_DECODE", "1")
+    got = decode_attention(q, k, v, lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_attention_validation():
+    q = jnp.zeros((2, 4, 1, 16))
+    k = jnp.zeros((2, 2, 32, 16))
+    lengths = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="single-token"):
+        decode_attention(jnp.zeros((2, 4, 2, 16)), k, k, lengths=lengths)
+    with pytest.raises(ValueError, match="not a multiple"):
+        decode_attention(jnp.zeros((2, 3, 1, 16)), k, k, lengths=lengths)
+    with pytest.raises(ValueError, match="lengths must be"):
+        decode_attention(q, k, k, lengths=jnp.zeros((3,), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# The unified HOROVOD_PALLAS switch (ops.pallas).
+# ---------------------------------------------------------------------------
+
+def test_pallas_switch_resolution(monkeypatch):
+    from horovod_tpu.ops import pallas as _pallas
+    for var in ("HOROVOD_PALLAS", "HVD_TPU_PALLAS", "HVD_TPU_FLASH",
+                "HOROVOD_PALLAS_FLASH", "HOROVOD_PALLAS_DECODE"):
+        monkeypatch.delenv(var, raising=False)
+    # auto: follows the backend (CPU here -> off).
+    assert not _pallas.pallas_enabled("flash")
+    # global switch gates every family...
+    monkeypatch.setenv("HOROVOD_PALLAS", "1")
+    assert _pallas.active_kernels() == _pallas.registered_kernels()
+    # ...and the per-family override wins over it.
+    monkeypatch.setenv("HOROVOD_PALLAS_DECODE", "0")
+    assert not _pallas.pallas_enabled("flash_decode")
+    assert _pallas.pallas_enabled("flash")
+    with pytest.raises(ValueError, match="unknown pallas kernel family"):
+        _pallas.pallas_enabled("nope")
+
+
+def test_pallas_switch_legacy_flash_flag(monkeypatch):
+    from horovod_tpu.ops import pallas as _pallas
+    for var in ("HOROVOD_PALLAS", "HVD_TPU_PALLAS",
+                "HOROVOD_PALLAS_FLASH"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("HVD_TPU_FLASH", "1")
+    monkeypatch.setattr(_pallas, "_warned_legacy", False)
+    with pytest.warns(DeprecationWarning, match="HVD_TPU_FLASH"):
+        assert _pallas.pallas_enabled("flash")
+    # The legacy flag only speaks for the flash family...
+    assert not _pallas.pallas_enabled("flash_decode")
+    # ...and loses to the unified per-family override.
+    monkeypatch.setenv("HOROVOD_PALLAS_FLASH", "0")
+    assert not _pallas.pallas_enabled("flash")
+
+
+def test_pallas_kernel_contracts_are_collective_free():
+    """The registry every kernel family ships: no in-kernel collectives,
+    no wire-byte deltas -- what stepmodel/trace_audit build on."""
+    from horovod_tpu.ops import pallas as _pallas
+    fams = _pallas.registered_kernels()
+    assert set(fams) >= {"flash", "flash_decode", "fused_update",
+                         "bn_bwd"}
+    for fam in fams:
+        contract = _pallas.kernel_contract(fam)
+        assert contract["collectives"] == ()
+        assert contract["wire_delta_bytes"] == 0
+        assert contract["site"]

@@ -1,0 +1,166 @@
+"""Fused BN-backward kernels (ops.bn) -- Pallas interpret mode on CPU.
+
+Parity ladder: the two-pass kernels against the XLA closed form and
+against autodiff of the naive BN composition (on the probe's hot channel
+widths), the flax-compatible ``BatchNorm`` module against
+``flax.linen.BatchNorm`` (outputs, variable tree, running stats), and
+the RN50 dispatch site end-to-end with the flag on vs off.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops import bn as _bn
+
+# Probe hot sites are (256, HxH, C) with C in {64, 128, 256, 512}
+# (examples/bn_bwd_probe.py); CPU interpret mode keeps the channel
+# widths and shrinks batch/spatial.
+HOT_CHANNELS = (64, 128, 256, 512)
+
+
+def _case(key, c, n=2, side=6, dtype=jnp.float32):
+    keys = jax.random.split(key, 4)
+    x = jax.random.normal(keys[0], (n, side, side, c), dtype)
+    dy = jax.random.normal(keys[1], (n, side, side, c), dtype)
+    scale = jax.random.normal(keys[2], (c,), jnp.float32) + 1.0
+    bias = jax.random.normal(keys[3], (c,), jnp.float32)
+    return x, dy, scale, bias
+
+
+@pytest.mark.parametrize("c", HOT_CHANNELS)
+def test_bn_backward_kernel_matches_closed_form(monkeypatch, c):
+    x, dy, scale, _ = _case(jax.random.PRNGKey(0), c)
+    mean, var = _bn.batch_stats(x)
+    monkeypatch.setenv("HOROVOD_PALLAS_BN", "0")
+    dx0, dg0, db0 = _bn.fused_bn_backward(x, scale, mean, var, dy,
+                                          eps=1e-5)
+    monkeypatch.setenv("HOROVOD_PALLAS_BN", "1")
+    dx1, dg1, db1 = _bn.fused_bn_backward(x, scale, mean, var, dy,
+                                          eps=1e-5)
+    np.testing.assert_allclose(np.asarray(dx1), np.asarray(dx0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dg1), np.asarray(dg0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(db1), np.asarray(db0),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("c", [64, 512])
+def test_bn_backward_kernel_matches_autodiff(monkeypatch, c):
+    """The kernels against jax.grad of the naive normalize composition
+    (mean/var INSIDE the differentiated function -- the real train-mode
+    backward, not the frozen-stats shortcut)."""
+    monkeypatch.setenv("HOROVOD_PALLAS_BN", "1")
+    x, dy, scale, bias = _case(jax.random.PRNGKey(1), c)
+
+    def naive(x, scale, bias):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.mean(jnp.square(xf), axis=(0, 1, 2)) - mean ** 2
+        y = (xf - mean) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+        return jnp.sum(y.astype(x.dtype) * dy)
+
+    def kernel(x, scale, bias):
+        return jnp.sum(_bn.bn_train(x, scale, bias, 1e-5) * dy)
+
+    g_ref = jax.grad(naive, argnums=(0, 1, 2))(x, scale, bias)
+    g_ker = jax.grad(kernel, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b in zip(g_ker, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_bn_backward_bf16_activations(monkeypatch):
+    """bf16 x/dy (the RN50 compute dtype): f32 in-register stats, dx back
+    in bf16, dgamma/dbeta in f32."""
+    x, dy, scale, _ = _case(jax.random.PRNGKey(2), 128,
+                            dtype=jnp.bfloat16)
+    mean, var = _bn.batch_stats(x)
+    monkeypatch.setenv("HOROVOD_PALLAS_BN", "0")
+    dx0, dg0, db0 = _bn.fused_bn_backward(x, scale, mean, var, dy,
+                                          eps=1e-5)
+    monkeypatch.setenv("HOROVOD_PALLAS_BN", "1")
+    dx1, dg1, db1 = _bn.fused_bn_backward(x, scale, mean, var, dy,
+                                          eps=1e-5)
+    assert dx1.dtype == jnp.bfloat16
+    assert dg1.dtype == db1.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(dx1, dtype=np.float32),
+                               np.asarray(dx0, dtype=np.float32),
+                               rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(dg1), np.asarray(dg0),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db1), np.asarray(db0),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bn_module_matches_flax(monkeypatch):
+    """Same params in, same outputs and same mutated batch_stats out --
+    train and inference -- as flax.linen.BatchNorm, and an identical
+    variable tree (the checkpoint-compatibility claim)."""
+    monkeypatch.setenv("HOROVOD_PALLAS_BN", "1")
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 8, 8, 32))
+    ours = _bn.BatchNorm(momentum=0.9, epsilon=1e-5)
+    theirs = nn.BatchNorm(momentum=0.9, epsilon=1e-5)
+    v_ours = ours.init(jax.random.PRNGKey(4), x,
+                       use_running_average=False)
+    v_theirs = theirs.init(jax.random.PRNGKey(4), x,
+                           use_running_average=False)
+    assert jax.tree.structure(v_ours) == jax.tree.structure(v_theirs)
+
+    y_ours, m_ours = ours.apply(v_theirs, x, use_running_average=False,
+                                mutable=["batch_stats"])
+    y_theirs, m_theirs = theirs.apply(v_theirs, x,
+                                      use_running_average=False,
+                                      mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y_ours), np.asarray(y_theirs),
+                               rtol=1e-5, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(m_ours), jax.tree.leaves(m_theirs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    y_eval = ours.apply(v_theirs, x, use_running_average=True)
+    y_eval_ref = theirs.apply(v_theirs, x, use_running_average=True)
+    np.testing.assert_allclose(np.asarray(y_eval),
+                               np.asarray(y_eval_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_dispatch_flag_on_off(monkeypatch):
+    """The RN50 BN sites: flag on and off give identical variable trees
+    and matching loss/gradients (the swap changes kernels, not math)."""
+    from horovod_tpu.models.resnet import ResNet, BasicBlock
+
+    def build():
+        model = ResNet(stage_sizes=[1, 1], block_cls=BasicBlock,
+                       num_classes=4, num_filters=8, dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 16, 16, 3))
+        variables = model.init(jax.random.PRNGKey(6), x, train=True)
+
+        def loss(params):
+            logits, _ = model.apply(
+                {"params": params,
+                 "batch_stats": variables["batch_stats"]},
+                x, train=True, mutable=["batch_stats"])
+            return jnp.sum(logits ** 2)
+
+        g = jax.grad(loss)(variables["params"])
+        return variables, g
+
+    monkeypatch.setenv("HOROVOD_PALLAS_BN", "0")
+    v0, g0 = build()
+    monkeypatch.setenv("HOROVOD_PALLAS_BN", "1")
+    v1, g1 = build()
+    assert jax.tree.structure(v0) == jax.tree.structure(v1)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_row_block_divides():
+    assert _bn._row_block(512) == 512
+    assert _bn._row_block(1024) == 512
+    assert _bn._row_block(72) == 72
+    assert _bn._row_block(7) == 7  # single-block fallback

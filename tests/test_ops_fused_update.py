@@ -1,0 +1,227 @@
+"""Fused optimizer+codec update kernels (ops.fused_update) vs the
+unfused PowerSGD+EF exchange -- Pallas interpret mode on CPU.
+
+The fusion contract: the three kernel stages replace only the compute
+BETWEEN the two P/Q factor psums, so with the flag on (a) the output and
+residual are bitwise what the unfused path produces, (b) the traced
+collectives -- kind, dtype, element count -- are identical, and (c) with
+the flag off nothing changes at all.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hv
+from horovod_tpu.collectives import ops as _ops
+from horovod_tpu.collectives.compression import powersgd_matrix_shape
+from horovod_tpu.core.state import global_state
+from horovod_tpu.ops import fused_update as _fused
+
+
+def _mesh_axes():
+    return tuple(global_state().mesh.axis_names)
+
+
+def _shard_run(fn, *arrays):
+    mesh = global_state().mesh
+    axes = P(*mesh.axis_names)
+
+    def spmd(*blocks):
+        out = fn(*[b[0] for b in blocks])
+        return jax.tree.map(lambda y: y[None], out)
+
+    # check_vma=False, like every package call site: shard_map's
+    # replication checker has no rule for pallas_call.
+    return jax.jit(jax.shard_map(
+        spmd, mesh=mesh, in_specs=axes, out_specs=axes,
+        check_vma=False))(*arrays)
+
+
+def _both_paths(fn, monkeypatch):
+    """Run ``fn()`` with the fused_update family pinned off, then on."""
+    monkeypatch.setenv("HOROVOD_PALLAS_FUSED_UPDATE", "0")
+    off = fn()
+    monkeypatch.setenv("HOROVOD_PALLAS_FUSED_UPDATE", "1")
+    on = fn()
+    return off, on
+
+
+# ---------------------------------------------------------------------------
+# Kernel-stage unit parity (single process, no mesh).
+# ---------------------------------------------------------------------------
+
+def test_matricize_p_accumulates_and_projects():
+    rng = np.random.RandomState(0)
+    m, c, r = 24, 16, 3
+    x = rng.randn(m, c).astype(np.float32)
+    res = rng.randn(m, c).astype(np.float32)
+    q0 = rng.randn(c, r).astype(np.float32)
+    acc, p = _fused.matricize_p(jnp.asarray(x), jnp.asarray(res),
+                                jnp.asarray(q0), prescale=0.5)
+    np.testing.assert_array_equal(np.asarray(acc), x * 0.5 + res)
+    np.testing.assert_allclose(np.asarray(p), (x * 0.5 + res) @ q0,
+                               rtol=1e-6, atol=1e-6)
+    acc2, _ = _fused.matricize_p(jnp.asarray(x), None, jnp.asarray(q0))
+    np.testing.assert_array_equal(np.asarray(acc2), x)
+
+
+def test_orthonormalize_q_matches_unfused_mgs():
+    rng = np.random.RandomState(1)
+    m, c, r = 16, 24, 3
+    acc = rng.randn(m, c).astype(np.float32)
+    p_mean = rng.randn(m, r).astype(np.float32)
+    p_orth, q_local = _fused.orthonormalize_q(jnp.asarray(acc),
+                                              jnp.asarray(p_mean))
+    ref = _ops._orthonormalize_columns(jnp.asarray(p_mean))
+    np.testing.assert_allclose(np.asarray(p_orth), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(q_local),
+                               acc.T @ np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    # Orthonormal to f32 roundoff.
+    gram = np.asarray(p_orth).T @ np.asarray(p_orth)
+    np.testing.assert_allclose(gram, np.eye(r), atol=1e-5)
+
+
+def test_reconstruct_residual_scales_in_unfused_order():
+    rng = np.random.RandomState(2)
+    m, c, r = 16, 16, 2
+    acc = rng.randn(m, c).astype(np.float32)
+    po = rng.randn(m, r).astype(np.float32)
+    q = rng.randn(c, r).astype(np.float32)
+    ql = rng.randn(c, r).astype(np.float32)
+    out, res = _fused.reconstruct_residual(
+        jnp.asarray(acc), jnp.asarray(po), jnp.asarray(q),
+        jnp.asarray(ql), n_scale=4.0, postscale=0.25)
+    np.testing.assert_allclose(np.asarray(out), ((po @ q.T) * 4.0) * 0.25,
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res), acc - po @ ql.T,
+                               rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end exchange parity under shard_map.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("size,rank", [(50, 3), (64, 2), (37, 1)])
+def test_fused_powersgd_parity_vs_unfused(hvd, monkeypatch, size, rank):
+    """The whole point: flag on == flag off to f32 roundoff, output AND
+    residual (sizes include non-square and padded matricizations; the
+    kernel's in-register accumulation order differs from XLA's, so the
+    bound is roundoff, not bitwise)."""
+    n = hvd.size()
+    x = np.random.RandomState(3).randn(n, size).astype(np.float32)
+    res = np.random.RandomState(4).randn(n, size).astype(np.float32)
+
+    def run():
+        def f(row, res_row):
+            return _ops.powersgd_allreduce(row, hv.Average, rank=rank,
+                                           axes=_mesh_axes(),
+                                           residual=res_row)
+        return _shard_run(f, x, res)
+
+    (out0, res0), (out1, res1) = _both_paths(run, monkeypatch)
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(res0), np.asarray(res1),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_fused_powersgd_parity_sum_no_residual(hvd, monkeypatch):
+    """Sum op (the * n scale) and the residual-free first step."""
+    n = hvd.size()
+    x = np.random.RandomState(5).randn(n, 48).astype(np.float32)
+
+    def run():
+        def f(row):
+            return _ops.powersgd_allreduce(row, hv.Sum, rank=2,
+                                           axes=_mesh_axes())
+        return _shard_run(f, x)
+
+    (out0, res0), (out1, res1) = _both_paths(run, monkeypatch)
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(res0), np.asarray(res1),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_fused_powersgd_parity_bf16(hvd, monkeypatch):
+    """bf16 buckets: the f32 accumulate/cast order must match too."""
+    n = hvd.size()
+    x = np.random.RandomState(6).randn(n, 40).astype(np.float32)
+    res = np.random.RandomState(7).randn(n, 40).astype(np.float32)
+
+    def run():
+        def f(row, res_row):
+            return _ops.powersgd_allreduce(
+                row.astype(jnp.bfloat16), hv.Average, rank=2,
+                axes=_mesh_axes(), residual=res_row)
+        return _shard_run(f, x, res)
+
+    (out0, res0), (out1, res1) = _both_paths(run, monkeypatch)
+    assert out1.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out0, dtype=np.float32),
+                               np.asarray(out1, dtype=np.float32),
+                               rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(res0), np.asarray(res1),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_powersgd_wire_contract_unchanged(hvd, monkeypatch):
+    """Same collectives on the wire with the kernels on: the two P/Q
+    factor psums (f32, r*(m+c) elements total) and nothing else."""
+    from horovod_tpu.analysis import jaxpr_walk as _walk
+    n = hvd.size()
+    size, rank = 50, 3
+    m, c = powersgd_matrix_shape(size)
+    mesh = global_state().mesh
+    axes = P(*mesh.axis_names)
+
+    def spmd(row):
+        out, res = _ops.powersgd_allreduce(row[0], hv.Average, rank=rank,
+                                           axes=_mesh_axes())
+        return out[None], res[None]
+
+    def collect():
+        x = jnp.zeros((n, size), jnp.float32)
+        closed = jax.make_jaxpr(jax.shard_map(
+            spmd, mesh=mesh, in_specs=axes, out_specs=axes,
+            check_vma=False))(x)
+        sigs = sorted(r.sig() for r in _walk.collect_collectives(closed))
+        kernel_hits = _walk.collectives_in_kernels(closed)
+        return sigs, kernel_hits
+
+    (sigs0, _), (sigs1, hits1) = _both_paths(collect, monkeypatch)
+    assert sigs0 == sigs1 == sorted(
+        [("psum", "float32", rank * m), ("psum", "float32", rank * c)])
+    # The kernels themselves stay collective-free (the contract the
+    # trace auditor enforces).
+    assert hits1 == []
+
+
+def test_fused_flag_off_is_default_path(hvd, monkeypatch):
+    """HOROVOD_PALLAS_FUSED_UPDATE=0 under a global HOROVOD_PALLAS=1
+    pins the unfused path: no pallas_call in the trace at all."""
+    monkeypatch.setenv("HOROVOD_PALLAS", "1")
+    monkeypatch.setenv("HOROVOD_PALLAS_FUSED_UPDATE", "0")
+    n = hvd.size()
+    mesh = global_state().mesh
+    axes = P(*mesh.axis_names)
+
+    def spmd(row):
+        out, res = _ops.powersgd_allreduce(row[0], hv.Average, rank=2,
+                                           axes=_mesh_axes())
+        return out[None], res[None]
+
+    closed = jax.make_jaxpr(jax.shard_map(
+        spmd, mesh=mesh, in_specs=axes, out_specs=axes,
+        check_vma=False))(jnp.zeros((n, 50), jnp.float32))
+    assert "pallas_call" not in str(closed)
+    monkeypatch.setenv("HOROVOD_PALLAS_FUSED_UPDATE", "1")
+    closed = jax.make_jaxpr(jax.shard_map(
+        spmd, mesh=mesh, in_specs=axes, out_specs=axes,
+        check_vma=False))(jnp.zeros((n, 50), jnp.float32))
+    assert "pallas_call" in str(closed)
