@@ -112,6 +112,28 @@ SERVING_BENCH = _env_on("BENCH_SERVING")
 SERVING_REQUESTS = int(os.environ.get("BENCH_SERVING_REQUESTS", "24"))
 SERVING_RATE = float(os.environ.get("BENCH_SERVING_RATE", "50"))
 SERVING_SLOTS = int(os.environ.get("BENCH_SERVING_SLOTS", "8"))
+# BENCH_SERVING_V2=1 runs the round-15 serving overhaul drill, two phases
+# in one process.  Phase A (throughput): the BENCH_r11 workload with
+# longer outputs, served with speculative decoding (self-draft
+# ModelDrafter, k tokens verified in one fixed-shape width-k+1 step) and
+# fp8 KV-cache compression on -- gated at >= 2x r11's 262.95 tokens/s
+# with mean batch occupancy > 0.8.  Phase B (latency): the 512/2048/4096
+# kilotoken mixture through chunked flash prefill vs an identical
+# no-chunk run, gated on TTFT p99 at the 4k bucket (chunked must beat
+# whole-prompt prefill, which blocks the decode loop for entire
+# kilotoken forwards).  vs_baseline reports the phase-A speedup over
+# r11; tests/test_bench_guard.py::scan_serving_v2_entries enforces the
+# block shape and both gates on the committed BENCH_r15.json.
+SERVING_V2_BENCH = _env_on("BENCH_SERVING_V2")
+SERVING_V2_REQUESTS = int(os.environ.get("BENCH_SERVING_V2_REQUESTS", "32"))
+SERVING_V2_RATE = float(os.environ.get("BENCH_SERVING_V2_RATE", "100"))
+SERVING_V2_K = int(os.environ.get("BENCH_SERVING_V2_K", "4"))
+SERVING_V2_CHUNK = int(os.environ.get("BENCH_SERVING_V2_CHUNK", "512"))
+SERVING_V2_LONG_REQUESTS = int(
+    os.environ.get("BENCH_SERVING_V2_LONG_REQUESTS", "12"))
+# Round-11 recorded serving throughput (BENCH_r11.json) on the same
+# 8-device virtual CPU mesh -- the denominator of the phase-A gate.
+SERVING_R11_TOKENS_PER_S = 262.95
 # BENCH_AUTOSCALE=1 runs the SLO-driven elastic serving drill: the same
 # LLAMA_SERVE decoder behind the ServingControlPlane, with a kill@ +
 # slow@ chaos spec fired virtually under the Poisson load.  The closed
@@ -329,6 +351,134 @@ def _main_serving():
                      "prompt_lens": list(spec.prompt_lens),
                      "output_lens": list(spec.output_lens),
                      "seed": spec.seed},
+        },
+    }
+    print(json.dumps(result), flush=True)
+    os._exit(0)
+
+
+def _main_serving_v2():
+    """BENCH_SERVING_V2=1: round-15 serving throughput overhaul drill."""
+    import dataclasses
+    from horovod_tpu.utils.platform import force_host_device_count
+    force_host_device_count(8, cpu=True)  # before jax touches the backend
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from horovod_tpu import serving
+    from horovod_tpu.models.transformer import LLAMA_SERVE, LlamaLM
+
+    cfg = LLAMA_SERVE
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("tp",))
+    slots = SERVING_SLOTS
+
+    # --- phase A: speculative throughput on the r11 workload shape -------
+    # Self-draft: the drafter runs the target model on its own 1-device
+    # mesh, so drafts disagree with the sharded verify argmax only where
+    # layout changes the float rounding -- acceptance stays near 1 and
+    # each width-(k+1) dispatch emits ~k+1 tokens where r11 paid one
+    # 8-device dispatch per token.  fp8 KV compression rides along to
+    # show the gather-path blend at full throughput.
+    drafter = serving.ModelDrafter(cfg, params, slots=slots, page_size=8,
+                                   max_len=64, dtype=jnp.float32)
+    eng_a = serving.ServingEngine(cfg, params, mesh=mesh, slots=slots,
+                                  page_size=8, max_len=64,
+                                  spec_decode=True, spec_k=SERVING_V2_K,
+                                  drafter=drafter, kv_compress=True)
+    spec_a = serving.LoadSpec(num_requests=SERVING_V2_REQUESTS,
+                              rate_rps=SERVING_V2_RATE,
+                              prompt_lens=(4, 8, 16),
+                              output_lens=(16, 24),
+                              vocab_size=cfg.vocab_size, seed=11)
+    # Warm-up compiles prefill variants, the verify step, and the
+    # drafter's own decode step outside the timed run.
+    eng_a.serve(serving.generate(
+        dataclasses.replace(spec_a, num_requests=6, seed=1)))
+    rep_a = eng_a.serve(serving.generate(spec_a))
+    print(f"# phase A: {rep_a.tokens_per_s:.1f} tokens/s, "
+          f"acceptance {rep_a.acceptance_rate:.3f}, "
+          f"occupancy {rep_a.mean_occupancy:.3f}", file=sys.stderr)
+
+    # --- phase B: kilotoken TTFT, chunked vs whole-prompt prefill --------
+    def _long_run(chunk):
+        eng = serving.ServingEngine(cfg, params, mesh=mesh, slots=slots,
+                                    page_size=8, max_len=4608,
+                                    prefill_chunk=chunk)
+        # Warm-up covers every prompt length in the mixture so neither
+        # run pays prefill compiles inside its timed TTFT window.
+        warm = serving.long_prompt_spec(
+            num_requests=6, rate_rps=1000.0,
+            prompt_weights=(0.34, 0.33, 0.33),
+            vocab_size=cfg.vocab_size, seed=1)
+        eng.serve(serving.generate(warm))
+        reqs = serving.generate(serving.long_prompt_spec(
+            num_requests=SERVING_V2_LONG_REQUESTS,
+            vocab_size=cfg.vocab_size, seed=11))
+        rep = eng.serve(reqs)
+        ttft_4k = sorted(r.ttft_s for r in reqs
+                         if r.prompt_len == 4096 and r.ttft_s is not None)
+        assert ttft_4k, "mixture produced no 4k-token prompts"
+        return rep, ttft_4k
+
+    rep_c, t4k_c = _long_run(SERVING_V2_CHUNK)
+    rep_n, t4k_n = _long_run(0)
+    p = lambda v, q: round(float(np.percentile(np.asarray(v), q)) * 1e3, 3)
+    print(f"# phase B: 4k TTFT p99 chunked {p(t4k_c, 99)} ms vs "
+          f"whole-prompt {p(t4k_n, 99)} ms", file=sys.stderr)
+
+    def _long_block(rep, t4k):
+        return {"completed": rep.completed,
+                "requests": rep.num_requests,
+                "tokens_per_s": round(rep.tokens_per_s, 2),
+                "ttft_p50_ms": round(rep.ttft_p50_s * 1e3, 3),
+                "ttft_p99_ms": round(rep.ttft_p99_s * 1e3, 3),
+                "ttft_4k_p50_ms": p(t4k, 50),
+                "ttft_4k_p99_ms": p(t4k, 99),
+                "prompts_4k": len(t4k)}
+
+    config = f"llama_serve_v2_w8_slots{slots}_spec{SERVING_V2_K}_fp8kv"
+    result = {
+        "metric": "serving_v2_tokens_per_sec",
+        "value": round(rep_a.tokens_per_s, 2),
+        "unit": "tokens/s",
+        # Same mesh/model/slots as r11; the serving stack is the variable.
+        "vs_baseline": round(rep_a.tokens_per_s / SERVING_R11_TOKENS_PER_S,
+                             2),
+        "config": config,
+        "baseline_config": "llama_serve_w8_slots8",
+        "serving_v2": {
+            "world": 8,
+            "slots": slots,
+            "spec_k": SERVING_V2_K,
+            "drafter": "model_self_draft",
+            "kv_compress": True,
+            "throughput": {
+                "requests": rep_a.num_requests,
+                "completed": rep_a.completed,
+                "rejected": rep_a.rejected,
+                "new_tokens": rep_a.new_tokens,
+                "decode_steps": rep_a.decode_steps,
+                "spec_rounds": rep_a.spec_rounds,
+                "proposed_tokens": rep_a.proposed_tokens,
+                "accepted_tokens": rep_a.accepted_tokens,
+                "acceptance_rate": round(rep_a.acceptance_rate, 4),
+                "tokens_per_s": round(rep_a.tokens_per_s, 2),
+                "batch_occupancy": round(rep_a.mean_occupancy, 4),
+                "baseline_tokens_per_s": SERVING_R11_TOKENS_PER_S,
+                "load": {"rate_rps": SERVING_V2_RATE,
+                         "num_requests": SERVING_V2_REQUESTS,
+                         "prompt_lens": list(spec_a.prompt_lens),
+                         "output_lens": list(spec_a.output_lens),
+                         "seed": spec_a.seed}},
+            "long_prompt": {
+                "prefill_chunk": SERVING_V2_CHUNK,
+                "num_requests": SERVING_V2_LONG_REQUESTS,
+                "prompt_lens": [512, 2048, 4096],
+                "chunked": _long_block(rep_c, t4k_c),
+                "nochunk": _long_block(rep_n, t4k_n)},
         },
     }
     print(json.dumps(result), flush=True)
@@ -711,6 +861,8 @@ def main():
         _main_chaos()
     if SERVING_BENCH:
         _main_serving()
+    if SERVING_V2_BENCH:
+        _main_serving_v2()
     if AUTOSCALE_BENCH:
         _main_autoscale()
     if ROOFLINE_BENCH:

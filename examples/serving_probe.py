@@ -11,9 +11,17 @@ TTFT/per-token latency histograms with populated buckets), and that the
 span layer attributed per-leg decode time to the row-parallel
 collectives (``serving_decode/layer*/{attn_wo,mlp_down}``).
 
+``--long-prompts`` switches to the kilotoken mixture (512/2048/4096
+weighted, :func:`horovod_tpu.serving.loadgen.long_prompt_spec`) with
+chunked flash prefill (``--prefill-chunk`` tokens per slice interleaved
+with decode steps), and additionally asserts the
+``serving_prefill_chunk`` span leg fired -- the workload the BENCH_r15
+TTFT-p99 gate measures.
+
 Run::
 
     python examples/serving_probe.py [--requests 16] [--rate 50]
+    python examples/serving_probe.py --long-prompts [--prefill-chunk 512]
     python examples/serving_probe.py --bench-json /tmp/BENCH_rXX.json
 """
 
@@ -54,6 +62,12 @@ def main():
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--cpu-devices", type=int, default=8,
                    help="virtual mesh size (tensor-parallel world)")
+    p.add_argument("--long-prompts", action="store_true",
+                   help="serve the 512/2048/4096 kilotoken mixture "
+                        "through chunked flash prefill")
+    p.add_argument("--prefill-chunk", type=int, default=512,
+                   help="chunk length for --long-prompts (0 = whole "
+                        "prompt at once)")
     p.add_argument("--bench-json", default=None,
                    help="also write a BENCH-style entry with the "
                         "serving block here")
@@ -70,7 +84,8 @@ def main():
     from jax.sharding import Mesh
     from horovod_tpu.core.state import global_state
     from horovod_tpu.models import LLAMA_SERVE, LlamaLM
-    from horovod_tpu.serving import LoadSpec, ServingEngine, generate
+    from horovod_tpu.serving import (LoadSpec, ServingEngine, generate,
+                                     long_prompt_spec)
     from horovod_tpu.timeline import spans
 
     hvd.init()
@@ -85,12 +100,23 @@ def main():
                                  jnp.zeros((1, 4), jnp.int32))
     mesh = Mesh(np.asarray(jax.devices(), dtype=object).reshape(world),
                 ("tp",))
-    engine = ServingEngine(cfg, params, mesh=mesh, slots=args.slots,
-                           page_size=8, max_len=64)
-
-    spec = LoadSpec(num_requests=args.requests, rate_rps=args.rate,
-                    prompt_lens=(4, 8, 16), output_lens=(4, 8),
-                    vocab_size=cfg.vocab_size, seed=11)
+    if args.long_prompts:
+        # Kilotoken mixture through chunked prefill: kilotoken
+        # admissions slice into --prefill-chunk forwards interleaved
+        # with decode steps, so the live batch keeps emitting (the
+        # TTFT-p99 gate's workload).
+        engine = ServingEngine(cfg, params, mesh=mesh, slots=args.slots,
+                               page_size=8, max_len=4608,
+                               prefill_chunk=args.prefill_chunk)
+        spec = long_prompt_spec(num_requests=args.requests,
+                                rate_rps=min(args.rate, 2.0),
+                                vocab_size=cfg.vocab_size, seed=11)
+    else:
+        engine = ServingEngine(cfg, params, mesh=mesh, slots=args.slots,
+                               page_size=8, max_len=64)
+        spec = LoadSpec(num_requests=args.requests, rate_rps=args.rate,
+                        prompt_lens=(4, 8, 16), output_lens=(4, 8),
+                        vocab_size=cfg.vocab_size, seed=11)
     requests = generate(spec)
     report = engine.serve(requests)
     print(f"served {report.completed}/{report.num_requests} requests: "
@@ -133,7 +159,11 @@ def main():
     # recorder accumulated for prefill/decode dispatch.
     rec = spans.recorder()
     summary = rec.step_boundary(rec.step, report.wall_s)
-    for leg in ("serving_prefill", "serving_decode"):
+    want_legs = ["serving_prefill", "serving_decode"]
+    if args.long_prompts and args.prefill_chunk:
+        # Kilotoken admissions must have gone through the chunked path.
+        want_legs.append("serving_prefill_chunk")
+    for leg in want_legs:
         got = summary["legs"].get(leg)
         assert got and got["count"] > 0 and got["secs"] > 0, (leg, summary)
     assert summary["legs"]["serving_decode"]["count"] == \

@@ -177,7 +177,7 @@ def _expected_exchange(params, meta: dict) -> ExpectedExchange:
     from ..optim import distributed as _dist
     from ..optim import zero as _zero
 
-    if meta.get("kind") == "serving_decode":
+    if meta.get("kind") in ("serving_decode", "serving_verify"):
         return _expected_serving_decode(meta)
     world = int(meta.get("world", 1))
     if world <= 1:
@@ -340,13 +340,17 @@ def _chunked_ops(rows: List[dict], comp, chunk_bytes: int,
 
 
 def _expected_serving_decode(meta: dict) -> ExpectedExchange:
-    """The serving TP decode step's activation contract.
+    """The serving TP decode / speculative verify activation contract.
 
     Two row-parallel closures per decoder layer (``wo`` after attention,
     ``w_down`` after the SwiGLU), each one ``collectives.ops.allreduce``
-    == one ``psum`` of the full residual activation -- ``slots * d_model``
-    elements at the compute dtype.  Size-1-axis psums are NOT elided at
-    trace time, so the same two-per-layer contract holds at tp=1.
+    == one ``psum`` of the full residual activation -- ``slots * width *
+    d_model`` elements at the compute dtype, where ``width`` is 1 for
+    plain decode and ``k + 1`` for the speculative verify step
+    (``kind=serving_verify``): the SAME two-psums-per-layer multiset,
+    just wider.  Size-1-axis psums are NOT elided at trace time, so the
+    contract holds at tp=1.  fp8 KV compression is wire-neutral here:
+    the dequant blend is local gather arithmetic, no new collectives.
 
     Per-slot LoRA banks are declined, not guessed: the adapter gather is
     an indexing pattern the pricing model does not cover, and a wrong
@@ -362,8 +366,11 @@ def _expected_serving_decode(meta: dict) -> ExpectedExchange:
             (f"serving decode meta missing {'/'.join(missing)}: "
              "cannot derive activation widths",))
     layers = int(meta["num_layers"])
-    elements = int(meta["slots"]) * int(meta["d_model"])
+    width = int(meta.get("width", 1))
+    elements = int(meta["slots"]) * width * int(meta["d_model"])
     dtype = str(jnp.dtype(meta.get("dtype", "float32")))
+    kind_tag = ("serving-tp-verify" if meta.get("kind") == "serving_verify"
+                else "serving-tp-decode")
     ops: List[ExpectedOp] = []
     for li in range(layers):
         ops.append(ExpectedOp("psum", dtype, elements,
@@ -372,9 +379,9 @@ def _expected_serving_decode(meta: dict) -> ExpectedExchange:
                               f"layer{li}/mlp_down/allreduce"))
     rows = [{"bucket": 0, "dtype": dtype, "leaves": 2 * layers,
              "elements": 2 * layers * elements,
-             "kind": "serving-tp-decode"}]
+             "kind": kind_tag}]
     notes = [f"serving decode: 2 row-parallel allreduces/layer x {layers} "
-             f"layer(s), {elements} elements each"]
+             f"layer(s), {elements} elements each (width {width})"]
     # A rebuilt step after an elastic resize carries provenance; the
     # contract is mesh-size invariant (the psum payload is the full
     # residual activation regardless of how many ranks reduce it), so

@@ -260,7 +260,10 @@ HIER_CONFIGS = ("hier", "hier_zero1", "hier_powersgd_ef")
 # leaves behind, so the exchange contract (2 row-parallel psums per
 # layer of slots*d_model at the activation dtype) is gated across
 # resizes, not only at the size serving happened to start at.
-SERVING_CONFIGS = ("serving_decode", "serving_decode_resized")
+# ``serving_verify`` gates the speculative-decoding verify step: the
+# same multiset widened by k+1 (slots*width*d_model per psum).
+SERVING_CONFIGS = ("serving_decode", "serving_decode_resized",
+                   "serving_verify")
 
 # Threshold chosen so the tiny parameter tree below splits into TWO f32
 # buckets (256 + 192 elements), exercising multi-bucket matching.
@@ -364,15 +367,18 @@ def _build_serving_config(config: str):
     pool allows; ``serving_decode_resized`` on the next size down --
     the mesh the control plane's shrink path lands on -- with
     ``resized_from`` provenance in the step meta so the expected model
-    notes the transition.  No donation: the decode step's pool aliasing
-    is the engine's business, not the trainer's.
+    notes the transition.  ``serving_verify`` is the width-5 (k=4)
+    speculative verify step on the full tp size: the audit must match
+    the widened multiset exactly, no new declines.  No donation: the
+    decode step's pool aliasing is the engine's business, not the
+    trainer's.
     """
     import numpy as np
     from jax.sharding import Mesh
 
     from ..models.transformer import LLAMA_SERVE, LlamaLM
     from ..serving import (CacheConfig, PagedKVCache, build_decode_step,
-                           cache_sharding)
+                           build_verify_step, cache_sharding)
     from ..serving.policy import valid_tp_sizes
 
     cfg = LLAMA_SERVE
@@ -390,13 +396,20 @@ def _build_serving_config(config: str):
     model = LlamaLM(cfg, dtype=jnp.float32)
     params = jax.jit(model.init)(jax.random.PRNGKey(0),
                                  jnp.zeros((1, 4), jnp.int32))
-    step = build_decode_step(cfg, mesh, slots=ccfg.slots,
-                             page_size=ccfg.page_size,
-                             pages_per_slot=ccfg.pages_per_slot)
+    if config == "serving_verify":
+        width = 5
+        step = build_verify_step(cfg, mesh, slots=ccfg.slots, width=width,
+                                 page_size=ccfg.page_size,
+                                 pages_per_slot=ccfg.pages_per_slot)
+        tokens = jnp.zeros((ccfg.slots, width), jnp.int32)
+    else:
+        step = build_decode_step(cfg, mesh, slots=ccfg.slots,
+                                 page_size=ccfg.page_size,
+                                 pages_per_slot=ccfg.pages_per_slot)
+        tokens = jnp.zeros((ccfg.slots,), jnp.int32)
     if resized_from is not None:
         step._meta["resized_from"] = resized_from
-    args = (params, cache.k, cache.v,
-            jnp.zeros((ccfg.slots,), jnp.int32), cache.lengths_device(),
+    args = (params, cache.k, cache.v, tokens, cache.lengths_device(),
             cache.table_device(), jnp.zeros((ccfg.slots,), bool))
     return step, args, None, f"step:{config}"
 

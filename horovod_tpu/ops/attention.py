@@ -202,6 +202,40 @@ def decode_attention(q, k, v, *, lengths, scale: Optional[float] = None,
                                segment_ids=q_seg, kv_segment_ids=kv_seg)
 
 
+def verify_attention(q, k, v, *, lengths, scale: Optional[float] = None,
+                     block_kv: int = DEFAULT_BLOCK_KV,
+                     force_reference: bool = False):
+    """Width-k verify attention: the speculative-decoding generalisation
+    of :func:`decode_attention` to ``w`` draft positions per slot.
+
+    ``q``: ``(b, h, w, d)`` -- query row ``i`` is the token being
+    verified at absolute position ``lengths - 1 + i`` (row 0 is exactly
+    the plain decode query).  ``k``/``v``: ``(b, h_kv, s, d)`` cache
+    views that ALREADY hold the w in-step-written keys.  ``lengths``:
+    ``(b,)`` live key count as seen by row 0 (pre-step length + 1);
+    row ``i`` sees ``lengths + i`` keys -- the length mask doubles as
+    the bottom-right-aligned causal mask across the draft window, the
+    same argument that makes single-token decode mask-free.
+
+    Implementation: one :func:`decode_attention` call per row, so every
+    row's softmax runs the EXACT op shapes of the plain decode step --
+    the greedy-exactness contract (speculative streams bitwise equal to
+    plain decode) rides on row-for-row numerical identity, not on a
+    reimplementation agreeing to tolerance.  ``w`` is the speculation
+    width (small), so the unrolled loop costs w kernel calls inside one
+    jitted step, not w dispatches.
+    """
+    w = q.shape[2]
+    outs = []
+    for i in range(w):
+        li = jnp.where(lengths > 0,
+                       jnp.minimum(lengths + i, k.shape[2]), 0)
+        outs.append(decode_attention(
+            q[:, :, i:i + 1, :], k, v, lengths=li, scale=scale,
+            block_kv=block_kv, force_reference=force_reference))
+    return jnp.concatenate(outs, axis=2)
+
+
 # ---------------------------------------------------------------------------
 # Flash-decoding: split-KV kernel for the single-token cache read.
 # ---------------------------------------------------------------------------

@@ -13,14 +13,15 @@ the training loop uses.
 
 from .controlplane import (ControlPlaneReport,  # noqa: F401
                            ServingControlPlane)
-from .decode import (build_decode_step, decode_param_specs,  # noqa: F401
-                     greedy_sample, prefill_forward, stack_adapters,
-                     ServingDecodeStep)
+from .decode import (build_decode_step, build_verify_step,  # noqa: F401
+                     decode_param_specs, greedy_sample, prefill_forward,
+                     stack_adapters, ServingDecodeStep)
 from .engine import (RequestPrefetcher, ServingEngine,  # noqa: F401
                      ServingReport)
 from .kvcache import (CacheConfig, PagedKVCache,  # noqa: F401
                       cache_sharding)
-from .loadgen import LoadSpec, generate  # noqa: F401
+from .loadgen import LoadSpec, generate, long_prompt_spec  # noqa: F401
 from .policy import (Decision, PolicyConfig, ScalePolicy,  # noqa: F401
                      SLOSample, valid_tp_sizes)
 from .scheduler import ContinuousBatchScheduler, Request  # noqa: F401
+from .spec import ModelDrafter, NgramDrafter  # noqa: F401

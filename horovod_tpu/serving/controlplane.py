@@ -50,7 +50,6 @@ import numpy as np
 from ..timeline import metrics as _metrics
 from ..timeline import spans as _spans
 from ..timeline.straggler import StragglerMonitor
-from .decode import greedy_sample
 from .engine import ServingEngine, ServingReport, _pct
 from .policy import (Decision, PolicyConfig, ScalePolicy, SLOSample,
                      valid_tp_sizes)
@@ -256,37 +255,12 @@ class ServingControlPlane:
 
     # -- decode step (shared by the main loop and the drain) ---------------
     def _decode_once(self, now) -> float:
-        eng = self.engine
-        sched = eng.scheduler
-        cache = eng.cache
-        st = self._stats
-        for slot in sched.active:
-            cache.reserve(slot, int(cache.lengths[slot]) + 1)
-        active = np.zeros((eng.slots,), bool)
-        for slot in sched.active:
-            active[slot] = True
-        args = [eng.params, cache.k, cache.v,
-                jnp.asarray(np.array(st["last_tokens"])),
-                cache.lengths_device(), cache.table_device(),
-                jnp.asarray(active)]
-        if eng.adapters is not None:
-            args += [eng.adapters,
-                     jnp.asarray(np.array(st["adapter_ids"]))]
-        t0 = time.monotonic()
-        logits, cache.k, cache.v = eng.step(*args)
-        sampled = np.asarray(greedy_sample(logits))  # sync point
-        step_s = time.monotonic() - t0
-        st["decode_steps"] += 1
-        st["occ_samples"].append(sched.occupancy)
-        for slot, req in list(sched.active.items()):
-            tok = int(sampled[slot])
-            req.tokens.append(tok)
-            cache.lengths[slot] += 1
-            st["last_tokens"][slot] = tok
-            sched.note_decode_token(req, step_s)
-            if req.finished or int(cache.lengths[slot]) >= eng.max_len:
-                st["completed"].append(sched.release(slot, now()))
-        return step_s
+        # Delegates to the engine's shared round so occupancy/TTFT
+        # bookkeeping stays truthful whatever the engine's decode mode
+        # is (plain or speculative).  The DRAIN path always runs plain
+        # decode: a draining mesh is about to lose ranks and the verify
+        # step's wider dispatch buys nothing on the way down.
+        return self.engine.decode_once(self._stats, now)
 
     # -- controller tick ---------------------------------------------------
     def _sample(self, now_s: float) -> SLOSample:

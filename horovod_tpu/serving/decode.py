@@ -42,7 +42,8 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..models.transformer import LlamaConfig, rotary_embedding
-from ..ops.attention import decode_attention, flash_attention
+from ..ops.attention import (decode_attention, flash_attention,
+                             verify_attention)
 from ..parallel.tp import row_parallel
 from ..timeline import spans as _spans
 
@@ -105,8 +106,8 @@ def _node_lora(node, adapters_node, select):
 
 def prefill_forward(params, config: LlamaConfig, tokens, positions=None,
                     *, segment_ids=None, dtype=jnp.float32,
-                    adapters=None, adapter_id=None, lora_alpha=16.0
-                    ) -> Tuple[Any, Any, Any]:
+                    adapters=None, adapter_id=None, lora_alpha=16.0,
+                    past=None) -> Tuple[Any, Any, Any]:
     """Forward a prompt batch, returning ``(logits, k_layers, v_layers)``.
 
     ``tokens``: ``[b, t]`` int32.  ``k_layers``/``v_layers``:
@@ -117,12 +118,30 @@ def prefill_forward(params, config: LlamaConfig, tokens, positions=None,
 
     ``adapters``/``adapter_id``: banked LoRA tree + the ONE adapter this
     prompt uses (prefill admits one request at a time).
+
+    ``past``: chunked prefill continuation -- a ``(k_layers, v_layers)``
+    pair from the previous chunks (``[num_layers, b, t_past, kv_heads,
+    head_dim]`` each).  ``tokens`` is then the CURRENT chunk only; its
+    queries attend over ``past ++ chunk`` keys with the bottom-right
+    aligned causal mask (exactly the KV-cache convention
+    :func:`~horovod_tpu.ops.attention.flash_attention` implements for
+    ``tq < tk``), and the returned K/V cover the FULL context so the
+    caller chains chunks by simple replacement.  ``positions`` must be
+    the chunk's absolute offsets (``t_past .. t_past + t``); the chunk
+    logits equal the same rows of a whole-prompt forward to float
+    tolerance (the chunked-prefill parity contract).
     """
     cfg = config
     p = params["params"] if "params" in params else params
     b, t = tokens.shape
+    t_past = 0 if past is None else int(past[0].shape[2])
     if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+        positions = jnp.broadcast_to(jnp.arange(t_past, t_past + t),
+                                     (b, t))
+    if past is not None and segment_ids is not None:
+        raise NotImplementedError(
+            "chunked prefill with segment_ids: pad isolation across "
+            "the past/chunk seam is not modeled; chunk unpadded prompts")
     emb = p["tok_embed"]
     x = emb[tokens].astype(dtype)
 
@@ -157,13 +176,29 @@ def prefill_forward(params, config: LlamaConfig, tokens, positions=None,
             0, 2, 1, 3)
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
-        o = flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
+        if past is not None:
+            # Chunk continuation: this chunk's queries see every past
+            # key; the bottom-right aligned causal mask handles the
+            # within-chunk triangle.  past k/v arrive in cache layout
+            # [b, t_past, H, D] -- move time back to the attention axis.
+            k_full = jnp.concatenate(
+                [past[0][li].transpose(0, 2, 1, 3).astype(k.dtype), k],
+                axis=2)
+            v_full = jnp.concatenate(
+                [past[1][li].transpose(0, 2, 1, 3).astype(v.dtype), v],
+                axis=2)
+        else:
+            k_full, v_full = k, v
+        o = flash_attention(q, k_full, v_full, causal=True,
+                            segment_ids=segment_ids)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, -1)
         x = x + _dense(o, attn["wo"], dtype, lora_select=lora("attn", "wo"),
                        lora_alpha=lora_alpha)
-        # Cache layout: [b, t, kv_heads, head_dim], post-RoPE.
-        ks.append(k.transpose(0, 2, 1, 3))
-        vs.append(v.transpose(0, 2, 1, 3))
+        # Cache layout: [b, t, kv_heads, head_dim], post-RoPE -- the
+        # FULL context (past ++ chunk) so chunk callers chain by
+        # replacement.
+        ks.append(k_full.transpose(0, 2, 1, 3))
+        vs.append(v_full.transpose(0, 2, 1, 3))
 
         h = _rmsnorm(x, blk["mlp_norm"]["scale"], dtype)
         mlp = blk["mlp"]
@@ -211,19 +246,21 @@ class ServingDecodeStep:
     Carries the builder ``_meta`` the static auditor dispatches on (the
     ``_InstrumentedStep`` convention: ``analysis.meta_from_step`` reads
     ``_meta``, ``audit_step`` unwraps ``_fn``) and times each dispatch
-    into the span recorder under the ``serving_decode`` leg.
+    into the span recorder under its leg (``serving_decode`` for the
+    one-token step, ``serving_verify`` for the speculative verify step).
     """
 
-    def __init__(self, fn, meta: dict):
+    def __init__(self, fn, meta: dict, leg: str = "serving_decode"):
         self._fn = fn
         self._meta = meta
+        self._leg = leg
 
     def __getattr__(self, name):
         return getattr(self._fn, name)
 
     def __call__(self, *args):
         rec = _spans.recorder()
-        with rec.span("dispatch", name="serving", leg="serving_decode"):
+        with rec.span("dispatch", name="serving", leg=self._leg):
             return self._fn(*args)
 
 
@@ -231,13 +268,16 @@ def build_decode_step(config: LlamaConfig, mesh, *,
                       slots: int, page_size: int, pages_per_slot: int,
                       dtype=jnp.float32, with_lora: bool = False,
                       lora_alpha: float = 16.0,
-                      tp_axis: str = TP_AXIS) -> ServingDecodeStep:
-    """Compile the batched one-token decode step over ``mesh``.
+                      tp_axis: str = TP_AXIS, width: int = 1,
+                      compress: bool = False) -> ServingDecodeStep:
+    """Compile the batched decode (or width-k verify) step over ``mesh``.
 
-    Signature of the returned step::
+    Signature of the returned step (``width == 1``)::
 
         logits, k_pool, v_pool = step(params, k_pool, v_pool, tokens,
                                       positions, page_table, active
+                                      [, kq, vq, kscale, vscale,
+                                         ctable, cmask]
                                       [, adapters, adapter_ids])
 
     ``tokens``/``positions``/``active``: ``[slots]`` (current token, its
@@ -247,6 +287,23 @@ def build_decode_step(config: LlamaConfig, mesh, *,
     length-masked slot view, and returns replicated next-token logits.
     Idle slots produce zero attention output (dead-row convention) and
     their logits are discarded by the engine.
+
+    ``width > 1`` is the speculative-decoding VERIFY step (built through
+    :func:`build_verify_step`): ``tokens`` widens to ``[slots, width]``
+    (the last sampled token followed by ``width - 1`` drafts), every
+    column's K/V is scattered to its own (page, offset) in-step, and
+    attention runs :func:`~horovod_tpu.ops.attention.verify_attention`
+    -- the same paged gather, with the length mask extended one key per
+    draft column.  Logits come back ``[slots, width, vocab]``, target
+    argmaxes for ALL width positions from ONE dispatch.  Columns past a
+    slot's accepted prefix leave garbage K/V above the rolled-back
+    length -- unreachable by the masking contract, exactly like a
+    recycled page.
+
+    ``compress=True`` (the fp8 KV-cache path) appends the six e4m3 pool
+    operands from :meth:`PagedKVCache.compress_operands`; gathers blend
+    dequantised cold pages in wherever ``cmask`` is set.  Purely local
+    indexing/dequant -- the collective contract is unchanged.
     """
     cfg = config
     tp = int(np.prod([mesh.shape[a] for a in mesh.axis_names
@@ -262,28 +319,64 @@ def build_decode_step(config: LlamaConfig, mesh, *,
         raise NotImplementedError(
             "per-slot LoRA banks are tp=1 only (a row-parallel adapter "
             "would need its own psum fold); shard requests, not adapters")
+    if width < 1:
+        raise ValueError(f"width must be >= 1, got {width}")
+    if with_lora and width > 1:
+        raise NotImplementedError(
+            "speculative verify with per-slot LoRA banks is not wired; "
+            "serve adapters with plain decode")
     heads_l = cfg.num_heads // tp
     kvh_l = cfg.num_kv_heads // tp
     hd = cfg.head_dim
-    nbytes_leg = slots * cfg.d_model * jnp.dtype(dtype).itemsize
+    kind = "serving_decode" if width == 1 else "serving_verify"
+    nbytes_leg = slots * width * cfg.d_model * jnp.dtype(dtype).itemsize
+    max_len = pages_per_slot * page_size
 
     def spmd(params, k_pool, v_pool, tokens, positions, page_table,
-             active, adapters=None, adapter_ids=None):
+             active, *extra):
+        if compress:
+            kq_pool, vq_pool, kscale, vscale, ctable, cmask = extra[:6]
+            extra = extra[6:]
+        adapters, adapter_ids = extra if extra else (None, None)
         p = params["params"] if "params" in params else params
         ad = (adapters["params"] if adapters is not None and
               "params" in adapters else adapters)
         s = tokens.shape[0]
         emb = p["tok_embed"]
-        x = emb[tokens].astype(dtype)[:, None, :]          # [S, 1, d]
-        pos2 = positions[:, None]                          # [S, 1]
-        # The step writes EVERY slot's K/V (fixed batch shape); idle
-        # slots are redirected to the pool's trailing scratch page so
-        # they never clobber a live page.
         scratch = slots * pages_per_slot
-        page = jnp.where(active,
-                         page_table[jnp.arange(s), positions // page_size],
-                         scratch)
-        off = positions % page_size
+        if width == 1:
+            x = emb[tokens].astype(dtype)[:, None, :]      # [S, 1, d]
+            pos2 = positions[:, None]                      # [S, 1]
+            # The step writes EVERY slot's K/V (fixed batch shape); idle
+            # slots are redirected to the pool's trailing scratch page
+            # so they never clobber a live page.
+            page = jnp.where(
+                active,
+                page_table[jnp.arange(s), positions // page_size],
+                scratch)
+            off = positions % page_size
+        else:
+            x = emb[tokens].astype(dtype)                  # [S, W, d]
+            pos2 = positions[:, None] + jnp.arange(width)[None, :]
+            # Columns may run past max_len on a nearly-full slot (the
+            # host caps emission); redirect those writes to scratch too.
+            writable = active[:, None] & (pos2 < max_len)
+            idx = jnp.clip(pos2 // page_size, 0, pages_per_slot - 1)
+            page = jnp.where(
+                writable,
+                jnp.take_along_axis(page_table, idx, axis=1), scratch)
+            off = pos2 % page_size
+
+        def gather_view(li, pool, qpool=None, scale=None):
+            view = pool[li][page_table]     # [S, pps, page, kvh_l, hd]
+            if compress:
+                deq = (qpool[li][ctable].astype(jnp.float32)
+                       * scale[li][ctable][..., None, None]
+                       ).astype(view.dtype)
+                view = jnp.where(cmask[..., None, None, None], deq, view)
+            return view.reshape(
+                s, pages_per_slot * page_size, kvh_l, hd
+            ).transpose(0, 2, 1, 3)
 
         def select(a, b):
             return a[adapter_ids], b[adapter_ids]
@@ -309,35 +402,46 @@ def build_decode_step(config: LlamaConfig, mesh, *,
             v = _dense(h, attn["wv"], dtype,
                        lora_select=lora("attn", "wv"),
                        lora_alpha=lora_alpha)
-            q = q.reshape(s, 1, heads_l, hd).transpose(0, 2, 1, 3)
-            k = k.reshape(s, 1, kvh_l, hd).transpose(0, 2, 1, 3)
-            v = v.reshape(s, 1, kvh_l, hd).transpose(0, 2, 1, 3)
+            q = q.reshape(s, width, heads_l, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(s, width, kvh_l, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(s, width, kvh_l, hd).transpose(0, 2, 1, 3)
             q = rotary_embedding(q, pos2, cfg.rope_theta)
             k = rotary_embedding(k, pos2, cfg.rope_theta)
 
-            # In-step cache write: the new token's K/V lands at
+            # In-step cache write: each column's K/V lands at its
             # (page, offset) -- one scatter per pool per layer.
             pool_dt = k_pool.dtype
-            k_pool = k_pool.at[li, page, off].set(
-                k[:, :, 0, :].astype(pool_dt))
-            v_pool = v_pool.at[li, page, off].set(
-                v[:, :, 0, :].astype(pool_dt))
+            if width == 1:
+                k_pool = k_pool.at[li, page, off].set(
+                    k[:, :, 0, :].astype(pool_dt))
+                v_pool = v_pool.at[li, page, off].set(
+                    v[:, :, 0, :].astype(pool_dt))
+            else:
+                k_pool = k_pool.at[li, page, off].set(
+                    k.transpose(0, 2, 1, 3).astype(pool_dt))
+                v_pool = v_pool.at[li, page, off].set(
+                    v.transpose(0, 2, 1, 3).astype(pool_dt))
 
-            # Slot view: gather this slot's pages -> [S, kvh, max_len, d].
-            ks = k_pool[li][page_table].reshape(
-                s, pages_per_slot * page_size, kvh_l, hd
-            ).transpose(0, 2, 1, 3)
-            vs = v_pool[li][page_table].reshape(
-                s, pages_per_slot * page_size, kvh_l, hd
-            ).transpose(0, 2, 1, 3)
+            # Slot view: gather this slot's pages -> [S, kvh, max_len, d]
+            # (cold pages dequantised from the e4m3 pool when present).
+            if compress:
+                ks = gather_view(li, k_pool, kq_pool, kscale)
+                vs = gather_view(li, v_pool, vq_pool, vscale)
+            else:
+                ks = gather_view(li, k_pool)
+                vs = gather_view(li, v_pool)
             lengths = jnp.where(active, positions + 1, 0)
-            o = decode_attention(q.astype(dtype), ks.astype(dtype),
-                                 vs.astype(dtype), lengths=lengths)
-            o = o.transpose(0, 2, 1, 3).reshape(s, 1, heads_l * hd)
+            if width == 1:
+                o = decode_attention(q.astype(dtype), ks.astype(dtype),
+                                     vs.astype(dtype), lengths=lengths)
+            else:
+                o = verify_attention(q.astype(dtype), ks.astype(dtype),
+                                     vs.astype(dtype), lengths=lengths)
+            o = o.transpose(0, 2, 1, 3).reshape(s, width, heads_l * hd)
 
             # Row-parallel closures: the activation allreduce routes
             # through collectives.ops (planner/auditor/span visible).
-            _spans.note_leg(f"serving_decode/layer{li}/attn_wo",
+            _spans.note_leg(f"{kind}/layer{li}/attn_wo",
                             nbytes=nbytes_leg)
             y = row_parallel(o.astype(dtype),
                              attn["wo"]["kernel"].astype(dtype),
@@ -356,7 +460,7 @@ def build_decode_step(config: LlamaConfig, mesh, *,
                         lora_select=lora("mlp", "w_up"),
                         lora_alpha=lora_alpha)
             act = (jax.nn.silu(gate) * up).astype(dtype)
-            _spans.note_leg(f"serving_decode/layer{li}/mlp_down",
+            _spans.note_leg(f"{kind}/layer{li}/mlp_down",
                             nbytes=nbytes_leg)
             y = row_parallel(act, mlp["w_down"]["kernel"].astype(dtype),
                              axis=tp_axis)
@@ -366,14 +470,21 @@ def build_decode_step(config: LlamaConfig, mesh, *,
             x = x + y
 
         x = _rmsnorm(x, p["final_norm"]["scale"], dtype)
-        logits = (x.astype(jnp.float32)
-                  @ emb.astype(jnp.float32).T)[:, 0, :]   # [S, vocab]
+        logits = x.astype(jnp.float32) @ emb.astype(jnp.float32).T
+        if width == 1:
+            logits = logits[:, 0, :]                       # [S, vocab]
         return logits, k_pool, v_pool
+
+    n_base = 7 + (6 if compress else 0)
 
     def _build(params_example, adapters_example=None):
         pool_spec = P(None, None, None, tp_axis, None)
         in_specs = [decode_param_specs(params_example, tp_axis),
                     pool_spec, pool_spec, P(), P(), P(), P()]
+        if compress:
+            # e4m3 pools shard like the f32 pools; scales/table/mask
+            # are replicated host metadata.
+            in_specs += [pool_spec, pool_spec, P(), P(), P(), P()]
         if adapters_example is not None:
             in_specs += [jax.tree.map(lambda _: P(), adapters_example),
                          P()]
@@ -389,14 +500,46 @@ def build_decode_step(config: LlamaConfig, mesh, *,
     def step(*args):
         key = len(args)
         if key not in state:
-            state[key] = _build(args[0], args[7] if len(args) > 7 else None)
+            state[key] = _build(
+                args[0],
+                args[n_base] if len(args) > n_base else None)
         return state[key](*args)
 
-    meta = {"kind": "serving_decode", "world": tp, "tp": tp,
+    meta = {"kind": kind, "world": tp, "tp": tp,
             "num_layers": cfg.num_layers, "d_model": cfg.d_model,
             "slots": int(slots), "dtype": str(jnp.dtype(dtype)),
-            "lora": bool(with_lora)}
-    return ServingDecodeStep(step, meta)
+            "lora": bool(with_lora), "compress": bool(compress)}
+    if width > 1:
+        meta["width"] = int(width)
+    return ServingDecodeStep(step, meta, leg=kind)
+
+
+def build_verify_step(config: LlamaConfig, mesh, *,
+                      slots: int, width: int, page_size: int,
+                      pages_per_slot: int, dtype=jnp.float32,
+                      tp_axis: str = TP_AXIS,
+                      compress: bool = False) -> ServingDecodeStep:
+    """Compile the speculative-decoding verify step: one fixed-shape
+    dispatch scoring ``width`` tokens per slot (the last sampled token
+    plus ``width - 1`` drafter proposals).
+
+    A width-k generalisation of :func:`build_decode_step` -- same paged
+    scatter, same length-masked attention (one extra visible key per
+    draft column), same two row-parallel psums per layer, just ``width``
+    times as wide (``slots * width * d_model`` elements; the widened
+    contract the static auditor prices under ``kind=serving_verify``).
+    The engine accepts each slot's longest draft prefix agreeing with
+    the returned argmaxes, plus the target's own token at the first
+    disagreement -- greedy-exact by construction.
+    """
+    if width < 2:
+        raise ValueError(
+            f"verify step needs width >= 2 (got {width}); width 1 is "
+            "plain decode -- use build_decode_step")
+    return build_decode_step(
+        config, mesh, slots=slots, page_size=page_size,
+        pages_per_slot=pages_per_slot, dtype=dtype, tp_axis=tp_axis,
+        width=width, compress=compress)
 
 
 def _dense_lora_only(x, lora_select, dtype, lora_alpha):

@@ -14,6 +14,11 @@ Knobs (all overridable per-constructor-arg, documented in docs/api.md):
 * ``HOROVOD_SERVING_PAGE_SIZE`` -- KV page length in tokens (default 16)
 * ``HOROVOD_SERVING_MAX_LEN`` -- per-sequence cap (default: model max)
 * ``HOROVOD_SERVING_PREFETCH`` -- request prefetch depth (default 2)
+* ``HOROVOD_SPEC_DECODE`` -- speculative decoding on/off (default off)
+* ``HOROVOD_SPEC_K`` -- draft tokens per speculative round (default 4)
+* ``HOROVOD_PREFILL_CHUNK`` -- chunked-prefill chunk length in tokens
+  (default 0 = whole-prompt prefill)
+* ``HOROVOD_KV_COMPRESS`` -- fp8 cold-page KV compression (default off)
 
 The engine keeps two clocks: a VIRTUAL clock that fast-forwards through
 idle gaps in the open-loop arrival schedule (TTFT and queueing are
@@ -34,11 +39,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.config import _env_int
+from ..core.config import _env_bool, _env_int
 from ..timeline import spans as _spans
-from .decode import build_decode_step, greedy_sample, prefill_forward
+from .decode import (build_decode_step, build_verify_step, greedy_sample,
+                     prefill_forward)
 from .kvcache import CacheConfig, PagedKVCache, cache_sharding
 from .scheduler import ContinuousBatchScheduler, Request
+from .spec import NgramDrafter
 
 
 class _Stop:
@@ -119,6 +126,11 @@ class ServingReport:
     token_latency_p50_s: float
     token_latency_p99_s: float
     mean_occupancy: float
+    # Speculative decoding (zero when HOROVOD_SPEC_DECODE is off).
+    spec_rounds: int = 0
+    proposed_tokens: int = 0
+    accepted_tokens: int = 0
+    acceptance_rate: float = 0.0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -136,7 +148,10 @@ class ServingEngine:
     def __init__(self, config, params, *, mesh=None, slots: int = 0,
                  page_size: int = 0, max_len: int = 0, dtype=jnp.float32,
                  adapters=None, adapter_ids=None, lora_alpha: float = 16.0,
-                 prefetch_depth: int = 0):
+                 prefetch_depth: int = 0,
+                 spec_decode: Optional[bool] = None, spec_k: int = 0,
+                 drafter=None, prefill_chunk: int = -1,
+                 kv_compress: Optional[bool] = None):
         self.config = config
         self.params = params
         if mesh is None:
@@ -149,6 +164,22 @@ class ServingEngine:
                                            config.max_seq_len)
         self.prefetch_depth = prefetch_depth or _env_int(
             "SERVING_PREFETCH", 2)
+        self.spec_decode = (_env_bool("SPEC_DECODE")
+                            if spec_decode is None else bool(spec_decode))
+        self.spec_k = spec_k or _env_int("SPEC_K", 4)
+        self.prefill_chunk = (_env_int("PREFILL_CHUNK", 0)
+                              if prefill_chunk < 0 else prefill_chunk)
+        self.kv_compress = (_env_bool("KV_COMPRESS")
+                            if kv_compress is None else bool(kv_compress))
+        if self.spec_decode and self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+        if adapters is not None and self.spec_decode:
+            raise NotImplementedError(
+                "speculative decoding with LoRA banks is not wired; "
+                "run adapters through plain decode")
+        if adapters is not None and self.kv_compress:
+            raise NotImplementedError(
+                "fp8 KV compression with LoRA banks is not wired")
         self.dtype = dtype
         self.adapters = adapters
         self.lora_alpha = lora_alpha
@@ -156,21 +187,47 @@ class ServingEngine:
             num_layers=config.num_layers,
             num_kv_heads=config.num_kv_heads, head_dim=config.head_dim,
             slots=self.slots, page_size=self.page_size,
-            max_len=self.max_len, dtype=str(jnp.dtype(dtype)))
+            max_len=self.max_len, dtype=str(jnp.dtype(dtype)),
+            compress=self.kv_compress)
         self.cache = PagedKVCache(self.cache_config,
                                   cache_sharding(mesh))
-        self.scheduler = ContinuousBatchScheduler(self.slots, self.cache)
+        # Admission must price the widest step a slot can take: k drafts
+        # + the target's bonus token under speculation, else 1.
+        budget = self.spec_k + 1 if self.spec_decode else 1
+        self.scheduler = ContinuousBatchScheduler(
+            self.slots, self.cache, token_budget=budget)
         self.step = build_decode_step(
             config, mesh, slots=self.slots, page_size=self.page_size,
             pages_per_slot=self.cache_config.pages_per_slot, dtype=dtype,
-            with_lora=adapters is not None, lora_alpha=lora_alpha)
+            with_lora=adapters is not None, lora_alpha=lora_alpha,
+            compress=self.kv_compress)
+        self.verify_step = None
+        if self.spec_decode:
+            self.verify_step = build_verify_step(
+                config, mesh, slots=self.slots, width=self.spec_k + 1,
+                page_size=self.page_size,
+                pages_per_slot=self.cache_config.pages_per_slot,
+                dtype=dtype, compress=self.kv_compress)
+            self.drafter = drafter if drafter is not None \
+                else NgramDrafter()
+        else:
+            self.drafter = None
 
         def _prefill(p, toks, ad, aid):
             return prefill_forward(p, config, toks, dtype=dtype,
                                    adapters=ad, adapter_id=aid,
                                    lora_alpha=lora_alpha)
 
+        def _prefill_chunk(p, toks, past):
+            return prefill_forward(p, config, toks, dtype=dtype,
+                                   past=past)
+
         self._prefill = jax.jit(_prefill)
+        self._prefill_chunked = jax.jit(_prefill_chunk)
+        # In-progress chunked prefills: slot -> dict(req, prompt, pos,
+        # past).  Slots in here are state "prefill" and excluded from
+        # the decode batch until their last chunk lands.
+        self._chunking: Dict[int, Dict[str, Any]] = {}
 
     # -- one-request helpers ----------------------------------------------
     def _do_prefill(self, slot: int, req: Request, prompt_dev) -> int:
@@ -183,6 +240,166 @@ class ServingEngine:
             self.cache.write_prefill(slot, kl[:, 0], vl[:, 0])
             first = int(greedy_sample(logits[:, -1, :])[0])
         return first
+
+    def _advance_chunks(self, st: Dict[str, Any], now) -> None:
+        """Push each in-progress chunked prefill forward by ONE chunk.
+
+        One chunk per slot per serve-loop iteration: a kilotoken
+        admission is sliced into ``prefill_chunk``-token forwards
+        interleaved with decode steps, so the live decode batch keeps
+        emitting while the long prompt fills in (the TTFT-p99 gate).
+        The final chunk's full-context K/V is scattered once -- chunked
+        and whole-prompt prefill land the identical cache state.
+        """
+        for slot in list(self._chunking):
+            c = self._chunking[slot]
+            req: Request = c["req"]
+            chunk = c["dev"][c["pos"]:c["pos"] + self.prefill_chunk]
+            with _spans.recorder().span("dispatch", name="prefill_chunk",
+                                        leg="serving_prefill_chunk"):
+                logits, kl, vl = self._prefill_chunked(
+                    self.params, chunk[None], c["past"])
+            c["past"] = (kl, vl)
+            c["pos"] += int(chunk.shape[0])
+            if c["pos"] < req.prompt_len:
+                continue
+            del self._chunking[slot]
+            self.cache.write_prefill(slot, kl[:, 0], vl[:, 0])
+            first = int(greedy_sample(logits[:, -1, :])[0])
+            self._join_decode(st, slot, req, first, now)
+
+    def _join_decode(self, st: Dict[str, Any], slot: int, req: Request,
+                     first: int, now) -> None:
+        """Prefill done (whole or final chunk): first token is sampled,
+        the request enters the decode batch."""
+        sched = self.scheduler
+        req.tokens.append(first)
+        sched.note_prefill(req, now())
+        st["last_tokens"][slot] = first
+        st["adapter_ids"][slot] = req.adapter_id
+        if self.drafter is not None:
+            self.drafter.on_admit(slot, req)
+        if req.finished:
+            self._release(st, slot, now)
+
+    def _release(self, st: Dict[str, Any], slot: int, now) -> None:
+        if self.drafter is not None:
+            self.drafter.on_release(slot)
+        st["completed"].append(self.scheduler.release(slot, now()))
+
+    def _decode_slots(self) -> List[int]:
+        """Slots actually in the decode batch: live requests minus
+        still-chunking prefills (those have no resident context yet)."""
+        return [s for s, r in self.scheduler.active.items()
+                if r.state != "prefill"]
+
+    # -- one decode round (shared with serving.controlplane) ---------------
+    def decode_once(self, st: Dict[str, Any], now) -> float:
+        """One plain continuous-batching decode step over live slots.
+
+        ``st`` is the mutable per-run state dict (``last_tokens``,
+        ``adapter_ids``, ``completed``, ``occ_samples``,
+        ``decode_steps``); the control plane's drain loop drives this
+        same method so its gauges stay truthful.
+        """
+        sched = self.scheduler
+        cache = self.cache
+        slots = self._decode_slots()
+        for slot in slots:
+            cache.reserve(slot, int(cache.lengths[slot]) + 1)
+        active = np.zeros((self.slots,), bool)
+        active[slots] = True
+        args = [self.params, cache.k, cache.v,
+                jnp.asarray(np.array(st["last_tokens"])),
+                cache.lengths_device(), cache.table_device(),
+                jnp.asarray(active)]
+        if self.kv_compress:
+            args += list(cache.compress_operands())
+        if self.adapters is not None:
+            args += [self.adapters,
+                     jnp.asarray(np.array(st["adapter_ids"]))]
+        t0 = time.monotonic()
+        logits, cache.k, cache.v = self.step(*args)
+        sampled = np.asarray(greedy_sample(logits))  # sync point
+        step_s = time.monotonic() - t0
+        st["decode_steps"] += 1
+        st["occ_samples"].append(sched.occupancy)
+        for slot in slots:
+            req = sched.active[slot]
+            tok = int(sampled[slot])
+            req.tokens.append(tok)
+            cache.lengths[slot] += 1
+            st["last_tokens"][slot] = tok
+            sched.note_decode_token(req, step_s)
+            if req.finished or int(cache.lengths[slot]) >= self.max_len:
+                self._release(st, slot, now)
+        return step_s
+
+    def spec_round(self, st: Dict[str, Any], now) -> float:
+        """One speculative round: draft k, verify k+1 wide, accept the
+        longest agreeing prefix per slot.
+
+        Greedy-exact by construction -- every emitted token is the
+        TARGET model's argmax (column j's logits condition on the
+        accepted prefix only), so the stream is bitwise identical to
+        plain decode; the drafter only changes how many tokens one
+        dispatch amortises.  Rejected draft K/V stays above the rolled-
+        back length: masked garbage, the recycled-page contract.
+        """
+        sched = self.scheduler
+        cache = self.cache
+        k = self.spec_k
+        width = k + 1
+        slots = self._decode_slots()
+        reqs = {s: sched.active[s] for s in slots}
+        base = {s: int(cache.lengths[s]) for s in slots}
+        for s in slots:
+            # Room for this round's widest write, capped at the slot's
+            # page allotment (columns past max_len scatter to scratch).
+            cache.reserve(s, min(base[s] + width, self.max_len))
+        drafts = self.drafter.propose(reqs, k,
+                                      np.array(st["last_tokens"]))
+        tokens_in = np.zeros((self.slots, width), np.int32)
+        tokens_in[:, 0] = st["last_tokens"]
+        tokens_in[:, 1:] = drafts
+        active = np.zeros((self.slots,), bool)
+        active[slots] = True
+        args = [self.params, cache.k, cache.v, jnp.asarray(tokens_in),
+                cache.lengths_device(), cache.table_device(),
+                jnp.asarray(active)]
+        if self.kv_compress:
+            args += list(cache.compress_operands())
+        t0 = time.monotonic()
+        logits, cache.k, cache.v = self.verify_step(*args)
+        sampled = np.asarray(greedy_sample(logits))  # [slots, width]
+        step_s = time.monotonic() - t0
+        st["decode_steps"] += 1
+        st["spec_rounds"] = st.get("spec_rounds", 0) + 1
+        st["occ_samples"].append(sched.occupancy)
+        for s in slots:
+            req = reqs[s]
+            # Longest agreeing prefix: draft j survives iff every
+            # earlier draft did AND it equals the target's argmax for
+            # the position it sits at.
+            m = 0
+            while m < k and drafts[s, m] == sampled[s, m]:
+                m += 1
+            emit = min(m + 1,
+                       req.max_new_tokens - len(req.tokens),
+                       self.max_len - base[s])
+            accepted = max(emit - 1, 0)
+            st["proposed"] = st.get("proposed", 0) + k
+            st["accepted"] = st.get("accepted", 0) + accepted
+            sched.note_spec(k, accepted)
+            for j in range(emit):
+                req.tokens.append(int(sampled[s, j]))
+                sched.note_decode_token(req, step_s / max(emit, 1))
+            cache.lengths[s] = base[s] + emit
+            st["last_tokens"][s] = req.tokens[-1]
+            self.drafter.observe(s, req, accepted)
+            if req.finished or int(cache.lengths[s]) >= self.max_len:
+                self._release(st, s, now)
+        return step_s
 
     # -- elastic resize hooks (driven by serving.controlplane) -------------
     def rebuild_mesh(self, mesh) -> None:
@@ -204,10 +421,17 @@ class ServingEngine:
             self.config, mesh, slots=self.slots, page_size=self.page_size,
             pages_per_slot=self.cache_config.pages_per_slot,
             dtype=self.dtype, with_lora=self.adapters is not None,
-            lora_alpha=self.lora_alpha)
+            lora_alpha=self.lora_alpha, compress=self.kv_compress)
         # The auditor's serving branch notes resize provenance so the
         # post-shrink gate can assert the exchange contract held.
         self.step._meta["resized_from"] = old_tp
+        if self.verify_step is not None:
+            self.verify_step = build_verify_step(
+                self.config, mesh, slots=self.slots,
+                width=self.spec_k + 1, page_size=self.page_size,
+                pages_per_slot=self.cache_config.pages_per_slot,
+                dtype=self.dtype, compress=self.kv_compress)
+            self.verify_step._meta["resized_from"] = old_tp
 
     def re_prefill(self, slot: int, req: Request) -> int:
         """Rebuild a suspended request's KV on the CURRENT mesh from its
@@ -230,13 +454,14 @@ class ServingEngine:
             _, kl, vl = self._prefill(
                 self.params, jnp.asarray(full)[None], self.adapters, aid)
             self.cache.write_prefill(slot, kl[:, 0], vl[:, 0])
+        if self.drafter is not None:
+            self.drafter.re_prefill(slot, req)
         return int(req.tokens[-1])
 
     # -- the serve loop ----------------------------------------------------
     def serve(self, requests: Sequence[Request]) -> ServingReport:
         """Run the open-loop request stream to completion."""
         sched = self.scheduler
-        cache = self.cache
         pending = sorted(requests, key=lambda r: r.arrival_s)
         rejected = 0
         admissible = []
@@ -253,12 +478,14 @@ class ServingEngine:
         def now() -> float:
             return time.monotonic() - start + skip
 
-        completed: List[Request] = []
-        occ_samples: List[float] = []
-        decode_steps = 0
-        last_tokens = np.zeros((self.slots,), np.int32)
-        adapter_ids = np.zeros((self.slots,), np.int32)
+        st: Dict[str, Any] = {
+            "completed": [], "occ_samples": [], "decode_steps": 0,
+            "spec_rounds": 0, "proposed": 0, "accepted": 0,
+            "last_tokens": np.zeros((self.slots,), np.int32),
+            "adapter_ids": np.zeros((self.slots,), np.int32)}
+        completed: List[Request] = st["completed"]
         prompts_dev: Dict[int, Any] = {}
+        self._chunking.clear()
 
         with RequestPrefetcher(admissible, self.prefetch_depth) as feed:
             fetched = next(feed, None)
@@ -282,61 +509,48 @@ class ServingEngine:
                     continue
 
                 for slot, req in sched.admit(now()):
-                    first = self._do_prefill(
-                        slot, req, prompts_dev.pop(req.rid))
-                    req.tokens.append(first)
-                    sched.note_prefill(req, now())
-                    last_tokens[slot] = first
-                    adapter_ids[slot] = req.adapter_id
-                    if req.finished:
-                        completed.append(sched.release(slot, now()))
+                    dev = prompts_dev.pop(req.rid)
+                    if 0 < self.prefill_chunk < req.prompt_len:
+                        # Long prompt: fill in chunk-by-chunk, one
+                        # chunk per loop iteration, decode interleaved.
+                        self._chunking[slot] = {
+                            "req": req, "dev": dev, "pos": 0,
+                            "past": None}
+                    else:
+                        first = self._do_prefill(slot, req, dev)
+                        self._join_decode(st, slot, req, first, now)
 
-                if not sched.active:
+                if self._chunking:
+                    self._advance_chunks(st, now)
+                if not self._decode_slots():
                     continue
 
-                # One continuous-batching decode step over live slots.
-                for slot in sched.active:
-                    cache.reserve(slot, int(cache.lengths[slot]) + 1)
-                active = np.zeros((self.slots,), bool)
-                for slot in sched.active:
-                    active[slot] = True
-                args = [self.params, cache.k, cache.v,
-                        jnp.asarray(np.array(last_tokens)),
-                        cache.lengths_device(), cache.table_device(),
-                        jnp.asarray(active)]
-                if self.adapters is not None:
-                    args += [self.adapters,
-                             jnp.asarray(np.array(adapter_ids))]
-                t0 = time.monotonic()
-                logits, cache.k, cache.v = self.step(*args)
-                sampled = np.asarray(greedy_sample(logits))  # sync point
-                step_s = time.monotonic() - t0
-                decode_steps += 1
-                occ_samples.append(sched.occupancy)
-
-                for slot, req in list(sched.active.items()):
-                    tok = int(sampled[slot])
-                    req.tokens.append(tok)
-                    cache.lengths[slot] += 1
-                    last_tokens[slot] = tok
-                    sched.note_decode_token(req, step_s)
-                    if req.finished or \
-                            int(cache.lengths[slot]) >= self.max_len:
-                        completed.append(sched.release(slot, now()))
+                # One continuous-batching round over the decode batch:
+                # a k-draft verify dispatch when speculating, else one
+                # plain single-token step.
+                if self.spec_decode:
+                    self.spec_round(st, now)
+                else:
+                    self.decode_once(st, now)
 
         wall_s = max(time.monotonic() - start, 1e-9)
         new_tokens = sum(len(r.tokens) for r in completed)
         prompt_tokens = sum(r.prompt_len for r in completed)
         ttfts = [r.ttft_s for r in completed if r.ttft_s is not None]
         lats = [l for r in completed for l in r.token_latencies]
+        proposed = int(st["proposed"])
+        accepted = int(st["accepted"])
         return ServingReport(
             num_requests=len(requests), completed=len(completed),
             rejected=rejected, prompt_tokens=prompt_tokens,
             new_tokens=new_tokens, wall_s=wall_s,
-            decode_steps=decode_steps,
+            decode_steps=int(st["decode_steps"]),
             tokens_per_s=new_tokens / wall_s,
             ttft_p50_s=_pct(ttfts, 50), ttft_p99_s=_pct(ttfts, 99),
             token_latency_p50_s=_pct(lats, 50),
             token_latency_p99_s=_pct(lats, 99),
-            mean_occupancy=(float(np.mean(occ_samples))
-                            if occ_samples else 0.0))
+            mean_occupancy=(float(np.mean(st["occ_samples"]))
+                            if st["occ_samples"] else 0.0),
+            spec_rounds=int(st["spec_rounds"]),
+            proposed_tokens=proposed, accepted_tokens=accepted,
+            acceptance_rate=(accepted / proposed if proposed else 0.0))

@@ -20,16 +20,33 @@ asserts this bit-for-bit).
 Pages are allocated lazily from a free list as a slot's sequence grows
 and returned wholesale on eviction -- continuous batching recycles slots
 mid-flight, so the pool, not the slot count, bounds resident KV bytes.
+
+fp8 cold-page compression (``CacheConfig(compress=True)``): pages that
+sit ``hot_pages`` full pages behind a slot's write head are *cold* --
+decode only reads them, never writes them again while the slot lives.
+A cold page can be migrated into a parallel e4m3 pool through the PR 5
+fp8 codec (:func:`~horovod_tpu.collectives.compression.fp8_quantize`,
+one max-abs scale per token-layer row so an all-zero row roundtrips to
+exact zeros), after which its f32 page returns to the free list.  The
+decode/verify steps blend the two pools on gather (``comp_mask`` picks
+the dequantised e4m3 page), so compression is invisible to the masking
+contract: a recycled compressed page's stale bytes are unreachable for
+exactly the reason a recycled f32 page's are.  Admission is therefore
+page-gated on COMPRESSED size: ``can_admit``/``reserve`` count cold
+pages at their e4m3 cost (compressing on demand to reclaim f32 pages),
+so the same physical pool admits roughly 4x the cold-token residency.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..collectives.compression import fp8_quantize
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,12 +60,16 @@ class CacheConfig:
     page_size: int
     max_len: int
     dtype: str = "float32"
+    compress: bool = False         # fp8 cold-page compression on/off
+    hot_pages: int = 1             # full pages behind the head kept f32
 
     def __post_init__(self):
         if self.max_len % self.page_size:
             raise ValueError(
                 f"max_len {self.max_len} not a multiple of page_size "
                 f"{self.page_size}")
+        if self.hot_pages < 0:
+            raise ValueError(f"hot_pages must be >= 0: {self.hot_pages}")
 
     @property
     def pages_per_slot(self) -> int:
@@ -106,6 +127,24 @@ class PagedKVCache:
         self.lengths = np.zeros((c.slots,), np.int32)
         self._allocated = np.zeros((c.slots,), np.int32)  # pages per slot
         self._free = list(range(c.num_pages - 1, -1, -1))  # pop() -> 0, 1...
+        # fp8 cold-page pool: a parallel e4m3 page space plus one max-abs
+        # scale per (layer, page, offset) row, blended in on gather by the
+        # decode/verify steps wherever ``comp_mask`` is set.
+        self.compress = bool(c.compress)
+        if self.compress:
+            self.kq = jnp.zeros(shape, jnp.float8_e4m3fn)
+            self.vq = jnp.zeros(shape, jnp.float8_e4m3fn)
+            if sharding is not None:
+                self.kq = jax.device_put(self.kq, sharding)
+                self.vq = jax.device_put(self.vq, sharding)
+            sshape = (c.num_layers, c.num_pages + 1, c.page_size)
+            self.kscale = jnp.ones(sshape, jnp.float32)
+            self.vscale = jnp.ones(sshape, jnp.float32)
+            self.cpage_table = np.zeros((c.slots, c.pages_per_slot),
+                                        np.int32)
+            self.comp_mask = np.zeros((c.slots, c.pages_per_slot), bool)
+            self._cfree = list(range(c.num_pages - 1, -1, -1))
+            self._cheld = np.zeros((c.slots,), np.int32)
 
     # -- page accounting ---------------------------------------------------
     @property
@@ -114,23 +153,84 @@ class PagedKVCache:
 
     @property
     def allocated_pages(self) -> int:
-        """Pages currently held by slots (free_pages + allocated_pages
-        == num_pages is the pool invariant the drain tests assert)."""
-        return int(self._allocated.sum())
+        """f32 pages currently held by slots (free_pages +
+        allocated_pages == num_pages is the pool invariant the drain
+        tests assert; compressed pages live in the e4m3 pool and are
+        accounted by :attr:`compressed_pages`)."""
+        total = int(self._allocated.sum())
+        if self.compress:
+            total -= int(self._cheld.sum())
+        return total
+
+    @property
+    def compressed_pages(self) -> int:
+        return int(self._cheld.sum()) if self.compress else 0
+
+    @property
+    def resident_bytes(self) -> int:
+        """Logical KV residency at COMPRESSED accounting: f32 pages at
+        full price, cold e4m3 pages at one byte per element plus the
+        per-row f32 scale (the number ``can_admit`` effectively budgets
+        against)."""
+        c = self.config
+        row = c.num_kv_heads * c.head_dim
+        page_f32 = c.num_layers * c.page_size * row * 2 \
+            * jnp.dtype(c.dtype).itemsize
+        page_fp8 = c.num_layers * c.page_size * (row + 4) * 2
+        return (self.allocated_pages * page_f32
+                + self.compressed_pages * page_fp8)
+
+    def _cold_candidates(self, exclude: Optional[int] = None
+                         ) -> List[int]:
+        """Slots ordered by how many not-yet-compressed cold pages they
+        hold (descending) -- the reclaim sweep order."""
+        c = self.config
+        out = []
+        for slot in range(c.slots):
+            if slot == exclude:
+                continue
+            n = self._cold_count(slot)
+            if n > 0:
+                out.append((n, slot))
+        return [slot for _, slot in sorted(out, reverse=True)]
+
+    def _cold_count(self, slot: int) -> int:
+        """Cold pages of ``slot`` still resident in f32: full pages at
+        least ``hot_pages`` behind the write head, minus the compressed
+        prefix.  Pages at or past ``lengths`` are NEVER cold -- the
+        decode/verify steps may still write them (speculative rejects
+        roll ``lengths`` back below already-written positions)."""
+        c = self.config
+        full = int(self.lengths[slot]) // c.page_size
+        return max(0, full - c.hot_pages - int(self._cheld[slot]))
 
     def can_admit(self, length: int) -> bool:
-        """Whether a sequence of ``length`` tokens fits the pool now."""
+        """Whether a sequence of ``length`` tokens fits the pool now.
+
+        With compression the gate prices cold pages at their compressed
+        size: f32 pages reclaimable by a cold sweep (bounded by e4m3
+        pool headroom) count as free."""
         need = -(-max(int(length), 1) // self.config.page_size)
-        return need <= len(self._free)
+        avail = len(self._free)
+        if self.compress:
+            cold = sum(self._cold_count(s)
+                       for s in range(self.config.slots))
+            avail += min(cold, len(self._cfree))
+        return need <= avail
 
     def reserve(self, slot: int, length: int) -> None:
-        """Ensure slot ``slot`` has pages for ``length`` tokens."""
+        """Ensure slot ``slot`` has pages for ``length`` tokens,
+        compressing other slots' cold pages on demand when the f32 free
+        list runs short."""
         c = self.config
         if length > c.max_len:
             raise ValueError(f"length {length} exceeds max_len {c.max_len}")
         need = -(-int(length) // c.page_size)
         have = int(self._allocated[slot])
         if need > have:
+            short = need - have - len(self._free)
+            if short > 0 and self.compress:
+                self._reclaim(short, exclude=slot)
             if need - have > len(self._free):
                 raise RuntimeError(
                     f"KV page pool exhausted: slot {slot} needs "
@@ -139,14 +239,69 @@ class PagedKVCache:
                 self.page_table[slot, i] = self._free.pop()
             self._allocated[slot] = need
 
+    def _reclaim(self, pages: int, exclude: Optional[int] = None) -> int:
+        """Compress cold pages across slots until ``pages`` f32 pages
+        came back (or candidates ran out).  Returns pages reclaimed."""
+        got = 0
+        for slot in self._cold_candidates(exclude=exclude):
+            if got >= pages:
+                break
+            got += self.compress_cold(
+                slot, max_pages=pages - got)
+        return got
+
+    def compress_cold(self, slot: int, max_pages: Optional[int] = None
+                      ) -> int:
+        """Migrate up to ``max_pages`` of ``slot``'s cold pages into the
+        e4m3 pool (prefix order -- compression always extends the cold
+        prefix), returning their f32 pages to the free list.  The freed
+        f32 table entries are pointed at the scratch page; gathers never
+        read them (``comp_mask`` blends the e4m3 page in) but a sound
+        table beats a dangling one."""
+        if not self.compress:
+            raise RuntimeError("cache built without compress=True")
+        c = self.config
+        n = self._cold_count(slot)
+        if max_pages is not None:
+            n = min(n, max_pages)
+        n = min(n, len(self._cfree))
+        if n <= 0:
+            return 0
+        start = int(self._cheld[slot])
+        idxs = list(range(start, start + n))
+        pids = np.asarray([self.page_table[slot, i] for i in idxs],
+                          np.int32)
+        cpids = np.asarray([self._cfree.pop() for _ in idxs], np.int32)
+        dev_pids = jnp.asarray(pids)
+        kq, ksc = _quantize_pages(self.k, dev_pids)
+        vq, vsc = _quantize_pages(self.v, dev_pids)
+        cp = jnp.asarray(cpids)
+        self.kq = self.kq.at[:, cp].set(kq)
+        self.vq = self.vq.at[:, cp].set(vq)
+        self.kscale = self.kscale.at[:, cp].set(ksc)
+        self.vscale = self.vscale.at[:, cp].set(vsc)
+        for i, cpid, pid in zip(idxs, cpids, pids):
+            self.cpage_table[slot, i] = cpid
+            self.comp_mask[slot, i] = True
+            self.page_table[slot, i] = c.scratch_page
+            self._free.append(int(pid))
+        self._cheld[slot] = start + n
+        return n
+
     def free_slot(self, slot: int) -> None:
         """Return the slot's pages to the pool and mark it idle.  Page
         CONTENTS are deliberately left in place: the masking contract,
         not zeroing, is what guarantees no stale attention mass."""
         n = int(self._allocated[slot])
         for i in range(n - 1, -1, -1):
-            self._free.append(int(self.page_table[slot, i]))
+            if self.compress and self.comp_mask[slot, i]:
+                self._cfree.append(int(self.cpage_table[slot, i]))
+                self.comp_mask[slot, i] = False
+            else:
+                self._free.append(int(self.page_table[slot, i]))
         self._allocated[slot] = 0
+        if self.compress:
+            self._cheld[slot] = 0
         self.lengths[slot] = 0
 
     def release_all(self) -> int:
@@ -202,8 +357,32 @@ class PagedKVCache:
     def lengths_device(self) -> jnp.ndarray:
         return jnp.asarray(np.array(self.lengths))
 
+    def ctable_device(self) -> jnp.ndarray:
+        return jnp.asarray(np.array(self.cpage_table))
+
+    def cmask_device(self) -> jnp.ndarray:
+        return jnp.asarray(np.array(self.comp_mask))
+
+    def compress_operands(self) -> tuple:
+        """The six extra step operands a ``compress=True`` decode/verify
+        step takes after ``active`` (pools, scales, table, mask)."""
+        return (self.kq, self.vq, self.kscale, self.vscale,
+                self.ctable_device(), self.cmask_device())
+
     def layout(self) -> dict:
         return self.config.layout()
+
+
+def _quantize_pages(pool, pids):
+    """fp8-quantize pages ``pids`` of one pool through the PR 5 codec:
+    one max-abs e4m3 scale per (layer, page, offset) row over the
+    ``[kv_heads * head_dim]`` vector, so a never-written row (absmax 0)
+    roundtrips to exact zeros with scale 1.  Returns
+    ``(q [L, n, page, H, D] e4m3, scales [L, n, page] f32)``."""
+    x = pool[:, pids]
+    l, n, pg, hh, dd = x.shape
+    q, s = fp8_quantize(x.reshape(l * n * pg, hh * dd), axis=0)
+    return q.reshape(l, n, pg, hh, dd), s.reshape(l, n, pg)
 
 
 def cache_sharding(mesh, tp_axis: str = "tp"):

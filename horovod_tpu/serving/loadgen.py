@@ -58,6 +58,18 @@ def _norm(weights: Optional[Sequence[float]], n: int):
     return w / w.sum()
 
 
+def long_prompt_spec(**overrides) -> LoadSpec:
+    """The kilotoken-prompt mixture the chunked-prefill TTFT gate runs:
+    512/2048/4096-token prompts weighted toward the long tail (the 4k
+    bucket is what the BENCH_r15 TTFT p99 is measured on)."""
+    base = dict(num_requests=16, rate_rps=2.0,
+                prompt_lens=(512, 2048, 4096),
+                prompt_weights=(0.5, 0.25, 0.25),
+                output_lens=(8, 16), seed=0)
+    base.update(overrides)
+    return LoadSpec(**base)
+
+
 def generate(spec: LoadSpec) -> List[Request]:
     """Materialize the request stream for ``spec`` (sorted by arrival)."""
     rng = np.random.RandomState(spec.seed)

@@ -23,6 +23,9 @@ mesh.  Every transition is instrumented through the PR 6
   gauges plus ``horovod_serving_slot_states{state}`` (active / draining
   / free slot counts, so dashboards can tell a draining batch from an
   idle one),
+* ``horovod_serving_spec_tokens_total{outcome}`` -- speculative-decoding
+  draft tokens proposed vs accepted (acceptance rate =
+  accepted / proposed),
 * ``horovod_serving_ttft_seconds`` / ``horovod_serving_token_latency_seconds``
   histograms (time-to-first-token, per-output-token latency)
 
@@ -81,11 +84,19 @@ class Request:
 class ContinuousBatchScheduler:
     """Admit/evict requests into a fixed-shape decode batch."""
 
-    def __init__(self, slots: int, cache=None):
+    def __init__(self, slots: int, cache=None, token_budget: int = 1):
         if slots < 1:
             raise ValueError(f"need at least one slot, got {slots}")
+        if token_budget < 1:
+            raise ValueError(
+                f"token_budget must be >= 1, got {token_budget}")
         self.slots = slots
         self.cache = cache
+        # Worst-case tokens a slot can append in ONE step: 1 for plain
+        # decode, k+1 under speculative decoding (k drafts + the
+        # target's own token).  Admission must price this in or a
+        # full-acceptance burst can oversubscribe KV pages mid-step.
+        self.token_budget = token_budget
         self.queue: "collections.deque[Request]" = collections.deque()
         self.active: dict[int, Request] = {}
         self._free_slots = list(range(slots - 1, -1, -1))  # pop() -> 0, 1...
@@ -112,6 +123,10 @@ class ContinuousBatchScheduler:
             "horovod_serving_slot_states",
             "Decode-batch slots by lifecycle state",
             labelnames=("state",))
+        self._m_spec = reg.counter(
+            "horovod_serving_spec_tokens_total",
+            "Speculative-decoding draft tokens by outcome",
+            labelnames=("outcome",))
 
     # -- state gauges ------------------------------------------------------
     @property
@@ -155,9 +170,10 @@ class ContinuousBatchScheduler:
             return out
         while self.queue and self._free_slots:
             req = self.queue[0]
-            # +1: room for at least one generated token beyond the prompt.
+            # + token_budget: room for a full step's worth of generated
+            # tokens beyond the prompt (1 plain, k+1 speculative).
             if self.cache is not None and not self.cache.can_admit(
-                    req.prompt_len + 1):
+                    req.prompt_len + self.token_budget):
                 break
             self.queue.popleft()
             slot = self._free_slots.pop()
@@ -183,6 +199,17 @@ class ContinuousBatchScheduler:
         self._m_tokens.labels(phase="decode").inc()
         self._m_tok_lat.observe(max(latency_s, 0.0))
         req.token_latencies.append(latency_s)
+
+    def note_spec(self, proposed: int, accepted: int) -> None:
+        """Account one speculative round: ``proposed`` draft tokens went
+        into the verify step, ``accepted`` of them survived (the
+        target's bonus token is decode-phase accounting, not a draft).
+        Exported as ``horovod_serving_spec_tokens_total{outcome}``."""
+        if accepted > proposed:
+            raise ValueError(
+                f"accepted {accepted} > proposed {proposed}")
+        self._m_spec.labels(outcome="proposed").inc(proposed)
+        self._m_spec.labels(outcome="accepted").inc(accepted)
 
     def release(self, slot: int, now_s: float, *,
                 completed: bool = True) -> Request:

@@ -1147,6 +1147,213 @@ def test_serving_guard_trips_on_bad_entries(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Serving v2 entries (PR 14)
+# ---------------------------------------------------------------------------
+
+def scan_serving_v2_entries(bench_dir):
+    """Return [(path, why), ...] for malformed serving-v2 entries.
+
+    A serving_v2 entry records the round-15 throughput-overhaul drill
+    (BENCH_SERVING_V2=1): the speculative-decoding + fp8-KV throughput
+    phase and the chunked-vs-whole kilotoken TTFT phase.  The headline
+    value must match the throughput block, the speculative accounting
+    must be internally consistent (accepted <= proposed, acceptance in
+    [0, 1], spec_rounds > 0), occupancy must be a fraction of the fixed
+    batch, both long-prompt runs must complete their mixture with at
+    least one 4k prompt and ordered TTFT percentile pairs, and
+    vs_baseline must equal the throughput ratio over the recorded r11
+    baseline (unlike the v1 drill, v2 HAS a same-mesh peer)."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            sv = parsed.get("serving_v2")
+            if not sv:
+                continue
+            th = sv.get("throughput") or {}
+            tps = th.get("tokens_per_s")
+            if not (isinstance(tps, (int, float)) and tps > 0):
+                bad.append((path, f"tokens_per_s must be > 0, got {tps!r}"))
+            elif parsed.get("value") != tps:
+                bad.append((path, f"headline value {parsed.get('value')!r}"
+                                  f" != throughput.tokens_per_s {tps!r}"))
+            prop, acc = th.get("proposed_tokens"), th.get("accepted_tokens")
+            rate = th.get("acceptance_rate")
+            if not (isinstance(prop, int) and isinstance(acc, int)
+                    and 0 <= acc <= prop and prop > 0):
+                bad.append((path, f"speculative accounting must satisfy "
+                                  f"0 <= accepted <= proposed with "
+                                  f"proposed > 0, got {acc!r}/{prop!r}"))
+            elif not (isinstance(rate, (int, float))
+                      and abs(rate - acc / prop) < 1e-3):
+                bad.append((path, f"acceptance_rate {rate!r} != accepted/"
+                                  f"proposed {acc}/{prop}"))
+            if not th.get("spec_rounds"):
+                bad.append((path, "spec_rounds == 0: the drill never took "
+                                  "the speculative path"))
+            occ = th.get("batch_occupancy")
+            if not (isinstance(occ, (int, float)) and 0 < occ <= 1):
+                bad.append((path, f"batch_occupancy must be in (0, 1], "
+                                  f"got {occ!r}"))
+            n_req, done = th.get("requests"), th.get("completed")
+            rejected = th.get("rejected", 0)
+            if not isinstance(n_req, int) or done != n_req - rejected:
+                bad.append((path, f"completed {done!r} != requests "
+                                  f"{n_req!r} - rejected {rejected!r}: "
+                                  f"the drill dropped requests"))
+            base = th.get("baseline_tokens_per_s")
+            vsb = parsed.get("vs_baseline")
+            if not (isinstance(base, (int, float)) and base > 0):
+                bad.append((path, f"baseline_tokens_per_s must be > 0, "
+                                  f"got {base!r}"))
+            elif not (isinstance(vsb, (int, float))
+                      and isinstance(tps, (int, float))
+                      and abs(vsb - tps / base) < 0.01):
+                bad.append((path, f"vs_baseline {vsb!r} != tokens_per_s/"
+                                  f"baseline {tps!r}/{base!r}"))
+            lp = sv.get("long_prompt") or {}
+            for which in ("chunked", "nochunk"):
+                blk = lp.get(which)
+                if not isinstance(blk, dict):
+                    bad.append((path, f"long_prompt.{which} block missing"))
+                    continue
+                if blk.get("completed") != blk.get("requests") \
+                        or not blk.get("requests"):
+                    bad.append((path, f"long_prompt.{which} dropped "
+                                      f"requests: {blk.get('completed')!r}"
+                                      f"/{blk.get('requests')!r}"))
+                if not blk.get("prompts_4k"):
+                    bad.append((path, f"long_prompt.{which} saw no "
+                                      f"4k-token prompts"))
+                for p50k, p99k in (("ttft_p50_ms", "ttft_p99_ms"),
+                                   ("ttft_4k_p50_ms", "ttft_4k_p99_ms")):
+                    p50, p99 = blk.get(p50k), blk.get(p99k)
+                    if not (isinstance(p50, (int, float))
+                            and isinstance(p99, (int, float))
+                            and 0 <= p50 <= p99):
+                        bad.append((path, f"long_prompt.{which} pair "
+                                          f"{p50k}/{p99k} must satisfy "
+                                          f"0 <= p50 <= p99, got "
+                                          f"{p50!r}/{p99!r}"))
+            if not (isinstance(lp.get("prefill_chunk"), int)
+                    and lp.get("prefill_chunk", 0) > 0):
+                bad.append((path, f"long_prompt.prefill_chunk must be a "
+                                  f"positive chunk length, got "
+                                  f"{lp.get('prefill_chunk')!r}"))
+    return bad
+
+
+def test_committed_serving_v2_entries_well_formed():
+    assert scan_serving_v2_entries(REPO) == []
+
+
+def test_committed_serving_v2_round_meets_gates():
+    """Acceptance gate: the committed round-15 entry must show >= 2x the
+    r11 serving throughput at occupancy > 0.8, and chunked prefill must
+    hold TTFT p99 at the 4k bucket under the whole-prompt baseline."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            sv = (entry.get("parsed") or {}).get("serving_v2")
+            if sv:
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries a serving_v2 block"
+    for path, parsed in found:
+        assert parsed["metric"] == "serving_v2_tokens_per_sec", path
+        th = parsed["serving_v2"]["throughput"]
+        assert th["tokens_per_s"] >= 2 * th["baseline_tokens_per_s"], \
+            (path, th)
+        assert th["batch_occupancy"] > 0.8, (path, th)
+        assert 0 < th["acceptance_rate"] <= 1, (path, th)
+        lp = parsed["serving_v2"]["long_prompt"]
+        assert lp["chunked"]["ttft_4k_p99_ms"] <= \
+            lp["nochunk"]["ttft_4k_p99_ms"], (path, lp)
+
+
+def _good_serving_v2():
+    return {
+        "world": 8, "slots": 8, "spec_k": 4,
+        "drafter": "model_self_draft", "kv_compress": True,
+        "throughput": {
+            "requests": 32, "completed": 32, "rejected": 0,
+            "new_tokens": 640, "decode_steps": 160, "spec_rounds": 150,
+            "proposed_tokens": 600, "accepted_tokens": 540,
+            "acceptance_rate": 0.9, "tokens_per_s": 900.0,
+            "batch_occupancy": 0.85, "baseline_tokens_per_s": 262.95},
+        "long_prompt": {
+            "prefill_chunk": 512, "num_requests": 12,
+            "prompt_lens": [512, 2048, 4096],
+            "chunked": {"completed": 12, "requests": 12,
+                        "tokens_per_s": 40.0, "ttft_p50_ms": 300.0,
+                        "ttft_p99_ms": 900.0, "ttft_4k_p50_ms": 800.0,
+                        "ttft_4k_p99_ms": 900.0, "prompts_4k": 3},
+            "nochunk": {"completed": 12, "requests": 12,
+                        "tokens_per_s": 41.0, "ttft_p50_ms": 350.0,
+                        "ttft_p99_ms": 1100.0, "ttft_4k_p50_ms": 950.0,
+                        "ttft_4k_p99_ms": 1100.0, "prompts_4k": 3}}}
+
+
+def _write_serving_v2(tmp_path, name, sv, vs_baseline=None, value=None):
+    tps = sv["throughput"].get("tokens_per_s")
+    base = sv["throughput"].get("baseline_tokens_per_s") or 1.0
+    parsed = {"metric": "serving_v2_tokens_per_sec",
+              "value": tps if value is None else value,
+              "unit": "tokens/s",
+              "vs_baseline": (round(tps / base, 2) if vs_baseline is None
+                              and isinstance(tps, (int, float))
+                              else vs_baseline),
+              "config": "llama_serve_v2_w8_slots8_spec4_fp8kv",
+              "baseline_config": "llama_serve_w8_slots8",
+              "serving_v2": sv}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 15, "cmd": "BENCH_SERVING_V2=1 bench.py", "rc": 0,
+         "tail": "", "parsed": parsed}))
+
+
+def test_serving_v2_guard_accepts_good_entry(tmp_path):
+    _write_serving_v2(tmp_path, "BENCH_r95.json", _good_serving_v2())
+    assert scan_serving_v2_entries(str(tmp_path)) == []
+
+
+def test_serving_v2_guard_trips_on_bad_entries(tmp_path):
+    bad = _good_serving_v2()
+    bad["throughput"].update({
+        "accepted_tokens": 700,        # accepted > proposed
+        "spec_rounds": 0,              # never took the spec path
+        "batch_occupancy": 1.5,        # beyond the fixed batch
+        "completed": 20})              # dropped requests
+    bad["long_prompt"]["chunked"].update({
+        "prompts_4k": 0,               # mixture missed the 4k bucket
+        "ttft_4k_p50_ms": 990.0})      # p50 > p99
+    bad["long_prompt"]["prefill_chunk"] = 0   # whole-prompt only
+    _write_serving_v2(tmp_path, "BENCH_r91.json", bad)
+    _write_serving_v2(tmp_path, "BENCH_r92.json", _good_serving_v2(),
+                      vs_baseline=9.9)  # ratio does not match the block
+    _write_serving_v2(tmp_path, "BENCH_r93.json", _good_serving_v2(),
+                      value=1.0)        # headline/block mismatch
+    why = " ".join(w for _, w in scan_serving_v2_entries(str(tmp_path)))
+    assert "0 <= accepted <= proposed" in why
+    assert "spec_rounds == 0" in why
+    assert "batch_occupancy" in why
+    assert "dropped requests" in why
+    assert "no 4k-token prompts" in why
+    assert "0 <= p50 <= p99" in why
+    assert "prefill_chunk" in why
+    assert "vs_baseline" in why
+    assert "headline value" in why
+
+
+# ---------------------------------------------------------------------------
 # Hierarchical exchange entries (PR 11)
 # ---------------------------------------------------------------------------
 

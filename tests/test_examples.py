@@ -130,6 +130,17 @@ def test_serving_probe_example_cpu(tmp_path):
 
 
 @pytest.mark.integration
+def test_serving_probe_long_prompts_cpu():
+    """Kilotoken-mixture drill through chunked flash prefill: the probe
+    asserts internally that the serving_prefill_chunk span leg fired
+    (long admissions sliced and interleaved with decode) alongside the
+    whole-prompt serving_prefill leg for the short end of the mix."""
+    out = _run([os.path.join(REPO, "examples", "serving_probe.py"),
+                "--long-prompts", "--requests", "4"])
+    assert "serving probe OK" in out
+
+
+@pytest.mark.integration
 def test_autoscale_probe_example_cpu(tmp_path):
     """Closed-loop chaos drill: kill@ forces a drain + shrink, slow@
     gets the rank auto-evicted, zero requests lost; the probe asserts
