@@ -158,6 +158,20 @@ AUTOSCALE_BUDGET_S = float(os.environ.get("BENCH_AUTOSCALE_BUDGET_S", "30"))
 # produced it, and the speedup column is only meaningful on TPU.
 ROOFLINE_BENCH = _env_on("BENCH_ROOFLINE")
 ROOFLINE_ITERS = int(os.environ.get("BENCH_ROOFLINE_ITERS", "5"))
+# BENCH_SDC=1 runs the silent-data-corruption defense drill: (1) a
+# nan-poisoned input shard is screened by the in-step guard
+# (HOROVOD_GUARD) and the optimizer update skipped, (2) a sustained
+# 3-step anomaly trips the streak limit and the snapshot ledger rolls
+# back past the poison window, replaying to <= 1.25x loss parity with
+# the uninterrupted run, (3) a single flipped mantissa bit on one
+# replica -- finite, invisible to the numeric screen -- is caught by the
+# in-band checksum tripwire (HOROVOD_DESYNC_CHECK_STEPS) within one
+# check interval, attributed to the victim rank, and quarantined by
+# shrinking the world off that rank.  A CPU recovery drill has no
+# throughput peer -> vs_baseline null; the committed entry is gated by
+# tests/test_bench_guard.py::scan_sdc_entries.
+SDC_BENCH = _env_on("BENCH_SDC")
+SDC_STEPS = int(os.environ.get("BENCH_SDC_STEPS", "30"))
 
 
 def _config() -> str:
@@ -290,6 +304,185 @@ def _main_chaos():
     }
     print(json.dumps(result), flush=True)
     os._exit(0)
+
+
+def _main_sdc():
+    """BENCH_SDC=1: silent-data-corruption defense drill.
+
+    Three acts on one 8-device virtual CPU mesh, all against the same
+    tanh-MLP problem under a lockstep DistributedOptimizer (grad
+    allreduce -- the host snapshot IS the collective state):
+
+    1. clean baseline: SDC_STEPS guarded steps, proving the screen fires
+       zero false activations;
+    2. sustained nan anomaly -> ledger rollback: a poisoned input shard
+       from step 11 is skipped in-step (params bitwise untouched) until
+       the 3-step streak raises SustainedAnomalyError; the ledger rolls
+       back PAST the poison window and the healed replay must land
+       within 1.25x loss parity of the uninterrupted run;
+    3. bitflip -> tripwire quarantine: one flipped mantissa bit on one
+       rank's replica stays finite (the numeric screen cannot see it);
+       the in-band checksum tripwire catches it within one check
+       interval, attributes the victim by majority vote, and the world
+       shrinks off that rank with state intact.
+    """
+    os.environ.setdefault("HOROVOD_GUARD", "1")
+    os.environ.setdefault("HOROVOD_GUARD_STREAK", "3")
+    os.environ.setdefault("HOROVOD_SNAPSHOT_STEPS", "2")
+    os.environ.setdefault("HOROVOD_DESYNC_CHECK_STEPS", "2")
+    from horovod_tpu.utils.platform import force_host_device_count
+    force_host_device_count(8, cpu=True)  # before jax touches the backend
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+    from horovod_tpu.core import desync, guard
+    from horovod_tpu.core.exceptions import (CorruptRankError,
+                                             SustainedAnomalyError)
+    from horovod_tpu.elastic import chaos
+    from horovod_tpu.timeline import metrics as tm
+
+    steps, commit_every = SDC_STEPS, 3
+    poison_from = 11
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(16, 4).astype(np.float32)
+    x = rng.randn(64, 16).astype(np.float32)
+    data = (x, x @ w_true)
+    params0 = {"w1": rng.randn(16, 32).astype(np.float32) * 0.3,
+               "b1": np.zeros((32,), np.float32),
+               "w2": rng.randn(32, 4).astype(np.float32) * 0.3,
+               "b2": np.zeros((4,), np.float32)}
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        h = jnp.tanh(bx @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] + p["b2"] - by) ** 2)
+
+    def build():
+        opt = hvd.DistributedOptimizer(optax.adam(0.05))
+        p = hvd.replicate(params0)
+        st = opt.init(p)
+        step = hvd.make_train_step(loss_fn, opt)
+        return p, st, step, hvd.shard_batch(data)
+
+    reg = tm.registry()
+    hvd.init()
+    guard.reset()
+    world = hvd.size()
+
+    # Act 1: uninterrupted guarded reference -- zero false activations.
+    p, st, step, batch = build()
+    for _ in range(steps):
+        p, st, loss = step(p, st, batch)
+    base_loss = float(loss)
+    clean_skips = int(reg.counter("horovod_guard_skipped_total").value)
+
+    # Act 2: sustained nan anomaly -> streak trip -> ledger rollback.
+    chaos.reset()
+    hvd.shutdown()
+    hvd.init()
+    guard.reset()
+    p, st, step, batch = build()
+    poisoned = hvd.shard_batch(chaos.poison_batch(
+        tuple(jnp.asarray(a) for a in data)))
+    state = elastic.JaxState(params=p, opt_state=st, batch=0)
+    wedged = True
+    rollback_report = None
+    while state.batch < steps:
+        nxt = state.batch + 1
+        try:
+            use = poisoned if (wedged and nxt >= poison_from) else batch
+            state.params, state.opt_state, loss = step(
+                state.params, state.opt_state, use)
+            state.batch = nxt
+            if state.batch % commit_every == 0:
+                state.commit()
+        except SustainedAnomalyError:
+            if rollback_report is not None:
+                raise
+            wedged = False  # the rolled-back replay reads a healed shard
+            rollback_report = state.rollback(
+                before_commit=(poison_from - 1) // commit_every)
+            if rollback_report is None:
+                break
+    skipped = int(reg.counter("horovod_guard_skipped_total").value
+                  ) - clean_skips
+    ratio = float(loss) / base_loss
+
+    # Act 3: bitflip on one replica -> tripwire attribution + quarantine.
+    victim = world - 1
+    state2 = elastic.JaxState(params=hvd.replicate(params0), batch=0)
+    state2.commit()  # commit 1: off-cadence, the flip rides undetected
+    state2.params = desync.corrupt_replica(state2.params, victim)
+    attributed = None
+    commits_to_detect = 0
+    try:
+        commits_to_detect = 1
+        state2.commit()  # commit 2: tripwire samples -- one interval later
+    except CorruptRankError as e:
+        attributed = list(e.ranks)
+    world_after = world
+    if attributed == [victim]:
+        survivors = [d for i, d in enumerate(jax.devices()) if i != victim]
+        survivors = survivors[:len(survivors) // 2 * 2 or 1]
+        hvd.shutdown()
+        hvd.init(devices=survivors)
+        state2.restore()  # pre-corruption commit: quarantine keeps state
+        world_after = hvd.size()
+
+    ok = (rollback_report is not None and 0 < ratio <= 1.25
+          and clean_skips == 0 and skipped >= 1 and attributed == [victim])
+    result = {
+        "metric": "sdc_defense_recovery",
+        "value": round(ratio, 4),
+        "unit": "loss_ratio",
+        "vs_baseline": None,  # a CPU recovery drill has no throughput peer
+        "config": _config() + "_sdc",
+        "baseline_config": _config() + "_sdc",
+        "sdc": {
+            "steps": steps,
+            "guard": {
+                "clean_skips": clean_skips,
+                "poison_from_step": poison_from,
+                "skipped": skipped,
+                "streak_limit": int(os.environ["HOROVOD_GUARD_STREAK"]),
+            },
+            "rollback": {
+                "report": rollback_report,
+                "resumed_batch": (rollback_report["commit"] * commit_every
+                                  if rollback_report else None),
+                "parity_ratio": round(ratio, 4),
+                "snapshot_steps": int(os.environ["HOROVOD_SNAPSHOT_STEPS"]),
+            },
+            "tripwire": {
+                "victim_rank": victim,
+                "attributed": attributed,
+                "check_interval_commits": int(
+                    os.environ["HOROVOD_DESYNC_CHECK_STEPS"]),
+                "detected_within_commits": commits_to_detect,
+                "world_before": world,
+                "world_after": world_after,
+                "checks": int(reg.counter(
+                    "horovod_guard_tripwire_checks_total").value),
+                "trips": int(reg.counter(
+                    "horovod_guard_tripwire_trips_total").value),
+            },
+            "counters": {
+                "horovod_guard_steps_total": int(reg.counter(
+                    "horovod_guard_steps_total").value),
+                "horovod_guard_skipped_total": int(reg.counter(
+                    "horovod_guard_skipped_total").value),
+                "horovod_guard_rollbacks_total": int(reg.counter(
+                    "horovod_guard_rollbacks_total").value),
+            },
+        },
+    }
+    if not ok:
+        result["error"] = "sdc drill failed a gate (see sdc block)"
+    print(json.dumps(result), flush=True)
+    os._exit(0 if ok else 2)
 
 
 def _main_serving():
@@ -867,6 +1060,8 @@ def main():
         _main_autoscale()
     if ROOFLINE_BENCH:
         _main_roofline()
+    if SDC_BENCH:
+        _main_sdc()
     if OVERLAP and ZERO:
         sys.exit("BENCH_OVERLAP / HOROVOD_MICROBATCHES>1 is incompatible "
                  "with HOROVOD_ZERO=1 (the ZeRO arena exchange is already "

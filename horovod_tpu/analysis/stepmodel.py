@@ -168,7 +168,16 @@ def _attach_kernel_contracts(expected: ExpectedExchange
 def expected_exchange(params, meta: dict) -> ExpectedExchange:
     """Derive the collective contract for a step built with ``meta``
     (kernel-aware: see :func:`_attach_kernel_contracts`)."""
-    return _attach_kernel_contracts(_expected_exchange(params, meta))
+    expected = _attach_kernel_contracts(_expected_exchange(params, meta))
+    if meta.get("guard") and expected.supported:
+        # The SDC guard screen: one f32[2] psum (nonfinite count +
+        # grad-norm square) riding beside the gradient exchange,
+        # identical on every modeled path including world=1.  Priced
+        # here, NOT absorbed by the scalar-aux allowance -- elements==2
+        # is deliberate so an unmodeled auditor flags it.
+        expected.ops.append(ExpectedOp("psum", "float32", 2,
+                                       "guard/screen"))
+    return expected
 
 
 def _expected_exchange(params, meta: dict) -> ExpectedExchange:

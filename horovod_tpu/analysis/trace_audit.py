@@ -170,7 +170,11 @@ def audit_step(fn, *args,
     check; ``batch_stats`` declares a flax mutable-stats tree whose
     per-leaf averaging psums are accounted to the stats exchange.
     """
-    inner = getattr(fn, "_fn", fn)
+    # Builders may stack wrappers (_GuardedStep over _InstrumentedStep):
+    # unwrap every layer to reach the traceable callable.
+    inner = fn
+    while hasattr(inner, "_fn"):
+        inner = inner._fn
     if meta is None:
         meta = meta_from_step(fn)
     closed = jax.make_jaxpr(inner)(*args)

@@ -172,6 +172,29 @@ class Config:
     # Consecutive restore+sync attempts before a persistent desync aborts.
     desync_max_retries: int = 3
 
+    # Silent-data-corruption defense plane (core/guard.py).
+    # HOROVOD_GUARD=auto|1|0 compiles a cheap numeric screen (global
+    # nonfinite count + grad norm, one extra f32[2] psum) into every train
+    # step and skips the optimizer update on a poisoned step.  "auto"
+    # enables the guard only when a corruption scenario is plausibly in
+    # play (chaos injection, desync checks, snapshot ledger) so default
+    # traces stay bitwise identical to the unguarded build.
+    guard: str = "auto"
+    # Skip a step whose global grad norm exceeds this bound even when
+    # finite (HOROVOD_GUARD_NORM_LIMIT); 0 = nonfinite screening only.
+    guard_norm_limit: float = 0.0
+    # Consecutive guard-skipped steps before the anomaly counts as
+    # sustained and the rollback ledger engages (HOROVOD_GUARD_STREAK).
+    guard_streak: int = 3
+    # Snapshot/rollback ledger cadence in committed steps
+    # (HOROVOD_SNAPSHOT_STEPS); 0 disables the ring.
+    snapshot_steps: int = 0
+    # In-band cross-rank corruption tripwire cadence in steps
+    # (HOROVOD_DESYNC_CHECK_STEPS); 0 disables.  Unlike check_desync
+    # (every commit, debug-only) this samples every N train steps and
+    # attributes the corrupt rank for quarantine.
+    desync_check_steps: int = 0
+
     # Driver-side heartbeat eviction (seconds; 0 disables).  Workers whose
     # elastic heartbeat file goes stale longer than this are terminated and
     # blacklisted (HOROVOD_STALL_SHUTDOWN_TIME analogue at process level).
@@ -321,6 +344,11 @@ def load_config() -> Config:
         compile_cache=_env("COMPILE_CACHE"),
         check_desync=_env_bool("CHECK_DESYNC"),
         desync_max_retries=_env_int("DESYNC_MAX_RETRIES", 3),
+        guard=(_env("GUARD", "auto") or "auto").strip().lower(),
+        guard_norm_limit=_env_float("GUARD_NORM_LIMIT", 0.0),
+        guard_streak=_env_int("GUARD_STREAK", 3),
+        snapshot_steps=_env_int("SNAPSHOT_STEPS", 0),
+        desync_check_steps=_env_int("DESYNC_CHECK_STEPS", 0),
         heartbeat_timeout=_env_float("HEARTBEAT_TIMEOUT", 0.0),
         force_cpu=_env_bool("FORCE_CPU"),
         metrics_enabled=_env_bool("METRICS", True),

@@ -20,30 +20,81 @@ Two entry points:
   boundary is exactly where a silent desync would get checkpointed).
 * :func:`horovod_tpu.collectives.ops.desync_check` -- in-step: an integer
   bit-sum compared via pmax/pmin inside the traced program (see ops.py).
+* :func:`tripwire_check` -- the SDC corruption tripwire
+  (``HOROVOD_DESYNC_CHECK_STEPS``): one jitted shard_map computes a
+  per-DEVICE bit-checksum of the replicated params and allgathers the
+  vector; the host majority-votes and raises
+  :class:`~horovod_tpu.core.exceptions.CorruptRankError` naming the
+  minority rank(s), which the elastic plane quarantines.
 """
 
 from __future__ import annotations
 
-import pickle
 import zlib
 from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-from .exceptions import DesyncError
+from .exceptions import CorruptRankError, DesyncError
+
+
+def _canonical_bytes(obj, _depth: int = 0) -> bytes:
+    """Deterministic, version-stable byte encoding of a non-array leaf.
+
+    Pickle bytes are NOT stable across python/numpy minor versions (the
+    protocol's framing and numpy's reconstructor paths both change),
+    which made cross-rank comparison on heterogeneous hosts a
+    false-positive source.  This encoding depends only on the VALUE:
+    type-tagged reprs for scalars (float repr is the shortest round-trip
+    form, stable since python 3.1), recursive tagged encodings for
+    containers, with dict items sorted by encoded key and set elements
+    sorted by encoded value so iteration order never leaks in.
+    """
+    if _depth > 64:
+        raise TypeError("leaf nests too deeply for canonical encoding")
+    if obj is None or isinstance(obj, (bool, int)):
+        return f"{type(obj).__name__}:{obj!r}".encode()
+    if isinstance(obj, float):
+        return b"float:" + repr(obj).encode()
+    if isinstance(obj, complex):
+        return (b"complex:" + repr(obj.real).encode() + b"," +
+                repr(obj.imag).encode())
+    if isinstance(obj, str):
+        return b"str:" + obj.encode("utf-8", "surrogatepass")
+    if isinstance(obj, (bytes, bytearray)):
+        return b"bytes:" + bytes(obj)
+    if isinstance(obj, (list, tuple)):
+        parts = [_canonical_bytes(v, _depth + 1) for v in obj]
+        tag = b"list" if isinstance(obj, list) else b"tuple"
+        return tag + b"[" + b";".join(parts) + b"]"
+    if isinstance(obj, dict):
+        items = sorted(
+            (_canonical_bytes(k, _depth + 1),
+             _canonical_bytes(v, _depth + 1)) for k, v in obj.items())
+        return b"dict{" + b";".join(k + b"=" + v for k, v in items) + b"}"
+    if isinstance(obj, (set, frozenset)):
+        parts = sorted(_canonical_bytes(v, _depth + 1) for v in obj)
+        return b"set{" + b";".join(parts) + b"}"
+    # Plain objects: type-tagged instance state (what pickle would ship),
+    # NEVER the default repr -- that embeds the memory address, the
+    # round-2 review's false-desync case.
+    state = getattr(obj, "__dict__", None)
+    if isinstance(state, dict):
+        return (b"obj:" + type(obj).__qualname__.encode()
+                + _canonical_bytes(state, _depth + 1))
+    raise TypeError(f"no canonical encoding for {type(obj).__qualname__}")
 
 
 def _leaf_checksum(leaf) -> int:
     """Stable CRC32 of a leaf's host bytes (uint32).
 
-    Non-array leaves are checksummed via their pickle bytes, which (unlike
-    ``repr``) never embed per-process memory addresses.  Leaves that cannot
-    be pickled contribute only their type name -- such a leaf is
-    under-checked, never a false positive.  Caveat: containers whose
-    iteration order depends on the string hash seed (sets of strings) can
-    still pickle differently across processes; run workers with a fixed
-    ``PYTHONHASHSEED`` when such leaves are in elastic state.
+    Non-array leaves are checksummed via :func:`_canonical_bytes` -- a
+    value-only encoding that (unlike ``repr``) never embeds per-process
+    memory addresses and (unlike pickle) is stable across python/numpy
+    minor versions on heterogeneous hosts.  Leaves with no canonical
+    encoding contribute only their type name -- such a leaf is
+    under-checked, never a false positive.
     """
     try:
         a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
@@ -53,8 +104,8 @@ def _leaf_checksum(leaf) -> int:
     except (TypeError, ValueError):
         pass
     try:
-        return zlib.crc32(pickle.dumps(leaf, protocol=4))
-    except Exception:  # noqa: BLE001 - unpicklable leaf
+        return zlib.crc32(_canonical_bytes(leaf))
+    except Exception:  # noqa: BLE001 - unencodable leaf
         return zlib.crc32(type(leaf).__qualname__.encode())
 
 
@@ -118,3 +169,153 @@ def maybe_check(tree: Any, name: str = "state",
     if not st.initialized or st.config is None or not st.config.check_desync:
         return None
     return check_desync(tree, name=name, process_set=process_set)
+
+
+# --- cross-rank corruption tripwire (SDC defense plane) -------------------
+
+
+def _traced_bit_checksum(x):
+    """uint32 position-weighted wrapping bit-sum of a local array.
+
+    Same construction as ``collectives.ops.desync_check`` (Knuth-constant
+    odd weights, exact under any reduction order); here the per-device
+    value is KEPT rather than pmax/pmin-compared, because the tripwire
+    needs attribution, not just a boolean.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = jnp.asarray(x)
+    nbits = x.dtype.itemsize * 8
+    if x.dtype == jnp.bool_:
+        bits = x.astype(jnp.int32)
+    elif nbits >= 32:
+        bits = lax.bitcast_convert_type(x, jnp.int32)
+    elif jnp.issubdtype(x.dtype, jnp.floating):
+        bits = lax.bitcast_convert_type(
+            x, jnp.dtype(f"int{nbits}")).astype(jnp.int32)
+    else:
+        bits = x.astype(jnp.int32)
+    flat = bits.ravel()
+    if not flat.size:
+        return jnp.zeros((), jnp.uint32)
+    u = lax.bitcast_convert_type(flat, jnp.uint32)
+    w = (jnp.arange(flat.size, dtype=jnp.uint32)
+         * jnp.uint32(2654435761)) | jnp.uint32(1)
+    return jnp.sum(u * w, dtype=jnp.uint32)
+
+
+_TRIPWIRE_CACHE: dict = {}
+
+
+def build_tripwire(mesh=None):
+    """Jitted ``tree -> uint32[world]`` per-device replica checksums.
+
+    A SEPARATE executable from the train step (the tripwire samples every
+    ``HOROVOD_DESYNC_CHECK_STEPS`` steps; folding it into the step trace
+    would charge every step for it): one shard_map in which each device
+    checksums ITS OWN replica of the tree and an all_gather exposes the
+    whole vector for host-side majority voting.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..collectives import ops as _ops
+    from . import basics as _basics
+
+    mesh = mesh if mesh is not None else _basics.mesh()
+    fn = _TRIPWIRE_CACHE.get(mesh)
+    if fn is not None:
+        return fn
+    axes = tuple(mesh.axis_names)
+
+    def local(tree):
+        import jax.numpy as jnp
+        c = jnp.zeros((), jnp.uint32)
+        for leaf in jax.tree.leaves(tree):
+            # 31x combine keeps leaf order significant, like the
+            # per-position weights keep element order significant.
+            c = c * jnp.uint32(31) + _traced_bit_checksum(leaf)
+        # Routed through the ops layer (axis resolution + plan audit);
+        # device order is mesh-major, same as jax.devices().
+        return _ops.allgather(c[None], axes=axes, tiled=True).reshape(-1)
+
+    shard = jax.shard_map(local, mesh=mesh, in_specs=(P(),),
+                          out_specs=P(), check_vma=False)
+    fn = jax.jit(shard)
+    _TRIPWIRE_CACHE[mesh] = fn
+    return fn
+
+
+def tripwire_check(tree: Any, mesh=None, name: str = "params",
+                   raise_error: bool = True) -> List[int]:
+    """Cross-rank corruption tripwire: attribute divergent replicas.
+
+    Every device checksums its replica of ``tree``; a device whose
+    checksum disagrees with the strict majority holds a corrupt replica
+    (bitflip-class SDC -- finite values the numeric guard cannot see).
+    Returns the minority device indices and raises
+    :class:`CorruptRankError` (unless ``raise_error=False``) so the
+    elastic plane can quarantine them through the eviction/resize path.
+    Without a strict majority no attribution is possible and the error
+    carries an empty rank list (handled as a plain desync: restore).
+    """
+    from ..timeline import metrics as _metrics
+
+    rows = np.asarray(jax.device_get(build_tripwire(mesh)(tree)))
+    reg = _metrics.registry()
+    reg.counter("horovod_guard_tripwire_checks_total",
+                "Cross-rank corruption tripwire samples").inc()
+    vals, counts = np.unique(rows, return_counts=True)
+    if len(vals) <= 1:
+        return []
+    reg.counter("horovod_guard_tripwire_trips_total",
+                "Tripwire samples that found divergent replicas").inc()
+    majority = vals[np.argmax(counts)]
+    bad = [] if counts.max() * 2 <= rows.size else \
+        [int(i) for i in np.nonzero(rows != majority)[0]]
+    if raise_error:
+        raise CorruptRankError(
+            f"corruption tripwire: {name!r} replicas diverge across the "
+            f"mesh (checksums {rows.tolist()}); "
+            + (f"minority rank(s) {bad} attributed for quarantine"
+               if bad else "no strict majority, cannot attribute"),
+            ranks=bad)
+    return bad
+
+
+def corrupt_replica(tree: Any, rank: int, mesh=None, bit: int = 0) -> Any:
+    """Flip one bit in device ``rank``'s replica of the first float leaf.
+
+    Chaos-injection helper (``bitflip@`` kind): rebuilds the leaf with
+    ``jax.make_array_from_single_device_arrays`` so exactly one device's
+    copy differs -- byte 0's bit ``bit`` (the mantissa LSB for little-
+    endian floats), a finite perturbation no numeric screen can see.
+    This is precisely the fault class only the tripwire catches.
+    """
+    import jax.numpy as jnp
+
+    from . import basics as _basics
+
+    mesh = mesh if mesh is not None else _basics.mesh()
+    devices = list(mesh.devices.flat)
+    if not 0 <= int(rank) < len(devices):
+        raise ValueError(f"rank {rank} outside mesh of {len(devices)}")
+    victim = devices[int(rank)]
+    leaves, treedef = jax.tree.flatten(tree)
+    idx = next((i for i, v in enumerate(leaves)
+                if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                and jnp.asarray(v).size), None)
+    if idx is None:
+        raise ValueError("corrupt_replica: no floating leaf to corrupt")
+    leaf = leaves[idx]
+    host = np.asarray(jax.device_get(leaf))
+    bufs = []
+    for d in devices:
+        a = np.array(host, copy=True)
+        if d == victim:
+            raw = a.view(np.uint8)
+            raw.reshape(-1)[0] ^= np.uint8(1 << (int(bit) & 7))
+        bufs.append(jax.device_put(a, d))
+    leaves[idx] = jax.make_array_from_single_device_arrays(
+        host.shape, leaf.sharding, bufs)
+    return jax.tree.unflatten(treedef, leaves)

@@ -49,6 +49,40 @@ class DesyncError(HorovodInternalError):
         self.leaves = list(leaves or [])
 
 
+class SustainedAnomalyError(HorovodInternalError):
+    """The SDC guard skipped ``streak`` consecutive steps.
+
+    One poisoned step is absorbed in-trace (the guard selects the old
+    params/opt-state, bitwise); a sustained streak means the anomaly is
+    not transient -- a wedged input shard, a corrupt replica -- and
+    skipping forward cannot recover.  Subclasses
+    :class:`HorovodInternalError` so the elastic loop's restore-from-
+    last-commit path catches it; the snapshot ledger
+    (``elastic/state.py``) turns that restore into a rollback + replay.
+    """
+
+    def __init__(self, streak: int):
+        super().__init__(
+            f"SDC guard skipped {streak} consecutive steps; "
+            "rolling back to last good snapshot")
+        self.streak = int(streak)
+
+
+class CorruptRankError(DesyncError):
+    """The cross-rank tripwire attributed divergent state to rank(s).
+
+    Raised by :func:`horovod_tpu.core.desync.tripwire_check` when the
+    per-rank parameter checksums disagree AND a majority agrees on one
+    value: the minority rank(s) hold corrupt replicas (bitflip-class
+    SDC).  Carries the attributed ranks so the elastic plane can
+    quarantine them (evict + resize) instead of restarting blind.
+    """
+
+    def __init__(self, message: str, ranks=None, leaves=None):
+        super().__init__(message, leaves=leaves)
+        self.ranks = sorted(set(int(r) for r in (ranks or [])))
+
+
 class NotInitializedError(HorovodTpuError):
     """An API was called before ``hvd.init()``."""
 

@@ -1,0 +1,158 @@
+"""Silent-data-corruption (SDC) guard: in-step numeric screen policy.
+
+Large fleets lose runs to *wrong* values, not just dead ranks: a flipped
+bit or a NaN sails through every collective (the exchange is correctness-
+agnostic), poisons the error-feedback residuals, and is committed forever.
+The reference framework has stall detection but nothing numeric; this
+module is the host half of the defense plane:
+
+* ``HOROVOD_GUARD=auto|1|0`` decides, at step-BUILD time, whether the
+  train-step builders compile the screen into the trace (a global
+  nonfinite count plus a gradient-magnitude psum riding alongside the
+  existing loss allreduce -- one extra ``f32[2]`` psum per step).  The
+  in-trace policy selects the OLD params/opt-state wholesale on a
+  poisoned step, so a skipped step leaves params and EF residuals
+  bitwise untouched.
+* :class:`GuardPolicy` consumes the per-step guard vector
+  ``[nonfinite, grad_norm, skipped]`` on the host, feeds the
+  ``horovod_guard_*`` metric family, and raises
+  :class:`~horovod_tpu.core.exceptions.SustainedAnomalyError` after
+  ``HOROVOD_GUARD_STREAK`` consecutive skips so the elastic loop /
+  snapshot ledger rolls back instead of spinning on a poisoned input.
+
+``auto`` (the default) arms the guard only when a corruption scenario is
+plausibly in play -- a corruption chaos kind (``bitflip``/``nan``)
+installed, desync checks on, the snapshot ledger or the cross-rank
+tripwire enabled -- so default-config traces stay bitwise identical to
+an unguarded build (the scan-loop parity and audit baselines never see
+a guard leg they did not ask for).  Latency/availability chaos kinds
+(``slow``, ``kill``, ...) do NOT arm it: they cannot corrupt numerics,
+and timing drills expect attribution-neutral steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .exceptions import SustainedAnomalyError
+
+_TRUE = ("1", "on", "true", "yes")
+_FALSE = ("0", "off", "false", "no")
+
+
+def _config():
+    from .state import global_state
+    return global_state().config
+
+
+def resolve_mode(config=None) -> bool:
+    """Should the builders compile the guard screen into the step trace?
+
+    Resolved once per step BUILD (not per call): the screen changes the
+    traced program.  ``1``/``0`` force; ``auto`` arms iff a corruption
+    chaos kind (bitflip/nan) is installed or any of ``check_desync`` /
+    ``desync_check_steps`` / ``snapshot_steps`` is active.
+    """
+    cfg = _config() if config is None else config
+    mode = (getattr(cfg, "guard", "auto") or "auto").strip().lower() \
+        if cfg is not None else "auto"
+    if mode in _TRUE:
+        return True
+    if mode in _FALSE:
+        return False
+    if mode != "auto":
+        raise ValueError(
+            f"HOROVOD_GUARD must be auto|1|0, got {mode!r}")
+    if cfg is None:
+        return False
+    if cfg.check_desync or cfg.desync_check_steps > 0 \
+            or cfg.snapshot_steps > 0:
+        return True
+    from ..elastic import chaos
+    return chaos.corruption_armed()
+
+
+def step_guard(config=None) -> Tuple[bool, float]:
+    """``(enabled, norm_limit)`` for the train-step builders."""
+    cfg = _config() if config is None else config
+    enabled = resolve_mode(cfg)
+    limit = float(getattr(cfg, "guard_norm_limit", 0.0) or 0.0) \
+        if cfg is not None else 0.0
+    return enabled, limit
+
+
+class GuardPolicy:
+    """Host-side consumer of the in-step guard vector.
+
+    ``observe`` takes the step's ``[nonfinite, grad_norm, skipped]`` row
+    (or the ``[k, 3]`` stack a scan loop emits), updates the
+    ``horovod_guard_*`` metrics, and tracks the consecutive-skip streak.
+    A streak reaching ``streak_limit`` raises
+    :class:`SustainedAnomalyError` -- the signal that skipping alone is
+    not recovering the run and the rollback ledger must engage.
+    """
+
+    def __init__(self, streak_limit: int = 3):
+        self.streak_limit = max(1, int(streak_limit))
+        self.streak = 0
+        self.steps = 0
+        self.skipped = 0
+
+    def observe(self, rows) -> int:
+        """Consume guard rows; returns how many steps were skipped."""
+        rows = np.atleast_2d(np.asarray(rows, dtype=np.float64))
+        from ..timeline import metrics as _metrics
+        reg = _metrics.registry()
+        steps_c = reg.counter(
+            "horovod_guard_steps_total",
+            "Train steps screened by the SDC guard")
+        skip_c = reg.counter(
+            "horovod_guard_skipped_total",
+            "Optimizer updates skipped by the SDC guard (poisoned steps)")
+        skipped_here = 0
+        last_norm = None
+        for row in rows:
+            self.steps += 1
+            steps_c.inc()
+            if float(row[2]) > 0.0:
+                self.skipped += 1
+                self.streak += 1
+                skipped_here += 1
+                skip_c.inc()
+            else:
+                self.streak = 0
+            last_norm = float(row[1])
+        if last_norm is not None:
+            reg.gauge(
+                "horovod_guard_grad_norm",
+                "Global gradient-magnitude screen from the last guarded "
+                "step (-1 when nonfinite)").set(
+                last_norm if np.isfinite(last_norm) else -1.0)
+        reg.gauge(
+            "horovod_guard_streak",
+            "Consecutive guard-skipped steps (rollback trips at "
+            "HOROVOD_GUARD_STREAK)").set(float(self.streak))
+        if self.streak >= self.streak_limit:
+            raise SustainedAnomalyError(self.streak)
+        return skipped_here
+
+
+_policy: Optional[GuardPolicy] = None
+
+
+def policy() -> GuardPolicy:
+    """Process-wide policy singleton (streak limit from config)."""
+    global _policy
+    if _policy is None:
+        cfg = _config()
+        _policy = GuardPolicy(
+            streak_limit=getattr(cfg, "guard_streak", 3) if cfg else 3)
+    return _policy
+
+
+def reset() -> None:
+    """Drop the singleton (tests; re-init picks up fresh config)."""
+    global _policy
+    _policy = None

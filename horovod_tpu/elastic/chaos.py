@@ -29,9 +29,25 @@ Each fault clause is ``<kind>@step=<k>[,rank=<r>|rank=any][,secs=<t>]
 - ``slow``: the target rank's host thread sleeps ``secs`` at the step
   boundary -- a deterministic straggler for the cross-rank trace plane
   (``timeline/straggler.py``) to detect and attribute.
+- ``nan``: latches a one-shot input-poisoning notice; the training
+  driver consumes it via :func:`consume_nan_poison` /
+  :func:`poison_batch` and NaNs one element of the next batch.  The
+  in-step SDC guard (``HOROVOD_GUARD``) must detect and skip that step.
+- ``bitflip``: latches a one-shot replica-corruption notice carrying
+  the victim rank; the driver consumes it via :func:`consume_bitflip`
+  and flips one mantissa bit in that rank's parameter replica
+  (:func:`horovod_tpu.core.desync.corrupt_replica`).  The values stay
+  finite, so only the cross-rank tripwire
+  (``HOROVOD_DESYNC_CHECK_STEPS``) catches it -- the SDC drill the
+  quarantine path is proved against.
 
 ``rank=any`` picks a victim with the seeded RNG -- identical on every
 process because the choice depends only on (seed, fault index, size).
+``secs=`` is accepted only on the duration kinds (``kv_blackout``,
+``hb_drop``, ``slow``); the others reject it instead of silently
+dropping it.  ``nan``/``bitflip`` clauses fire on EVERY process at the
+given step (the latch records the victim rank) because the victim's
+host may not be the process that owns the injection point.
 """
 
 from __future__ import annotations
@@ -50,7 +66,12 @@ logger = logging.getLogger("horovod_tpu.elastic")
 _ENV = "HOROVOD_CHAOS"
 _ENV_ALT = "HVD_TPU_CHAOS"
 
-_KINDS = ("kill", "sigterm", "comm", "kv_blackout", "hb_drop", "slow")
+_KINDS = ("kill", "sigterm", "comm", "kv_blackout", "hb_drop", "slow",
+          "bitflip", "nan")
+# Kinds with a duration; only these accept a secs= field.
+_DURATION_KINDS = ("kv_blackout", "hb_drop", "slow")
+# Corruption kinds fire on every process (the latch carries the victim).
+_CORRUPTION_KINDS = ("bitflip", "nan")
 
 
 class ChaosSpecError(ValueError):
@@ -94,7 +115,8 @@ def parse_spec(spec: str) -> (int, List[ChaosFault]):
         if "@" not in clause:
             raise ChaosSpecError(
                 f"bad chaos clause {clause!r}: expected "
-                f"<kind>@step=<k>[,rank=<r>][,secs=<t>][,at=sync]")
+                f"<kind>@step=<k>[,rank=<r>|rank=any][,secs=<t>][,at=sync] "
+                f"with kind in {_KINDS} (secs= only on {_DURATION_KINDS})")
         kind, _, rest = clause.partition("@")
         kind = kind.strip()
         if kind not in _KINDS:
@@ -115,6 +137,11 @@ def parse_spec(spec: str) -> (int, List[ChaosFault]):
             elif key == "rank":
                 rank = None if val == "any" else int(val)
             elif key == "secs":
+                if kind not in _DURATION_KINDS:
+                    raise ChaosSpecError(
+                        f"secs= does not apply to {kind!r} faults "
+                        f"(duration kinds: {_DURATION_KINDS}); rejecting "
+                        f"{clause!r} instead of silently dropping it")
                 secs = float(val)
             elif key == "at":
                 if val != "sync":
@@ -194,6 +221,10 @@ class ChaosInjector:
             logger.warning("chaos: slowing rank %d by %.3fs at step %d",
                            self.rank, f.secs, self.step)
             time.sleep(max(0.0, f.secs))
+        elif f.kind == "nan":
+            _set_nan_poison(f.rank if f.rank is not None else 0)
+        elif f.kind == "bitflip":
+            _set_bitflip(f.rank if f.rank is not None else 0)
 
     def on_step(self, step: Optional[int] = None) -> None:
         """Advance the chaos clock and fire any due faults.
@@ -201,6 +232,8 @@ class ChaosInjector:
         Without an explicit ``step`` the injector's own monotone commit
         counter is used (replayed commits after a rollback count as new
         chaos steps; the once-only latch keeps faults from re-firing).
+        Corruption kinds (``bitflip``/``nan``) fire on every process --
+        the victim rank rides in the latch, not in the firing condition.
         """
         if step is None:
             self.step += 1
@@ -208,8 +241,9 @@ class ChaosInjector:
         else:
             self.step = int(step)
         for f in self.faults:
-            if (not f.fired and f.step == self.step
-                    and f.rank == self.rank):
+            if not f.fired and f.step == self.step and (
+                    f.rank == self.rank
+                    or f.kind in _CORRUPTION_KINDS):
                 self._fire(f)
 
 
@@ -221,11 +255,63 @@ _env_checked = False
 _kv_blackout_until = 0.0
 _hb_drop_until = 0.0
 _armed_comm_error: Optional[ChaosCommError] = None
+# One-shot corruption latches: the pending victim rank, or None.
+_nan_poison_pending: Optional[int] = None
+_bitflip_pending: Optional[int] = None
 
 
 def _set_kv_blackout(secs: float) -> None:
     global _kv_blackout_until
     _kv_blackout_until = time.monotonic() + max(0.0, secs)
+
+
+def _set_nan_poison(rank: int) -> None:
+    global _nan_poison_pending
+    _nan_poison_pending = int(rank)
+
+
+def _set_bitflip(rank: int) -> None:
+    global _bitflip_pending
+    _bitflip_pending = int(rank)
+
+
+def consume_nan_poison() -> Optional[int]:
+    """One-shot: the pending ``nan`` victim rank, or None.
+
+    The training driver calls this before each dispatch and poisons the
+    next batch (:func:`poison_batch`) when it returns a rank."""
+    global _nan_poison_pending
+    rank, _nan_poison_pending = _nan_poison_pending, None
+    return rank
+
+
+def consume_bitflip() -> Optional[int]:
+    """One-shot: the pending ``bitflip`` victim rank, or None.
+
+    The consumer flips one bit in that rank's parameter replica
+    (:func:`horovod_tpu.core.desync.corrupt_replica`)."""
+    global _bitflip_pending
+    rank, _bitflip_pending = _bitflip_pending, None
+    return rank
+
+
+def poison_batch(batch):
+    """NaN one element of the first floating leaf of ``batch`` (eagerly,
+    host-side -- the poisoned value flows into the next dispatch exactly
+    like a corrupt input shard would)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(batch)
+    for i, leaf in enumerate(leaves):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating) and arr.size:
+            flat = arr.reshape(-1).at[0].set(jnp.nan)
+            leaves[i] = flat.reshape(arr.shape)
+            break
+    else:
+        raise ValueError("poison_batch: no floating leaf to poison")
+    return jax.tree.unflatten(treedef, leaves)
 
 
 def _set_hb_drop(secs: float) -> None:
@@ -291,6 +377,19 @@ def injector() -> Optional[ChaosInjector]:
     return _injector
 
 
+def corruption_armed() -> bool:
+    """Does the installed spec include a corruption kind (bitflip/nan)?
+
+    The guard's ``auto`` mode keys on this rather than on injector
+    presence: latency/availability faults (``slow``, ``kill``, ...)
+    cannot corrupt numerics, and arming the screen for them would add a
+    guard leg -- and its host sync -- to traces that drills like the
+    straggler probe expect to be attribution-neutral.
+    """
+    return _injector is not None and any(
+        f.kind in _CORRUPTION_KINDS for f in _injector.faults)
+
+
 def on_commit() -> None:
     """Commit-boundary hook: advance the injector clock if installed."""
     if _injector is not None:
@@ -300,10 +399,12 @@ def on_commit() -> None:
 def reset() -> None:
     """Drop the injector and clear every latch (tests only)."""
     global _injector, _env_checked, _kv_blackout_until, _hb_drop_until
-    global _armed_comm_error
+    global _armed_comm_error, _nan_poison_pending, _bitflip_pending
     with _lock:
         _injector = None
         _env_checked = False
         _kv_blackout_until = 0.0
         _hb_drop_until = 0.0
         _armed_comm_error = None
+        _nan_poison_pending = None
+        _bitflip_pending = None

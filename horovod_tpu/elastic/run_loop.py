@@ -28,8 +28,9 @@ from typing import Any, Callable
 import jax
 
 from ..core import basics as _basics
-from ..core.exceptions import (DesyncError, HorovodInternalError,
-                               HostsUpdatedInterrupt)
+from ..core.exceptions import (CorruptRankError, DesyncError,
+                               HorovodInternalError, HostsUpdatedInterrupt,
+                               SustainedAnomalyError)
 from ..core.stall import heartbeat_path  # noqa: F401  (re-export)
 from .notify import Notifier
 from .state import State
@@ -281,6 +282,27 @@ def apply_resize(state, old_size, new_size) -> None:
     state.on_reset()
 
 
+def _rollback_or_restore(state) -> None:
+    """Recover committed state, preferring the snapshot ledger.
+
+    ``rollback()`` (JaxState, HOROVOD_SNAPSHOT_STEPS > 0) steps back to a
+    pre-anomaly ledger entry -- the last *commit* may already hold
+    poisoned state.  When the ledger is off/empty (or the carrier has no
+    ledger) this degrades to plain ``restore()``.
+    """
+    rollback = getattr(state, "rollback", None)
+    if rollback is not None:
+        try:
+            report = rollback()
+            if report is not None:
+                logger.warning("rolled back to ledger snapshot %s", report)
+                return
+        except Exception:
+            logger.exception("snapshot-ledger rollback failed; falling "
+                             "back to plain restore")
+    state.restore()
+
+
 def _elastic_loop(func, state, notifier, args, kwargs):
     from . import preemption
 
@@ -321,6 +343,45 @@ def _elastic_loop(func, state, notifier, args, kwargs):
         except HostsUpdatedInterrupt:
             logger.info("hosts updated; re-rendezvousing")
             reset_required = True
+        except CorruptRankError as e:
+            # The in-band tripwire attributed divergent replicas to
+            # specific rank(s) by majority vote -- bitflip-class SDC, not
+            # a membership change.  The attributed rank must not carry
+            # its replica forward: it leaves at this boundary (the
+            # driver's next epoch excludes it, the same teardown the
+            # heartbeat-eviction path uses), while survivors roll back
+            # past the corruption window and re-rendezvous into the
+            # shrunk world.
+            my_rank = _basics.rank() if _basics.is_initialized() else None
+            if my_rank is not None and my_rank in e.ranks:
+                logger.error("tripwire attributed THIS rank (%d) as "
+                             "corrupt; exiting for quarantine", my_rank)
+                raise
+            logger.warning("tripwire attributed corrupt rank(s) %s; "
+                           "rolling back and re-rendezvousing without "
+                           "them", e.ranks)
+            _rollback_or_restore(state)
+            reset_required = True
+        except SustainedAnomalyError as e:
+            # The in-step guard skipped HOROVOD_GUARD_STREAK consecutive
+            # updates: skipping forward cannot recover, but no membership
+            # change happened either -- roll back (ledger-first) and let
+            # the loop-top sync() replay from the snapshot.  Shares the
+            # desync consecutive-failure cap: an anomaly that survives
+            # rollback+replay (deterministically poisoned input) must not
+            # spin this loop forever.
+            commits = getattr(state, "_commit_count", 0)
+            if commit_baseline is not None and commits > commit_baseline:
+                desync_retries = 0
+            commit_baseline = commits
+            desync_retries += 1
+            cap = _desync_max_retries()
+            if desync_retries > cap:
+                logger.error("sustained anomaly persisted through %d "
+                             "rollback+replay attempts; giving up", cap)
+                raise
+            logger.warning("%s (attempt %d/%d)", e, desync_retries, cap)
+            _rollback_or_restore(state)
         except DesyncError as e:
             # Raised symmetrically on every rank by the commit-boundary
             # checksum (the check runs BEFORE the snapshot is overwritten,

@@ -20,6 +20,30 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+# Snapshot-ledger ring depth (entries, not steps): deep enough to step
+# back past a corruption window, bounded so host RAM stays O(model).
+# Cadence comes from HOROVOD_SNAPSHOT_STEPS; depth is deliberately not a
+# knob -- 4 entries x N-step cadence already spans 4N steps of history.
+LEDGER_DEPTH = 4
+
+
+def _snapshot_steps() -> int:
+    """HOROVOD_SNAPSHOT_STEPS from the live config (0 = ledger off)."""
+    from ..core.state import global_state
+    st = global_state()
+    if st.initialized and st.config is not None:
+        return max(0, int(st.config.snapshot_steps))
+    return 0
+
+
+def _desync_check_steps() -> int:
+    """HOROVOD_DESYNC_CHECK_STEPS from the live config (0 = off)."""
+    from ..core.state import global_state
+    st = global_state()
+    if st.initialized and st.config is not None:
+        return max(0, int(st.config.desync_check_steps))
+    return 0
+
 
 def _tree_is_sharded(tree, world: int) -> bool:
     """True when every array leaf carries a leading ``[world, ...]``
@@ -147,19 +171,109 @@ class JaxState(State):
                 self._tree_keys.append(k)
         self._saved_trees: Dict[str, Any] = {}
         self._saved_scalars: Dict[str, Any] = {}
+        # Snapshot/rollback ledger (SDC defense plane): a bounded ring of
+        # past committed carries, pushed every HOROVOD_SNAPSHOT_STEPS
+        # commits.  restore() only reaches the LAST commit -- useless
+        # when the last commit itself snapshotted already-corrupt state
+        # (a bitflip rides undetected until the next tripwire sample);
+        # rollback() steps back to a pre-anomaly entry instead.
+        self._ledger: List[Dict[str, Any]] = []
         self.commit()
 
     def commit(self) -> None:
         self._check_desync({
             "trees": {k: getattr(self, k) for k in self._tree_keys},
             "scalars": {k: getattr(self, k) for k in self._scalar_keys}})
+        self._maybe_tripwire()
         # Host-RAM snapshot (device_get): survives device-state loss on
         # preemption/rescale, the whole point of elastic commit.
         self._saved_trees = {
             k: jax.device_get(getattr(self, k)) for k in self._tree_keys}
         self._saved_scalars = {
             k: copy.deepcopy(getattr(self, k)) for k in self._scalar_keys}
+        self._ledger_push()
         self._check_host_updates()
+
+    def _maybe_tripwire(self) -> None:
+        """In-band corruption tripwire, every HOROVOD_DESYNC_CHECK_STEPS
+        commits: bit-checksum each replicated tree on every device and
+        attribute any divergence to the minority rank(s) by majority
+        vote (:class:`~horovod_tpu.core.exceptions.CorruptRankError`).
+
+        Runs BEFORE the snapshot refresh -- like ``_check_desync`` -- so
+        the last committed copy is still the converged one when the
+        error propagates.  Sharded trees (the ZeRO arena) are skipped:
+        their replicas differ by construction, so only trees whose every
+        leaf claims full replication can testify.
+        """
+        from ..core.desync import tripwire_check
+        n = _desync_check_steps()
+        if n <= 0 or self._commit_count % n:
+            return
+        for k in self._tree_keys:
+            tree = getattr(self, k)
+            leaves = [l for l in jax.tree.leaves(tree)
+                      if hasattr(l, "sharding")]
+            if leaves and all(l.sharding.is_fully_replicated
+                              for l in leaves):
+                tripwire_check(tree, name=k)
+
+    def _ledger_push(self) -> None:
+        """Ring-buffer the snapshot just taken, every N commits.
+
+        Entries alias the snapshot's host arrays (device_get output is
+        never mutated in place, only replaced) but copy the dicts and
+        scalars, so a later commit/resize cannot rewrite history.  The
+        scalar copy is what makes rollback sampler-offset-aware: the
+        batch/epoch counters rewind WITH the params, so the replay
+        consumes the same data the rolled-back steps did.
+        """
+        n = _snapshot_steps()
+        if n <= 0:
+            return
+        # _commit_count is pre-increment here (it advances inside
+        # _check_host_updates): entry 0 is the constructor's commit, so
+        # a rollback floor always exists.
+        if self._commit_count % n:
+            return
+        self._ledger.append({
+            "commit": self._commit_count,
+            "trees": dict(self._saved_trees),
+            "scalars": copy.deepcopy(self._saved_scalars)})
+        while len(self._ledger) > LEDGER_DEPTH:
+            self._ledger.pop(0)
+
+    def rollback(self, before_commit: Optional[int] = None
+                 ) -> Optional[Dict[str, Any]]:
+        """Roll back to a ledger snapshot and make it current.
+
+        Picks the newest entry with ``commit <= before_commit`` (pass the
+        last commit known good -- e.g. detection commit minus the
+        tripwire interval -- or None for the newest), DROPS the newer
+        entries (they may hold poisoned state: that is why plain
+        ``restore()`` is not enough), installs the entry as the committed
+        snapshot, and restores it onto the live attributes.  Returns a
+        report dict, or None when the ledger has no eligible entry (the
+        caller falls back to ``restore()``).
+        """
+        entry = None
+        while self._ledger:
+            e = self._ledger[-1]
+            if before_commit is None or e["commit"] <= int(before_commit):
+                entry = e
+                break
+            self._ledger.pop()
+        if entry is None:
+            return None
+        self._saved_trees = dict(entry["trees"])
+        self._saved_scalars = copy.deepcopy(entry["scalars"])
+        from ..timeline import metrics as _metrics
+        _metrics.registry().counter(
+            "horovod_guard_rollbacks_total",
+            "Snapshot-ledger rollbacks (sustained anomaly / corrupt "
+            "replica recoveries)").inc()
+        self.restore()
+        return {"commit": entry["commit"], "depth": len(self._ledger)}
 
     def restore(self) -> None:
         # Steps rolled back = the recovery replay cost; exported as

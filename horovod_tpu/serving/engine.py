@@ -293,6 +293,26 @@ class ServingEngine:
         return [s for s, r in self.scheduler.active.items()
                 if r.state != "prefill"]
 
+    def _quarantine_logits(self, st: Dict[str, Any], slot: int,
+                           req: Request) -> None:
+        """A slot produced nonfinite logits: never stream a token
+        sampled from a poisoned distribution.
+
+        The slot's resident KV (or the dispatch that read it) is
+        suspect, so rebuild the context from the request's own token
+        history via :meth:`re_prefill` -- ``write_prefill`` re-derives
+        the slot's length and page mapping from scratch, so the
+        quarantine cannot leak pages -- and retry the same position on
+        the next round.  The request keeps its slot and emitted prefix;
+        only the round is lost.
+        """
+        from ..timeline import metrics as _metrics
+        _metrics.registry().counter(
+            "horovod_guard_serving_reprefills_total",
+            "Decode rounds where a slot's nonfinite logits were "
+            "quarantined by re-prefilling its context").inc()
+        st["last_tokens"][slot] = self.re_prefill(slot, req)
+
     # -- one decode round (shared with serving.controlplane) ---------------
     def decode_once(self, st: Dict[str, Any], now) -> float:
         """One plain continuous-batching decode step over live slots.
@@ -321,11 +341,17 @@ class ServingEngine:
         t0 = time.monotonic()
         logits, cache.k, cache.v = self.step(*args)
         sampled = np.asarray(greedy_sample(logits))  # sync point
+        # Per-slot SDC screen: one reduced scalar per row (sum propagates
+        # any NaN/Inf in the vocab axis), fetched with the sample.
+        finite = np.isfinite(np.asarray(jnp.sum(logits, axis=-1)))
         step_s = time.monotonic() - t0
         st["decode_steps"] += 1
         st["occ_samples"].append(sched.occupancy)
         for slot in slots:
             req = sched.active[slot]
+            if not finite[slot]:
+                self._quarantine_logits(st, slot, req)
+                continue
             tok = int(sampled[slot])
             req.tokens.append(tok)
             cache.lengths[slot] += 1
@@ -372,12 +398,20 @@ class ServingEngine:
         t0 = time.monotonic()
         logits, cache.k, cache.v = self.verify_step(*args)
         sampled = np.asarray(greedy_sample(logits))  # [slots, width]
+        # Per-slot SDC screen across every verify column: a poisoned
+        # column anywhere in the window disqualifies the whole round for
+        # that slot (the agreeing-prefix walk would condition on it).
+        finite = np.isfinite(
+            np.asarray(jnp.sum(logits, axis=(-2, -1))))
         step_s = time.monotonic() - t0
         st["decode_steps"] += 1
         st["spec_rounds"] = st.get("spec_rounds", 0) + 1
         st["occ_samples"].append(sched.occupancy)
         for s in slots:
             req = reqs[s]
+            if not finite[s]:
+                self._quarantine_logits(st, s, req)
+                continue
             # Longest agreeing prefix: draft j survives iff every
             # earlier draft did AND it equals the target's argmax for
             # the position it sits at.

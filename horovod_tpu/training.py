@@ -250,6 +250,54 @@ def _is_ef_exchange(exchange) -> bool:
     return is_error_feedback(exchange["compression"])
 
 
+def _resolve_guard() -> Tuple[bool, float]:
+    """``(guard_on, norm_limit)`` from ``HOROVOD_GUARD`` (core/guard.py).
+
+    Resolved at step-BUILD time: the screen is part of the traced
+    program.  ``auto`` (default) arms only when chaos injection or the
+    desync/snapshot planes are active, so default builds stay bitwise
+    identical to the unguarded trace."""
+    from .core import guard as _guard
+    return _guard.step_guard()
+
+
+def _guard_screen_vec(grads):
+    """Local half of the SDC screen: ``[nonfinite_count, sq_sum]`` f32[2].
+
+    Summed across ranks with ONE extra psum (float32 on purpose: the
+    audit fence flags scalar int32 psums as barrier-shaped).  The norm
+    half is a magnitude SCREEN (sqrt of the global sum of local squared
+    norms), not the exact norm of the averaged gradient -- it saturates
+    to inf for |g| beyond ~1e19, which the policy treats as poisoned."""
+    nonf = jnp.zeros((), jnp.float32)
+    sq = jnp.zeros((), jnp.float32)
+    for g in jax.tree.leaves(grads):
+        if jnp.issubdtype(g.dtype, jnp.inexact):
+            g32 = g.astype(jnp.float32)
+            nonf = nonf + jnp.sum(~jnp.isfinite(g32)).astype(jnp.float32)
+            sq = sq + jnp.sum(jnp.square(g32))
+        # Integer leaves are always finite and carry no norm.
+    return jnp.stack([nonf, sq])
+
+
+def _guard_verdict(gvec, norm_limit):
+    """``(nonfinite, norm, bad)`` from the psum'd screen vector."""
+    nonfinite = gvec[0]
+    norm = jnp.sqrt(gvec[1])
+    bad = (nonfinite > 0) | ~jnp.isfinite(norm)
+    if norm_limit and norm_limit > 0:
+        bad = bad | (norm > norm_limit)
+    return nonfinite, norm, bad
+
+
+def _guard_select(bad, old_tree, new_tree):
+    """Poisoned step -> keep the OLD tree wholesale (bitwise: params and
+    EF residuals provably untouched -- the whole old carry is selected,
+    not recomputed)."""
+    return jax.tree.map(lambda o, n: jnp.where(bad, o, n),
+                        old_tree, new_tree)
+
+
 def stack_steps(batches) -> Any:
     """Stack k per-step batches into the scanned layout ``make_train_loop``
     consumes: each leaf gains a leading steps axis ``[k, batch, ...]``."""
@@ -328,41 +376,55 @@ def make_train_step(
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
+    guard_on, guard_limit = _resolve_guard()
     if k_micro > 1:
         inner, exchange = _microbatch_unwrap(optimizer)
         local_step = _build_microbatch_local_step(
             loss_fn, inner, exchange, axes, loss_has_aux, aux_mode,
-            with_frozen, k_micro)
+            with_frozen, k_micro, guard=guard_on,
+            guard_norm_limit=guard_limit)
     else:
         local_step = _build_local_step(loss_fn, optimizer, axes,
                                        loss_has_aux, aux_mode, with_frozen,
-                                       zero_stage, zero_compression)
+                                       zero_stage, zero_compression,
+                                       guard=guard_on,
+                                       guard_norm_limit=guard_limit)
 
     aux_spec = () if not loss_has_aux else \
         ((P(),) if aux_mode == "averaged" else (P(axes),))
+    guard_spec = (P(),) if guard_on else ()
     frozen_spec = (P(),) if with_frozen else ()
     opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
     shard = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), opt_spec, P(axes)) + frozen_spec,
-        out_specs=(P(), opt_spec, P()) + aux_spec,
+        out_specs=(P(), opt_spec, P()) + aux_spec + guard_spec,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
-    return _maybe_tuned(shard, donate_argnums, loss_index=2,
-                        meta={"optimizer": optimizer,
-                              "zero_stage": zero_stage,
-                              "zero_compression": zero_compression,
-                              "microbatches": k_micro,
-                              "world": int(mesh.devices.size)})
+    meta = {"optimizer": optimizer,
+            "zero_stage": zero_stage,
+            "zero_compression": zero_compression,
+            "microbatches": k_micro,
+            "guard": guard_on,
+            "world": int(mesh.devices.size)}
+    step = _maybe_tuned(shard, donate_argnums, loss_index=2, meta=meta)
+    return _GuardedStep(step, meta) if guard_on else step
 
 
 def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
-                      with_frozen, zero_stage, zero_compression):
+                      with_frozen, zero_stage, zero_compression,
+                      guard=False, guard_norm_limit=0.0):
     """The per-device step body shared by :func:`make_train_step` (one
     shard_map call) and :func:`make_train_loop` (the ``lax.scan`` body).
     Sharing the exact closure is what makes the k-step loop bitwise
-    identical to k sequential step calls."""
+    identical to k sequential step calls.
+
+    With ``guard`` the SDC screen psums the raw LOCAL gradients' nonfinite
+    count and squared norm (one extra f32[2] psum, before any exchange or
+    update) and a poisoned step selects the OLD params/opt-state carry
+    wholesale; the step then emits a trailing replicated ``f32[3]``
+    ``[nonfinite, grad_norm, skipped]`` vector for the host policy."""
 
     def local_step(params, opt_state, batch, *frozen):
         lf = (lambda p, b: loss_fn(p, frozen[0], b)) if with_frozen \
@@ -373,6 +435,9 @@ def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
         else:
             loss, grads = jax.value_and_grad(lf)(params, batch)
             aux = None
+        if guard:
+            old_params, old_opt = params, opt_state
+            gvec = _ops.allreduce(_guard_screen_vec(grads), Sum, axes=axes)
         if zero_stage:
             params, opt_state = _zero.zero_apply(
                 optimizer, grads, opt_state, params, axes=axes,
@@ -380,14 +445,23 @@ def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
         else:
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+        if guard:
+            nonfinite, norm, bad = _guard_verdict(gvec, guard_norm_limit)
+            params = _guard_select(bad, old_params, params)
+            opt_state = _guard_select(bad, old_opt, opt_state)
+            guard_out = jnp.stack([nonfinite, norm,
+                                   bad.astype(jnp.float32)])
         loss = _ops.allreduce(loss, Average, axes=axes)
+        out = (params, opt_state, loss)
         if loss_has_aux:
             if aux_mode == "averaged":
                 aux = jax.tree.map(
                     lambda v: _ops.allreduce(v, Average, axes=axes)
                     if jnp.issubdtype(v.dtype, jnp.floating) else v, aux)
-            return params, opt_state, loss, aux
-        return params, opt_state, loss
+            out = out + (aux,)
+        if guard:
+            out = out + (guard_out,)
+        return out
 
     return local_step
 
@@ -507,7 +581,8 @@ def _split_microbatches(tree, k):
 
 
 def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
-                                 loss_has_aux, aux_mode, with_frozen, k):
+                                 loss_has_aux, aux_mode, with_frozen, k,
+                                 guard=False, guard_norm_limit=0.0):
     """Per-device step body for ``microbatches=k > 1``: an UNROLLED loop
     over k sub-batches whose trace interleaves each microbatch's bucket
     reduce-scatters between backward segments (the HLO-structure the
@@ -549,6 +624,17 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
             losses.append(loss_i)
             state = accumulate(grads, state)
         reduced = finalize(state, k, grads)
+        if guard:
+            # Screen the merged gradient (already cross-rank for a wrapped
+            # exchange): nonfinite sub-batch contributions have propagated
+            # into it by now, and screening BEFORE ef_exchange/update means
+            # the skip select below discards the residuals a poisoned
+            # exchange would have produced.
+            # opt_state here is still the incoming carry (normalized to
+            # _EFState on the ef path), structure-matched to the new one.
+            old_params, old_opt = params, opt_state
+            gvec = _ops.allreduce(_guard_screen_vec(reduced), Sum,
+                                  axes=axes)
         if ef:
             reduced, new_res = _dist.ef_exchange(
                 reduced, residuals, compression=exchange["compression"],
@@ -561,8 +647,15 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
             tuple(r[None] for r in new_res), inner_state) if ef \
             else inner_state
         params = optax.apply_updates(params, updates)
+        if guard:
+            nonfinite, norm, bad = _guard_verdict(gvec, guard_norm_limit)
+            params = _guard_select(bad, old_params, params)
+            opt_state = _guard_select(bad, old_opt, opt_state)
+            guard_out = jnp.stack([nonfinite, norm,
+                                   bad.astype(jnp.float32)])
         loss = _ops.allreduce(jnp.mean(jnp.stack(losses)), Average,
                               axes=axes)
+        out = (params, opt_state, loss)
         if loss_has_aux:
             if aux_mode == "averaged":
                 aux = jax.tree.map(
@@ -574,14 +667,17 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
                     if jnp.issubdtype(v.dtype, jnp.floating) else v, aux)
             else:
                 aux = jax.tree.map(lambda *xs: jnp.stack(xs), *auxes)
-            return params, opt_state, loss, aux
-        return params, opt_state, loss
+            out = out + (aux,)
+        if guard:
+            out = out + (guard_out,)
+        return out
 
     return local_step
 
 
 def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
-                                      axes, k):
+                                      axes, k, guard=False,
+                                      guard_norm_limit=0.0):
     """Flax counterpart of :func:`_build_microbatch_local_step`.
 
     BatchNorm note: batch statistics CHAIN through the k microbatches
@@ -632,6 +728,10 @@ def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
             losses.append(loss_i)
             state = accumulate(grads, state)
         reduced = finalize(state, k, grads)
+        if guard:
+            old_params, old_opt = params, opt_state
+            gvec = _ops.allreduce(_guard_screen_vec(reduced), Sum,
+                                  axes=axes)
         if ef:
             reduced, new_res = _dist.ef_exchange(
                 reduced, residuals, compression=exchange["compression"],
@@ -648,6 +748,14 @@ def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
             lambda v: _ops.allreduce(v, Average, axes=axes), stats)
         loss = _ops.allreduce(jnp.mean(jnp.stack(losses)), Average,
                               axes=axes)
+        if guard:
+            nonfinite, norm, bad = _guard_verdict(gvec, guard_norm_limit)
+            params = _guard_select(bad, old_params, params)
+            opt_state = _guard_select(bad, old_opt, opt_state)
+            new_stats = _guard_select(bad, batch_stats, new_stats)
+            guard_out = jnp.stack([nonfinite, norm,
+                                   bad.astype(jnp.float32)])
+            return params, new_stats, opt_state, loss, guard_out
         return params, new_stats, opt_state, loss
 
     return local_step
@@ -705,50 +813,54 @@ def make_train_loop(
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
     k = _resolve_steps(steps_per_execution)
+    guard_on, guard_limit = _resolve_guard()
     if k_micro > 1:
         inner, exchange = _microbatch_unwrap(optimizer)
         local_step = _build_microbatch_local_step(
             loss_fn, inner, exchange, axes, loss_has_aux, aux_mode,
-            with_frozen, k_micro)
+            with_frozen, k_micro, guard=guard_on,
+            guard_norm_limit=guard_limit)
     else:
         local_step = _build_local_step(loss_fn, optimizer, axes,
                                        loss_has_aux, aux_mode, with_frozen,
-                                       zero_stage, zero_compression)
+                                       zero_stage, zero_compression,
+                                       guard=guard_on,
+                                       guard_norm_limit=guard_limit)
 
     def local_loop(params, opt_state, batches, *frozen):
         def body(carry, batch):
             out = local_step(carry[0], carry[1], batch, *frozen)
-            if loss_has_aux:
-                p, o, loss, aux = out
-                return (p, o), (loss, aux)
-            p, o, loss = out
-            return (p, o), loss
+            # Trailing outputs (loss[, aux][, guard]) stack on a leading
+            # [k] axis; with guard the history is [k, 3] so the host
+            # policy sees every scanned step, not just the last.
+            return (out[0], out[1]), tuple(out[2:])
 
         (params, opt_state), ys = jax.lax.scan(
             body, (params, opt_state), batches, length=k)
-        if loss_has_aux:
-            losses, aux = ys
-            return params, opt_state, losses, aux
-        return params, opt_state, ys
+        return (params, opt_state) + tuple(ys)
 
     # Batch leaves carry a leading steps axis: dim 0 scans, dim 1 shards.
     aux_spec = () if not loss_has_aux else \
         ((P(),) if aux_mode == "averaged" else (P(None, axes),))
+    guard_spec = (P(),) if guard_on else ()
     frozen_spec = (P(),) if with_frozen else ()
     opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
     shard = jax.shard_map(
         local_loop, mesh=mesh,
         in_specs=(P(), opt_spec, P(None, axes)) + frozen_spec,
-        out_specs=(P(), opt_spec, P()) + aux_spec,
+        out_specs=(P(), opt_spec, P()) + aux_spec + guard_spec,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
-    return _maybe_tuned(shard, donate_argnums, loss_index=2, steps=k,
-                        meta={"optimizer": optimizer,
-                              "zero_stage": zero_stage,
-                              "zero_compression": zero_compression,
-                              "microbatches": k_micro,
-                              "world": int(mesh.devices.size)})
+    meta = {"optimizer": optimizer,
+            "zero_stage": zero_stage,
+            "zero_compression": zero_compression,
+            "microbatches": k_micro,
+            "guard": guard_on,
+            "world": int(mesh.devices.size)}
+    step = _maybe_tuned(shard, donate_argnums, loss_index=2, steps=k,
+                        meta=meta)
+    return _GuardedStep(step, meta) if guard_on else step
 
 
 def _maybe_tuned(shard, donate_argnums, loss_index: int, steps: int = 1,
@@ -896,6 +1008,36 @@ class _InstrumentedStep:
         return out
 
 
+class _GuardedStep:
+    """Host-side SDC policy around a guarded step.
+
+    The guarded trace appends a trailing replicated ``f32[3]`` guard
+    vector (``[k, 3]`` for a scan loop); this wrapper strips it from the
+    outputs -- callers see exactly the unguarded signature -- and feeds
+    it to :func:`horovod_tpu.core.guard.policy`, which counts the
+    ``horovod_guard_*`` metrics and raises
+    :class:`~horovod_tpu.core.exceptions.SustainedAnomalyError` when a
+    skip streak reaches ``HOROVOD_GUARD_STREAK``.  The fetch of the tiny
+    guard vector is the guard's only host cost (it does fence the step;
+    that is the price of a same-step verdict).  Attribute access
+    delegates to the wrapped step (``.lower``, ``._meta``, AOT paths).
+    """
+
+    def __init__(self, fn, meta: dict):
+        self._fn = fn
+        self._meta = meta
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def __call__(self, *args):
+        out = self._fn(*args)
+        from .core import guard as _guard
+        import numpy as np
+        _guard.policy().observe(np.asarray(out[-1]))
+        return out[:-1]
+
+
 def _step_exchange_accounting(params, meta) -> Tuple[str, int, int]:
     """``(codec, wire_bytes_per_step, uncompressed_bytes_per_step)`` for
     the exchange a step built with ``meta`` emits, per chip per optimizer
@@ -982,35 +1124,45 @@ def make_flax_train_step(
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
+    guard_on, guard_limit = _resolve_guard()
     if k_micro > 1:
         inner, exchange = _microbatch_unwrap(optimizer)
         local_step = _build_flax_microbatch_local_step(
-            apply_fn, inner, exchange, loss_fn, axes, k_micro)
+            apply_fn, inner, exchange, loss_fn, axes, k_micro,
+            guard=guard_on, guard_norm_limit=guard_limit)
     else:
         local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn,
                                             axes, zero_stage,
-                                            zero_compression)
+                                            zero_compression,
+                                            guard=guard_on,
+                                            guard_norm_limit=guard_limit)
 
+    guard_spec = (P(),) if guard_on else ()
     opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
     shard = jax.shard_map(local_step, mesh=mesh,
                           in_specs=(P(), P(), opt_spec, P(axes)),
-                          out_specs=(P(), P(), opt_spec, P()),
+                          out_specs=(P(), P(), opt_spec, P()) + guard_spec,
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
     # Autotune applies here too (HOROVOD_AUTOTUNE=1): loss is element 3.
-    return _maybe_tuned(shard, donate_argnums, loss_index=3,
-                        meta={"optimizer": optimizer,
-                              "zero_stage": zero_stage,
-                              "zero_compression": zero_compression,
-                              "microbatches": k_micro,
-                              "world": int(mesh.devices.size)})
+    meta = {"optimizer": optimizer,
+            "zero_stage": zero_stage,
+            "zero_compression": zero_compression,
+            "microbatches": k_micro,
+            "guard": guard_on,
+            "world": int(mesh.devices.size)}
+    step = _maybe_tuned(shard, donate_argnums, loss_index=3, meta=meta)
+    return _GuardedStep(step, meta) if guard_on else step
 
 
 def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
-                           zero_compression):
+                           zero_compression, guard=False,
+                           guard_norm_limit=0.0):
     """Per-device flax step body shared by :func:`make_flax_train_step`
     and :func:`make_flax_train_loop` (bitwise parity, as with
-    :func:`_build_local_step`)."""
+    :func:`_build_local_step`).  The guard additionally pins the OLD
+    batch stats on a poisoned step -- a NaN batch pollutes the BN running
+    statistics as surely as it pollutes the gradients."""
     if loss_fn is None:
         def loss_fn(logits, y):
             return _softmax_xent(logits, y)
@@ -1029,6 +1181,9 @@ def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
             return loss_fn(logits, y), {}
 
         (loss, new_stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        if guard:
+            old_params, old_opt = params, opt_state
+            gvec = _ops.allreduce(_guard_screen_vec(grads), Sum, axes=axes)
         if zero_stage:
             params, opt_state = _zero.zero_apply(
                 optimizer, grads, opt_state, params, axes=axes,
@@ -1039,6 +1194,14 @@ def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
         new_stats = jax.tree.map(
             lambda v: _ops.allreduce(v, Average, axes=axes), new_stats)
         loss = _ops.allreduce(loss, Average, axes=axes)
+        if guard:
+            nonfinite, norm, bad = _guard_verdict(gvec, guard_norm_limit)
+            params = _guard_select(bad, old_params, params)
+            opt_state = _guard_select(bad, old_opt, opt_state)
+            new_stats = _guard_select(bad, batch_stats, new_stats)
+            guard_out = jnp.stack([nonfinite, norm,
+                                   bad.astype(jnp.float32)])
+            return params, new_stats, opt_state, loss, guard_out
         return params, new_stats, opt_state, loss
 
     return local_step
@@ -1080,36 +1243,44 @@ def make_flax_train_loop(
     mesh = mesh or _basics.mesh()
     axes = tuple(mesh.axis_names)
     k = _resolve_steps(steps_per_execution)
+    guard_on, guard_limit = _resolve_guard()
     if k_micro > 1:
         inner, exchange = _microbatch_unwrap(optimizer)
         local_step = _build_flax_microbatch_local_step(
-            apply_fn, inner, exchange, loss_fn, axes, k_micro)
+            apply_fn, inner, exchange, loss_fn, axes, k_micro,
+            guard=guard_on, guard_norm_limit=guard_limit)
     else:
         local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn,
                                             axes, zero_stage,
-                                            zero_compression)
+                                            zero_compression,
+                                            guard=guard_on,
+                                            guard_norm_limit=guard_limit)
 
     def local_loop(params, batch_stats, opt_state, batches):
         def body(carry, batch):
-            p, s, o, loss = local_step(*carry, batch)
-            return (p, s, o), loss
+            out = local_step(*carry, batch)
+            return (out[0], out[1], out[2]), tuple(out[3:])
 
-        (params, batch_stats, opt_state), losses = jax.lax.scan(
+        (params, batch_stats, opt_state), ys = jax.lax.scan(
             body, (params, batch_stats, opt_state), batches, length=k)
-        return params, batch_stats, opt_state, losses
+        return (params, batch_stats, opt_state) + tuple(ys)
 
+    guard_spec = (P(),) if guard_on else ()
     opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
     shard = jax.shard_map(local_loop, mesh=mesh,
                           in_specs=(P(), P(), opt_spec, P(None, axes)),
-                          out_specs=(P(), P(), opt_spec, P()),
+                          out_specs=(P(), P(), opt_spec, P()) + guard_spec,
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
-    return _maybe_tuned(shard, donate_argnums, loss_index=3, steps=k,
-                        meta={"optimizer": optimizer,
-                              "zero_stage": zero_stage,
-                              "zero_compression": zero_compression,
-                              "microbatches": k_micro,
-                              "world": int(mesh.devices.size)})
+    meta = {"optimizer": optimizer,
+            "zero_stage": zero_stage,
+            "zero_compression": zero_compression,
+            "microbatches": k_micro,
+            "guard": guard_on,
+            "world": int(mesh.devices.size)}
+    step = _maybe_tuned(shard, donate_argnums, loss_index=3, steps=k,
+                        meta=meta)
+    return _GuardedStep(step, meta) if guard_on else step
 
 
 def _softmax_xent(logits, y):

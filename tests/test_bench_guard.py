@@ -1870,3 +1870,157 @@ def test_bench_roofline_mode_flags(monkeypatch):
     monkeypatch.delenv("BENCH_ROOFLINE_ITERS")
     b = importlib.reload(bench)
     assert not b.ROOFLINE_BENCH
+
+
+# -- SDC defense drill shape (round 16) --------------------------------------
+# bench.py's BENCH_SDC=1 drill (config suffix "_sdc") records the
+# corruption-defense acceptance gates: zero clean-run guard activations,
+# >= 1 poisoned step actually skipped, a non-null ledger rollback report,
+# loss parity within 1.25x of the uninterrupted run, and the bitflip
+# tripwire attributing exactly the victim rank within one check interval.
+
+
+def scan_sdc_entries(bench_dir):
+    """Return [(path, why), ...] for malformed SDC bench entries."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            if not str(parsed.get("config", "")).endswith("_sdc"):
+                continue
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "sdc vs_baseline must be null"))
+            ratio = parsed.get("value")
+            if not isinstance(ratio, (int, float)) or not 0 < ratio <= 1.25:
+                bad.append((path, f"parity ratio out of (0, 1.25]: "
+                                  f"{ratio!r}"))
+            sdc = parsed.get("sdc") or {}
+            g = sdc.get("guard") or {}
+            if g.get("clean_skips") != 0:
+                bad.append((path, f"clean run must have zero guard "
+                                  f"skips, got {g.get('clean_skips')!r}"))
+            if not isinstance(g.get("skipped"), int) or g["skipped"] < 1:
+                bad.append((path, f"no poisoned step was skipped: "
+                                  f"{g.get('skipped')!r}"))
+            if not (sdc.get("rollback") or {}).get("report"):
+                bad.append((path, "missing ledger rollback report"))
+            t = sdc.get("tripwire") or {}
+            if (t.get("attributed") != [t.get("victim_rank")]
+                    or t.get("victim_rank") is None):
+                bad.append((path, f"tripwire misattribution: victim "
+                                  f"{t.get('victim_rank')!r}, attributed "
+                                  f"{t.get('attributed')!r}"))
+            if not (isinstance(t.get("detected_within_commits"), int)
+                    and 0 < t["detected_within_commits"]
+                    <= t.get("check_interval_commits", 0)):
+                bad.append((path, "tripwire detection exceeded one check "
+                                  "interval"))
+    return bad
+
+
+def test_committed_sdc_entries_well_formed():
+    assert scan_sdc_entries(REPO) == []
+
+
+def test_committed_sdc_round_covers_all_three_acts():
+    """The committed round-16 artifact must prove the full defense chain:
+    guard skip, ledger rollback, tripwire quarantine."""
+    with open(os.path.join(REPO, "BENCH_r16.json")) as f:
+        doc = json.load(f)
+    parsed = doc["parsed"]
+    assert parsed["metric"] == "sdc_defense_recovery"
+    assert "error" not in parsed
+    sdc = parsed["sdc"]
+    assert sdc["guard"]["skipped"] >= 1
+    assert sdc["rollback"]["report"]["commit"] is not None
+    assert sdc["tripwire"]["world_after"] < sdc["tripwire"]["world_before"]
+    assert sdc["counters"]["horovod_guard_rollbacks_total"] >= 1
+
+
+def _write_sdc(tmp_path, name, **overrides):
+    sdc = {
+        "steps": 30,
+        "guard": {"clean_skips": 0, "poison_from_step": 11, "skipped": 3,
+                  "streak_limit": 3},
+        "rollback": {"report": {"commit": 2, "depth": 2},
+                     "resumed_batch": 6, "parity_ratio": 1.0,
+                     "snapshot_steps": 2},
+        "tripwire": {"victim_rank": 7, "attributed": [7],
+                     "check_interval_commits": 2,
+                     "detected_within_commits": 1,
+                     "world_before": 8, "world_after": 6,
+                     "checks": 16, "trips": 1},
+        "counters": {"horovod_guard_steps_total": 67,
+                     "horovod_guard_skipped_total": 3,
+                     "horovod_guard_rollbacks_total": 1},
+    }
+    parsed = {"metric": "sdc_defense_recovery", "value": 1.0,
+              "unit": "loss_ratio", "vs_baseline": None,
+              "config": "batch256_s2d_bf16_sdc",
+              "baseline_config": "batch256_s2d_bf16_sdc", "sdc": sdc}
+    parsed.update({k: v for k, v in overrides.items() if k != "sdc"})
+    for k, v in (overrides.get("sdc") or {}).items():
+        sdc[k].update(v) if isinstance(v, dict) else sdc.update({k: v})
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def test_sdc_validator_accepts_well_formed_entry(tmp_path):
+    _write_sdc(tmp_path, "BENCH_r80.json")
+    assert scan_sdc_entries(str(tmp_path)) == []
+    # ...and the >=0.98 throughput gate ignores it (vs_baseline null).
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_sdc_validator_trips_on_bad_parity_or_vs_baseline(tmp_path):
+    _write_sdc(tmp_path, "BENCH_r81.json", value=1.4)
+    _write_sdc(tmp_path, "BENCH_r82.json", vs_baseline=1.02)
+    bad = dict(scan_sdc_entries(str(tmp_path)))
+    assert "parity ratio" in bad[str(tmp_path / "BENCH_r81.json")]
+    assert "vs_baseline" in bad[str(tmp_path / "BENCH_r82.json")]
+
+
+def test_sdc_validator_trips_on_false_activation_or_no_skip(tmp_path):
+    _write_sdc(tmp_path, "BENCH_r83.json",
+               sdc={"guard": {"clean_skips": 2}})
+    _write_sdc(tmp_path, "BENCH_r84.json", sdc={"guard": {"skipped": 0}})
+    bad = dict(scan_sdc_entries(str(tmp_path)))
+    assert "zero guard" in bad[str(tmp_path / "BENCH_r83.json")]
+    assert "no poisoned step" in bad[str(tmp_path / "BENCH_r84.json")]
+
+
+def test_sdc_validator_trips_on_misattribution_or_slow_detect(tmp_path):
+    _write_sdc(tmp_path, "BENCH_r85.json",
+               sdc={"tripwire": {"attributed": [3]}})
+    _write_sdc(tmp_path, "BENCH_r86.json",
+               sdc={"tripwire": {"detected_within_commits": 5}})
+    _write_sdc(tmp_path, "BENCH_r87.json",
+               sdc={"rollback": {"report": None}})
+    bad = dict(scan_sdc_entries(str(tmp_path)))
+    assert "misattribution" in bad[str(tmp_path / "BENCH_r85.json")]
+    assert "interval" in bad[str(tmp_path / "BENCH_r86.json")]
+    assert "rollback report" in bad[str(tmp_path / "BENCH_r87.json")]
+
+
+def test_bench_sdc_mode_flags(monkeypatch):
+    """BENCH_SDC=1 selects the corruption-defense drill; BENCH_SDC_STEPS
+    sizes the training runs."""
+    import importlib
+
+    import bench
+    monkeypatch.setenv("BENCH_SDC", "1")
+    b = importlib.reload(bench)
+    assert b.SDC_BENCH and b.SDC_STEPS == 30
+    monkeypatch.setenv("BENCH_SDC_STEPS", "12")
+    b = importlib.reload(bench)
+    assert b.SDC_STEPS == 12
+    monkeypatch.delenv("BENCH_SDC")
+    monkeypatch.delenv("BENCH_SDC_STEPS")
+    b = importlib.reload(bench)
+    assert not b.SDC_BENCH

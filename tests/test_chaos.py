@@ -592,3 +592,81 @@ def test_jax_state_resize_noop_on_same_size(hvd):
     s = elastic.JaxState(params={"w": jnp.ones((3,))}, batch=0)
     report = s.resize(8, 8)
     assert report["resized"] == [] and report["carried_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Corruption kinds (bitflip / nan) -- the SDC drill grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_corruption_kinds():
+    seed, faults = chaos.parse_spec(
+        "seed=7; nan@step=3,rank=1; bitflip@step=5,rank=any; "
+        "slow@step=2,rank=0,secs=0.25")
+    assert seed == 7
+    nan, flip, slow = faults
+    assert (nan.kind, nan.step, nan.rank) == ("nan", 3, 1)
+    assert (flip.kind, flip.step, flip.rank) == ("bitflip", 5, None)
+    # slow IS a duration kind: secs= parses.
+    assert (slow.kind, slow.secs) == ("slow", 0.25)
+
+
+@pytest.mark.parametrize("bad", [
+    "nan@step=1,secs=2",         # corruption kinds have no duration
+    "bitflip@step=1,secs=0.5",
+    "kill@step=1,secs=1",        # neither do the hard-exit kinds
+    "sigterm@step=1,secs=3",
+    "comm@step=1,secs=1",
+])
+def test_parse_spec_rejects_secs_on_instant_kinds(bad):
+    """secs= is rejected -- not silently dropped -- on kinds that would
+    ignore it (only kv_blackout/hb_drop/slow have a duration)."""
+    with pytest.raises(chaos.ChaosSpecError, match="secs= does not apply"):
+        chaos.parse_spec(bad)
+
+
+def test_corruption_faults_fire_on_every_process():
+    """bitflip/nan fire on EVERY process at the given step -- the victim
+    rank rides in the latch, because the process that owns the injection
+    point (the training driver) may not be the victim's host."""
+    for rank in range(3):
+        chaos.reset()
+        inj = chaos.ChaosInjector(
+            "nan@step=2,rank=1;bitflip@step=4,rank=2", rank=rank, size=3)
+        inj.on_step(2)
+        assert chaos.consume_nan_poison() == 1
+        inj.on_step(3)
+        assert chaos.consume_nan_poison() is None  # one-shot
+        inj.on_step(4)
+        assert chaos.consume_bitflip() == 2
+        assert chaos.consume_bitflip() is None
+        # fired-once latch: a replayed step does not re-poison.
+        inj.on_step(4)
+        assert chaos.consume_bitflip() is None
+
+
+def test_corruption_latches_cleared_by_reset():
+    inj = chaos.ChaosInjector("nan@step=1;bitflip@step=1", rank=0, size=1)
+    inj.on_step(1)
+    chaos.reset()
+    assert chaos.consume_nan_poison() is None
+    assert chaos.consume_bitflip() is None
+
+
+def test_poison_batch_nans_first_float_leaf_only():
+    idx = np.arange(6, dtype=np.int32)          # int leaf: skipped
+    a = np.ones((2, 3), np.float32)             # first float leaf: hit
+    b = np.ones((4,), np.float32)               # later float leaf: intact
+    out_idx, out_a, out_b = chaos.poison_batch((idx, a, b))
+    np.testing.assert_array_equal(np.asarray(out_idx), idx)
+    oa = np.asarray(out_a)
+    assert np.isnan(oa.reshape(-1)[0])
+    np.testing.assert_array_equal(oa.reshape(-1)[1:],
+                                  np.ones(5, np.float32))
+    np.testing.assert_array_equal(np.asarray(out_b), b)
+    # Shape/structure preserved, input untouched.
+    assert oa.shape == a.shape and not np.isnan(a).any()
+
+
+def test_poison_batch_requires_a_float_leaf():
+    with pytest.raises(ValueError, match="no floating leaf"):
+        chaos.poison_batch({"tokens": np.arange(4)})
