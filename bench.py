@@ -172,6 +172,25 @@ ROOFLINE_ITERS = int(os.environ.get("BENCH_ROOFLINE_ITERS", "5"))
 # tests/test_bench_guard.py::scan_sdc_entries.
 SDC_BENCH = _env_on("BENCH_SDC")
 SDC_STEPS = int(os.environ.get("BENCH_SDC_STEPS", "30"))
+# BENCH_PREFIX=1 runs the round-17 prefix-shared KV cache drill: the
+# LLAMA_SERVE 8-way mesh serves a kilotoken prefix-shared mixture (75%
+# of requests share one of two fixed 1024-token system prefixes, a
+# quarter open two-turn sessions, gold/bronze tenant mix) twice --
+# cold (prefix cache off) and warm (radix cache on) at matched load --
+# then replays matched uniform vs adversarial tenant mixes (same seed,
+# so prompts and arrival times are byte-identical; only the tenant
+# labels move) for the fairness gate.  Gates: prefill FLOPs avoided
+# >= 0.4, warm TTFT p99 strictly under cold, warm end-to-end tokens/s
+# (prompt + generated over wall clock -- the comparable number at
+# kilotoken context) >= BENCH_r15's 975.11 headline, zero leaked pages
+# with balanced refcounts after drop_all, and every tenant class
+# inside its TTFT SLO budget under the adversarial mix at >= 90% of
+# the uniform-mix throughput.  Committed entry gated by
+# tests/test_bench_guard.py::scan_prefix_entries.
+PREFIX_BENCH = _env_on("BENCH_PREFIX")
+PREFIX_REQUESTS = int(os.environ.get("BENCH_PREFIX_REQUESTS", "28"))
+PREFIX_RATE = float(os.environ.get("BENCH_PREFIX_RATE", "6"))
+SERVING_R15_TOKENS_PER_S = 975.11
 
 
 def _config() -> str:
@@ -678,6 +697,178 @@ def _main_serving_v2():
     os._exit(0)
 
 
+def _main_prefix():
+    """BENCH_PREFIX=1: round-17 prefix-shared KV cache drill."""
+    import dataclasses
+    from horovod_tpu.utils.platform import force_host_device_count
+    force_host_device_count(8, cpu=True)  # before jax touches the backend
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from horovod_tpu import serving
+    from horovod_tpu.models.transformer import LLAMA_SERVE, LlamaLM
+
+    cfg = LLAMA_SERVE
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("tp",))
+    slots = SERVING_SLOTS
+    # SLO class budgets for the fairness gate: gold gets 4x the stride
+    # weight and a tight TTFT budget; bronze is best-effort but capped
+    # at 3/4 of the slots so a bronze flood cannot starve gold.
+    classes = {
+        "gold": serving.TenantClass("gold", weight=4.0, ttft_slo_s=3.0),
+        "bronze": serving.TenantClass("bronze", weight=1.0,
+                                      ttft_slo_s=10.0, max_share=0.75)}
+
+    def _engine(prefix_on):
+        return serving.ServingEngine(
+            cfg, params, mesh=mesh, slots=slots, page_size=16,
+            max_len=2048, prefix_cache=prefix_on,
+            session_ttl_steps=2048, tenants=classes)
+
+    # The measured mixture: 1024-token shared prefixes over 64-token
+    # unique tails, so one radix hit skips ~16x the tail's prefill.
+    spec = serving.prefix_spec(
+        num_requests=PREFIX_REQUESTS, rate_rps=PREFIX_RATE,
+        prompt_lens=(64,), output_lens=(16, 24),
+        prefix_share=0.75, num_prefixes=2, prefix_lens=(1024,),
+        session_share=0.25, session_turns=2,
+        tenants=(("gold", 1.0), ("bronze", 1.0)),
+        vocab_size=cfg.vocab_size, seed=11)
+    # Warm-up mixture: same shape, tiny N, high rate -- covers every
+    # prefill length {64, 128, 1088, 1152} and every chunked-tail
+    # (tail, past) variant outside the timed runs.
+    warm_spec = dataclasses.replace(spec, num_requests=12, rate_rps=1000.0,
+                                    session_share=0.5, seed=1)
+
+    def _run(eng, s):
+        reqs = serving.generate(s)
+        rep = eng.serve(reqs)
+        total = (rep.prompt_tokens + rep.new_tokens) / rep.wall_s
+        return rep, reqs, total
+
+    # --- phase A: cold-cache baseline at matched load --------------------
+    eng_cold = _engine(False)
+    eng_cold.serve(serving.generate(warm_spec))
+    rep_c, _, total_c = _run(eng_cold, spec)
+    print(f"# cold: {total_c:.1f} tokens/s end-to-end, "
+          f"TTFT p99 {rep_c.ttft_p99_s * 1e3:.1f} ms", file=sys.stderr)
+
+    # --- phase B: warm radix cache, same stream --------------------------
+    eng = _engine(True)
+    eng.serve(serving.generate(warm_spec))
+    eng._prefix.drop_all()  # hits in the timed run must be earned there
+    rep_w, _, total_w = _run(eng, spec)
+    print(f"# warm: {total_w:.1f} tokens/s end-to-end, "
+          f"TTFT p99 {rep_w.ttft_p99_s * 1e3:.1f} ms, "
+          f"hit rate {rep_w.prefix_hit_rate:.3f}, "
+          f"flops avoided {rep_w.prefill_flops_avoided:.3f}",
+          file=sys.stderr)
+
+    # --- drain: every shared page must come home -------------------------
+    eng._prefix.drop_all()
+    leaked = int(eng.cache.live_pages)
+    balanced = bool(eng.cache.refcounts_balanced())
+
+    # --- phase C: fairness under an adversarial tenant mix ---------------
+    # Same seed for both mixes: the tenant label is the only rng draw
+    # whose OUTCOME changes with the weights, so prompts and arrival
+    # times stay byte-identical -- matched load by construction.
+    def _fair(mix, seed):
+        eng._prefix.drop_all()
+        s = dataclasses.replace(spec, tenants=mix, seed=seed)
+        rep, reqs, total = _run(eng, s)
+        p99 = {}
+        for name in ("gold", "bronze"):
+            ts = [r.ttft_s for r in reqs
+                  if r.tenant == name and r.ttft_s is not None]
+            p99[name] = float(np.percentile(np.asarray(ts), 99)) \
+                if ts else 0.0
+        return rep, total, p99
+
+    rep_u, total_u, p99_u = _fair((("gold", 1.0), ("bronze", 1.0)), 13)
+    rep_a, total_a, p99_a = _fair((("gold", 1.0), ("bronze", 9.0)), 13)
+    ratio = total_a / total_u if total_u else 0.0
+    print(f"# fairness: uniform {total_u:.1f} vs adversarial "
+          f"{total_a:.1f} tokens/s (ratio {ratio:.3f}); adversarial "
+          f"TTFT p99 gold {p99_a['gold'] * 1e3:.1f} ms / bronze "
+          f"{p99_a['bronze'] * 1e3:.1f} ms", file=sys.stderr)
+
+    slo = {c.name: c.ttft_slo_s for c in classes.values()}
+    ok = (rep_w.prefill_flops_avoided >= 0.4
+          and rep_w.ttft_p99_s < rep_c.ttft_p99_s
+          and total_w >= SERVING_R15_TOKENS_PER_S
+          and total_w >= total_c
+          and leaked == 0 and balanced
+          and all(p99_a[n] <= slo[n] for n in slo)
+          and ratio >= 0.9)
+
+    config = f"llama_serve_w8_slots{slots}_prefix"
+    result = {
+        "metric": "serving_prefix_tokens_per_sec",
+        "value": round(total_w, 2),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # CPU-mesh serving drill: no throughput peer
+        "config": config,
+        "baseline_config": f"llama_serve_w8_slots{slots}_coldcache",
+        "prefix": {
+            "world": 8,
+            "slots": slots,
+            "page_size": 16,
+            "hit": {"queries": rep_w.prefix_queries,
+                    "hits": rep_w.prefix_hits,
+                    "hit_rate": round(rep_w.prefix_hit_rate, 4)},
+            "prefill": {
+                "tokens_cached": rep_w.prefill_tokens_cached,
+                "tokens_computed": (rep_w.prompt_tokens
+                                    - rep_w.prefill_tokens_cached),
+                "flops_avoided": round(rep_w.prefill_flops_avoided, 4)},
+            "ttft": {"cold_p50_ms": round(rep_c.ttft_p50_s * 1e3, 3),
+                     "cold_p99_ms": round(rep_c.ttft_p99_s * 1e3, 3),
+                     "warm_p50_ms": round(rep_w.ttft_p50_s * 1e3, 3),
+                     "warm_p99_ms": round(rep_w.ttft_p99_s * 1e3, 3)},
+            # End-to-end token throughput (prompt + generated per wall
+            # second): the number the avoided prefill moves at
+            # kilotoken context, and the one compared against the
+            # BENCH_r15 headline.
+            "throughput": {
+                "cold_tokens_per_s": round(total_c, 2),
+                "warm_tokens_per_s": round(total_w, 2),
+                "warm_decode_tokens_per_s": round(rep_w.tokens_per_s, 2),
+                "baseline_r15_tokens_per_s": SERVING_R15_TOKENS_PER_S,
+                "vs_r15": round(total_w / SERVING_R15_TOKENS_PER_S, 2)},
+            "sessions": {"resumes": rep_w.session_resumes},
+            "drain": {"leaked_pages": leaked,
+                      "refcounts_balanced": balanced},
+            "fairness": {
+                "classes": {
+                    n: {"ttft_p99_s": round(p99_a[n], 4),
+                        "slo_s": slo[n],
+                        "met": bool(p99_a[n] <= slo[n])}
+                    for n in slo},
+                "uniform_tokens_per_s": round(total_u, 2),
+                "adversarial_tokens_per_s": round(total_a, 2),
+                "throughput_ratio": round(ratio, 4)},
+            "load": {"rate_rps": PREFIX_RATE,
+                     "num_requests": PREFIX_REQUESTS,
+                     "prefix_share": spec.prefix_share,
+                     "num_prefixes": spec.num_prefixes,
+                     "prefix_lens": list(spec.prefix_lens),
+                     "prompt_lens": list(spec.prompt_lens),
+                     "output_lens": list(spec.output_lens),
+                     "session_share": spec.session_share,
+                     "session_turns": spec.session_turns,
+                     "seed": spec.seed},
+        },
+    }
+    if not ok:
+        result["error"] = "prefix drill failed a gate (see prefix block)"
+    print(json.dumps(result), flush=True)
+    os._exit(0 if ok else 2)
+
+
 def _main_autoscale():
     """BENCH_AUTOSCALE=1: closed-loop elastic serving chaos drill."""
     from horovod_tpu.utils.platform import force_host_device_count
@@ -1056,6 +1247,8 @@ def main():
         _main_serving()
     if SERVING_V2_BENCH:
         _main_serving_v2()
+    if PREFIX_BENCH:
+        _main_prefix()
     if AUTOSCALE_BENCH:
         _main_autoscale()
     if ROOFLINE_BENCH:

@@ -19,9 +19,11 @@ from .decode import (build_decode_step, build_verify_step,  # noqa: F401
 from .engine import (RequestPrefetcher, ServingEngine,  # noqa: F401
                      ServingReport)
 from .kvcache import (CacheConfig, PagedKVCache,  # noqa: F401
-                      cache_sharding)
-from .loadgen import LoadSpec, generate, long_prompt_spec  # noqa: F401
+                      PrefixCache, cache_sharding)
+from .loadgen import (LoadSpec, generate, long_prompt_spec,  # noqa: F401
+                      prefix_spec)
 from .policy import (Decision, PolicyConfig, ScalePolicy,  # noqa: F401
                      SLOSample, valid_tp_sizes)
-from .scheduler import ContinuousBatchScheduler, Request  # noqa: F401
+from .scheduler import (ContinuousBatchScheduler, Request,  # noqa: F401
+                        TenantClass, parse_tenant_classes)
 from .spec import ModelDrafter, NgramDrafter  # noqa: F401

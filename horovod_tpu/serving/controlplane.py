@@ -199,6 +199,10 @@ class ServingControlPlane:
         self._m_ttft_p99 = reg.gauge(
             "horovod_ctl_ttft_p99_seconds",
             "Windowed TTFT p99 as sampled by the control plane")
+        self._m_prefix_hit = reg.gauge(
+            "horovod_ctl_prefix_hit_rate",
+            "Radix prefix-cache hit rate as sampled by the control "
+            "plane (0 when the cache is off)")
         self._m_mesh_size.set(len(self.mesh_ranks))
         self._m_healthy.set(len(self.healthy))
 
@@ -272,13 +276,16 @@ class ServingControlPlane:
             win = _metrics.histogram_window(curr, self._stats["ttft_base"])
             self._stats["ttft_base"] = curr
             p99 = _metrics.histogram_quantile(win, 0.99)
+        prefix = getattr(self.engine, "_prefix", None)
+        hit_rate = prefix.hit_rate if prefix is not None else None
         return SLOSample(
             now_s=now_s, queue_depth=len(sched.queue), ttft_p99_s=p99,
             occupancy=sched.occupancy, mesh_size=len(self.mesh_ranks),
             mesh_ranks=tuple(self.mesh_ranks),
             healthy=tuple(self.healthy),
             dead_ranks=tuple(sorted(self.dead)),
-            evict_candidate=self._evict_candidate)
+            evict_candidate=self._evict_candidate,
+            prefix_hit_rate=hit_rate)
 
     def _tick(self, now) -> None:
         now_s = now()
@@ -287,6 +294,7 @@ class ServingControlPlane:
             return
         sample = self._sample(now_s)
         self._m_ttft_p99.set(sample.ttft_p99_s or 0.0)
+        self._m_prefix_hit.set(sample.prefix_hit_rate or 0.0)
         violated = (sample.queue_depth >= self.policy_cfg.queue_high
                     or (sample.ttft_p99_s is not None
                         and sample.ttft_p99_s > self.policy_cfg.ttft_slo_s))

@@ -29,6 +29,20 @@ Multi-LoRA: ``stack_adapters`` packs N trained adapter trees into banked
 adapter pair by a per-slot ``adapter_ids`` operand INSIDE the step, so
 one base model serves heterogeneous adapters in one decode batch
 (tensor-parallel meshes decline the banks -- adapters stay tp=1).
+
+Shared read-only pages (PR 16): the decode step never sees page
+ownership -- it reads K/V through the slot's ``page_table`` row and
+masks positions at or beyond ``lengths[slot]``, so two slots whose
+table rows point at the SAME physical page (a radix prefix-cache hit)
+compute bitwise-identical attention to two slots holding private
+copies: identical bytes in, identical gather/mask/matmul, identical
+logits out.  Isolation is therefore the cache's contract, not the
+step's: decode writes always scatter at ``lengths[slot]`` (past any
+shared prefix, which is page-aligned and shorter than the prompt), and
+any write that WOULD land inside a shared page is preceded by a
+copy-on-write clone in ``PagedKVCache.reserve(..., writable_from=)``.
+The shared-page bitwise proof lives next to the eviction/reuse proof in
+``test_slot_eviction_reuse_no_stale_attention_mass``.
 """
 
 from __future__ import annotations

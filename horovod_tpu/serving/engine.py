@@ -19,6 +19,15 @@ Knobs (all overridable per-constructor-arg, documented in docs/api.md):
 * ``HOROVOD_PREFILL_CHUNK`` -- chunked-prefill chunk length in tokens
   (default 0 = whole-prompt prefill)
 * ``HOROVOD_KV_COMPRESS`` -- fp8 cold-page KV compression (default off)
+* ``HOROVOD_PREFIX_CACHE`` -- radix prefix cache over the page pool
+  (default off): a request whose prompt hits a cached prefix attaches
+  the matched pages refcounted copy-on-write and prefills only the
+  tail through the chunked path
+* ``HOROVOD_SESSION_TTL_STEPS`` -- engine steps a session's warm KV
+  context stays pinned without reuse (default 512)
+* ``HOROVOD_TENANT_CLASSES`` -- per-tenant SLO classes,
+  ``name:weight[:ttft_slo_s[:max_share]],...`` (default: single
+  tenant)
 
 The engine keeps two clocks: a VIRTUAL clock that fast-forwards through
 idle gaps in the open-loop arrival schedule (TTFT and queueing are
@@ -39,12 +48,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.config import _env_bool, _env_int
+from ..core.config import _env, _env_bool, _env_int
 from ..timeline import spans as _spans
 from .decode import (build_decode_step, build_verify_step, greedy_sample,
                      prefill_forward)
-from .kvcache import CacheConfig, PagedKVCache, cache_sharding
-from .scheduler import ContinuousBatchScheduler, Request
+from .kvcache import (CacheConfig, PagedKVCache, PrefixCache,
+                      cache_sharding)
+from .scheduler import (ContinuousBatchScheduler, Request,
+                        parse_tenant_classes)
 from .spec import NgramDrafter
 
 
@@ -131,6 +142,16 @@ class ServingReport:
     proposed_tokens: int = 0
     accepted_tokens: int = 0
     acceptance_rate: float = 0.0
+    # Prefix cache (zero when HOROVOD_PREFIX_CACHE is off).
+    prefix_queries: int = 0
+    prefix_hits: int = 0
+    prefix_hit_rate: float = 0.0
+    prefill_tokens_cached: int = 0
+    # Fraction of prompt tokens whose per-token prefill forward was
+    # skipped outright (matched pages attached instead of computed) --
+    # the "prefill FLOPs avoided" headline of BENCH_r17.
+    prefill_flops_avoided: float = 0.0
+    session_resumes: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -151,7 +172,9 @@ class ServingEngine:
                  prefetch_depth: int = 0,
                  spec_decode: Optional[bool] = None, spec_k: int = 0,
                  drafter=None, prefill_chunk: int = -1,
-                 kv_compress: Optional[bool] = None):
+                 kv_compress: Optional[bool] = None,
+                 prefix_cache: Optional[bool] = None,
+                 session_ttl_steps: int = 0, tenants=None):
         self.config = config
         self.params = params
         if mesh is None:
@@ -171,6 +194,14 @@ class ServingEngine:
                               if prefill_chunk < 0 else prefill_chunk)
         self.kv_compress = (_env_bool("KV_COMPRESS")
                             if kv_compress is None else bool(kv_compress))
+        self.prefix_cache = (_env_bool("PREFIX_CACHE")
+                             if prefix_cache is None
+                             else bool(prefix_cache))
+        self.session_ttl_steps = session_ttl_steps or _env_int(
+            "SESSION_TTL_STEPS", 512)
+        if tenants is None:
+            spec = _env("TENANT_CLASSES")
+            tenants = parse_tenant_classes(spec) if spec else None
         if self.spec_decode and self.spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
         if adapters is not None and self.spec_decode:
@@ -180,6 +211,11 @@ class ServingEngine:
         if adapters is not None and self.kv_compress:
             raise NotImplementedError(
                 "fp8 KV compression with LoRA banks is not wired")
+        if adapters is not None and self.prefix_cache:
+            raise NotImplementedError(
+                "prefix cache with LoRA banks is not wired: cached K/V "
+                "is keyed by tokens only, but LoRA'd wk/wv make K/V "
+                "adapter-dependent")
         self.dtype = dtype
         self.adapters = adapters
         self.lora_alpha = lora_alpha
@@ -195,7 +231,16 @@ class ServingEngine:
         # + the target's bonus token under speculation, else 1.
         budget = self.spec_k + 1 if self.spec_decode else 1
         self.scheduler = ContinuousBatchScheduler(
-            self.slots, self.cache, token_budget=budget)
+            self.slots, self.cache, token_budget=budget,
+            tenants=tenants)
+        self._tenants = tenants
+        # Radix prefix cache over the page pool: installed as the
+        # cache's reclaim callback so page pressure demotes/evicts
+        # cached prefixes instead of failing admission.
+        self._prefix: Optional[PrefixCache] = None
+        if self.prefix_cache:
+            self._prefix = PrefixCache(
+                self.cache, session_ttl_steps=self.session_ttl_steps)
         self.step = build_decode_step(
             config, mesh, slots=self.slots, page_size=self.page_size,
             pages_per_slot=self.cache_config.pages_per_slot, dtype=dtype,
@@ -230,14 +275,57 @@ class ServingEngine:
         self._chunking: Dict[int, Dict[str, Any]] = {}
 
     # -- one-request helpers ----------------------------------------------
-    def _do_prefill(self, slot: int, req: Request, prompt_dev) -> int:
+    def _begin_prefill(self, st: Dict[str, Any], slot: int, req: Request,
+                       dev, now) -> None:
+        """Admit one request into its slot: radix-match the prompt
+        against the prefix cache (attach matched pages, no compute),
+        then prefill the remaining tail -- chunked when it is long.
+        """
+        matched, entries = 0, ()
+        if self._prefix is not None:
+            matched, entries = self._prefix.match(req.prompt)
+            st["prefix_queries"] += 1
+            if matched:
+                st["prefix_hits"] += 1
+                st["prefill_cached"] += matched
+                self.cache.attach_pages(slot, entries, matched)
+            st["prefill_computed"] += req.prompt_len - matched
+            if req.session_id is not None and \
+                    self._prefix.touch_session(req.session_id) and matched:
+                st["session_resumes"] += 1
+        if 0 < self.prefill_chunk < req.prompt_len - matched:
+            # Long tail: fill in chunk-by-chunk, one chunk per loop
+            # iteration, decode interleaved.  A matched prefix seeds
+            # the running past from the cached pages.
+            past = self.cache.gather_pages(entries) if matched else None
+            self._chunking[slot] = {
+                "req": req, "dev": dev, "pos": matched,
+                "start": matched, "past": past}
+        else:
+            first = self._do_prefill(slot, req, dev, matched=matched,
+                                     entries=entries)
+            self._join_decode(st, slot, req, first, now)
+
+    def _do_prefill(self, slot: int, req: Request, prompt_dev,
+                    matched: int = 0, entries: Sequence = ()) -> int:
         with _spans.recorder().span("dispatch", name="prefill",
                                     leg="serving_prefill"):
-            aid = jnp.int32(req.adapter_id) if self.adapters is not None \
-                else None
-            logits, kl, vl = self._prefill(self.params, prompt_dev[None],
-                                           self.adapters, aid)
-            self.cache.write_prefill(slot, kl[:, 0], vl[:, 0])
+            if matched:
+                # Prefix hit: only the tail goes through the forward
+                # pass, conditioned on the cached pages as past K/V --
+                # the matched tokens' prefill FLOPs are avoided.
+                past = self.cache.gather_pages(entries)
+                logits, kl, vl = self._prefill_chunked(
+                    self.params, prompt_dev[matched:][None], past)
+                self.cache.write_prefill(slot, kl[:, 0, matched:],
+                                         vl[:, 0, matched:],
+                                         start=matched)
+            else:
+                aid = jnp.int32(req.adapter_id) \
+                    if self.adapters is not None else None
+                logits, kl, vl = self._prefill(
+                    self.params, prompt_dev[None], self.adapters, aid)
+                self.cache.write_prefill(slot, kl[:, 0], vl[:, 0])
             first = int(greedy_sample(logits[:, -1, :])[0])
         return first
 
@@ -264,7 +352,9 @@ class ServingEngine:
             if c["pos"] < req.prompt_len:
                 continue
             del self._chunking[slot]
-            self.cache.write_prefill(slot, kl[:, 0], vl[:, 0])
+            start = int(c.get("start", 0))
+            self.cache.write_prefill(slot, kl[:, 0, start:],
+                                     vl[:, 0, start:], start=start)
             first = int(greedy_sample(logits[:, -1, :])[0])
             self._join_decode(st, slot, req, first, now)
 
@@ -277,6 +367,13 @@ class ServingEngine:
         sched.note_prefill(req, now())
         st["last_tokens"][slot] = first
         st["adapter_ids"][slot] = req.adapter_id
+        if self._prefix is not None:
+            # Register the prompt's full pages in the radix tree (tree
+            # holds its own refs, so they outlive the slot) and pin the
+            # session's path so multi-turn context stays warm.
+            self._prefix.insert(req.prompt, slot)
+            if req.session_id is not None:
+                self._prefix.pin_session(req.session_id, req.prompt)
         if self.drafter is not None:
             self.drafter.on_admit(slot, req)
         if req.finished:
@@ -326,7 +423,8 @@ class ServingEngine:
         cache = self.cache
         slots = self._decode_slots()
         for slot in slots:
-            cache.reserve(slot, int(cache.lengths[slot]) + 1)
+            length = int(cache.lengths[slot])
+            cache.reserve(slot, length + 1, writable_from=length)
         active = np.zeros((self.slots,), bool)
         active[slots] = True
         args = [self.params, cache.k, cache.v,
@@ -382,7 +480,8 @@ class ServingEngine:
         for s in slots:
             # Room for this round's widest write, capped at the slot's
             # page allotment (columns past max_len scatter to scratch).
-            cache.reserve(s, min(base[s] + width, self.max_len))
+            cache.reserve(s, min(base[s] + width, self.max_len),
+                          writable_from=base[s])
         drafts = self.drafter.propose(reqs, k,
                                       np.array(st["last_tokens"]))
         tokens_in = np.zeros((self.slots, width), np.int32)
@@ -451,6 +550,11 @@ class ServingEngine:
         self.mesh = mesh
         self.cache = PagedKVCache(self.cache_config, cache_sharding(mesh))
         self.scheduler.cache = self.cache
+        if self._prefix is not None:
+            # Cached pages lived in the old pool: start a fresh tree
+            # over the new one (suspended requests re-prefill anyway).
+            self._prefix = PrefixCache(
+                self.cache, session_ttl_steps=self.session_ttl_steps)
         self.step = build_decode_step(
             self.config, mesh, slots=self.slots, page_size=self.page_size,
             pages_per_slot=self.cache_config.pages_per_slot,
@@ -515,6 +619,9 @@ class ServingEngine:
         st: Dict[str, Any] = {
             "completed": [], "occ_samples": [], "decode_steps": 0,
             "spec_rounds": 0, "proposed": 0, "accepted": 0,
+            "prefix_queries": 0, "prefix_hits": 0,
+            "prefill_cached": 0, "prefill_computed": 0,
+            "session_resumes": 0,
             "last_tokens": np.zeros((self.slots,), np.int32),
             "adapter_ids": np.zeros((self.slots,), np.int32)}
         completed: List[Request] = st["completed"]
@@ -525,6 +632,11 @@ class ServingEngine:
             fetched = next(feed, None)
 
             while True:
+                if self._prefix is not None:
+                    # Advance the session-TTL clock every iteration
+                    # (idle spins included) so pinned sessions always
+                    # expire and page pressure can resolve.
+                    self._prefix.tick()
                 # Pull every request whose arrival time has passed.
                 while fetched is not None and \
                         fetched[0].arrival_s <= now():
@@ -544,15 +656,7 @@ class ServingEngine:
 
                 for slot, req in sched.admit(now()):
                     dev = prompts_dev.pop(req.rid)
-                    if 0 < self.prefill_chunk < req.prompt_len:
-                        # Long prompt: fill in chunk-by-chunk, one
-                        # chunk per loop iteration, decode interleaved.
-                        self._chunking[slot] = {
-                            "req": req, "dev": dev, "pos": 0,
-                            "past": None}
-                    else:
-                        first = self._do_prefill(slot, req, dev)
-                        self._join_decode(st, slot, req, first, now)
+                    self._begin_prefill(st, slot, req, dev, now)
 
                 if self._chunking:
                     self._advance_chunks(st, now)
@@ -574,6 +678,9 @@ class ServingEngine:
         lats = [l for r in completed for l in r.token_latencies]
         proposed = int(st["proposed"])
         accepted = int(st["accepted"])
+        pq, ph = int(st["prefix_queries"]), int(st["prefix_hits"])
+        cached = int(st["prefill_cached"])
+        computed = int(st["prefill_computed"])
         return ServingReport(
             num_requests=len(requests), completed=len(completed),
             rejected=rejected, prompt_tokens=prompt_tokens,
@@ -587,4 +694,10 @@ class ServingEngine:
                             if st["occ_samples"] else 0.0),
             spec_rounds=int(st["spec_rounds"]),
             proposed_tokens=proposed, accepted_tokens=accepted,
-            acceptance_rate=(accepted / proposed if proposed else 0.0))
+            acceptance_rate=(accepted / proposed if proposed else 0.0),
+            prefix_queries=pq, prefix_hits=ph,
+            prefix_hit_rate=(ph / pq if pq else 0.0),
+            prefill_tokens_cached=cached,
+            prefill_flops_avoided=(cached / (cached + computed)
+                                   if cached + computed else 0.0),
+            session_resumes=int(st["session_resumes"]))

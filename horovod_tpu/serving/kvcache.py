@@ -35,18 +35,38 @@ exactly the reason a recycled f32 page's are.  Admission is therefore
 page-gated on COMPRESSED size: ``can_admit``/``reserve`` count cold
 pages at their e4m3 cost (compressing on demand to reclaim f32 pages),
 so the same physical pool admits roughly 4x the cold-token residency.
+
+Prefix sharing (PR 16): pages are REFCOUNTED, so one physical page can
+back the same token prefix in many slots at once.  A page popped off
+the free list starts at refcount 1 (its slot); :meth:`attach_pages`
+maps an existing page into another slot's table with refcount +1, and
+:meth:`free_slot` is a refcount DECREMENT -- the page returns to the
+free list only when its last holder lets go.  Shared pages are
+immutable by construction: decode/verify only write at positions ``>=
+lengths``, which always land past a matched prefix, and every write
+path additionally runs a copy-on-write guard (:meth:`reserve` with
+``writable_from``, :meth:`write_prefill`) that clones a still-shared
+page into a private one before the first byte changes -- a divergent
+continuation can NEVER mutate the shared original (asserted bitwise in
+tests/test_serving.py).  On top sits :class:`PrefixCache`: a radix
+tree over page-sized token-id chunks mapping shared prompt prefixes
+(system prompts, RAG templates, multi-turn session context) to resident
+pages, with session pinning, TTL expiry, the fp8 pool as its demotion
+tier, and LRU eviction under page pressure.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..collectives.compression import fp8_quantize
+from ..timeline.metrics import registry as _registry
 
 
 @dataclasses.dataclass(frozen=True)
@@ -127,6 +147,14 @@ class PagedKVCache:
         self.lengths = np.zeros((c.slots,), np.int32)
         self._allocated = np.zeros((c.slots,), np.int32)  # pages per slot
         self._free = list(range(c.num_pages - 1, -1, -1))  # pop() -> 0, 1...
+        # Holders per physical page: 0 = on the free list, 1 = private,
+        # >1 = shared across slots and/or pinned by the prefix tree.
+        self._refcount = np.zeros((c.num_pages,), np.int32)
+        # Optional page-pressure hook (PrefixCache installs itself
+        # here): called with the page shortfall before admission or
+        # reservation gives up, so cached-but-unreferenced prefixes are
+        # demoted/evicted instead of blocking live traffic.
+        self.reclaim_cb = None
         # fp8 cold-page pool: a parallel e4m3 page space plus one max-abs
         # scale per (layer, page, offset) row, blended in on gather by the
         # decode/verify steps wherever ``comp_mask`` is set.
@@ -145,6 +173,7 @@ class PagedKVCache:
             self.comp_mask = np.zeros((c.slots, c.pages_per_slot), bool)
             self._cfree = list(range(c.num_pages - 1, -1, -1))
             self._cheld = np.zeros((c.slots,), np.int32)
+            self._crefcount = np.zeros((c.num_pages,), np.int32)
 
     # -- page accounting ---------------------------------------------------
     @property
@@ -165,6 +194,49 @@ class PagedKVCache:
     @property
     def compressed_pages(self) -> int:
         return int(self._cheld.sum()) if self.compress else 0
+
+    @property
+    def live_pages(self) -> int:
+        """Physical f32 pages with at least one holder.  The pool
+        invariant under sharing is ``free_pages + live_pages ==
+        num_pages`` (``allocated_pages`` counts TABLE ENTRIES and
+        double-counts a page shared by two slots)."""
+        return int((self._refcount > 0).sum())
+
+    def refcounts_balanced(self) -> bool:
+        """True when every page is either on a free list (refcount 0)
+        or held (refcount > 0) with the free lists consistent -- the
+        drain-time leak check the BENCH_r17 drill asserts."""
+        ok = len(self._free) + self.live_pages == self.config.num_pages
+        ok = ok and not any(self._refcount[p] for p in self._free)
+        if self.compress:
+            live_c = int((self._crefcount > 0).sum())
+            ok = ok and len(self._cfree) + live_c == self.config.num_pages
+            ok = ok and not any(self._crefcount[p] for p in self._cfree)
+        return bool(ok)
+
+    # -- refcount primitives ----------------------------------------------
+    def add_page_ref(self, pid: int, kind: str = "f") -> None:
+        if kind == "c":
+            self._crefcount[pid] += 1
+        else:
+            self._refcount[pid] += 1
+
+    def drop_page_ref(self, pid: int, kind: str = "f") -> bool:
+        """Drop one holder; returns True when that freed the physical
+        page (last reference gone -- the page rejoins its free list
+        unzeroed, the masking contract keeps its stale bytes dark)."""
+        if kind == "c":
+            self._crefcount[pid] -= 1
+            if self._crefcount[pid] == 0:
+                self._cfree.append(int(pid))
+                return True
+            return False
+        self._refcount[pid] -= 1
+        if self._refcount[pid] == 0:
+            self._free.append(int(pid))
+            return True
+        return False
 
     @property
     def resident_bytes(self) -> int:
@@ -194,34 +266,62 @@ class PagedKVCache:
                 out.append((n, slot))
         return [slot for _, slot in sorted(out, reverse=True)]
 
-    def _cold_count(self, slot: int) -> int:
-        """Cold pages of ``slot`` still resident in f32: full pages at
-        least ``hot_pages`` behind the write head, minus the compressed
-        prefix.  Pages at or past ``lengths`` are NEVER cold -- the
-        decode/verify steps may still write them (speculative rejects
-        roll ``lengths`` back below already-written positions)."""
+    def _cold_indices(self, slot: int) -> List[int]:
+        """Table indices of ``slot``'s cold pages still resident in
+        f32: full pages at least ``hot_pages`` behind the write head
+        that are not yet compressed and not SHARED (migrating a page
+        another holder still reads through the f32 table would dangle
+        their gather).  Pages at or past ``lengths`` are NEVER cold --
+        the decode/verify steps may still write them (speculative
+        rejects roll ``lengths`` back below already-written
+        positions)."""
         c = self.config
         full = int(self.lengths[slot]) // c.page_size
-        return max(0, full - c.hot_pages - int(self._cheld[slot]))
+        out = []
+        for i in range(max(0, full - c.hot_pages)):
+            if self.comp_mask[slot, i]:
+                continue
+            if self._refcount[int(self.page_table[slot, i])] != 1:
+                continue
+            out.append(i)
+        return out
+
+    def _cold_count(self, slot: int) -> int:
+        return len(self._cold_indices(slot))
 
     def can_admit(self, length: int) -> bool:
         """Whether a sequence of ``length`` tokens fits the pool now.
 
         With compression the gate prices cold pages at their compressed
         size: f32 pages reclaimable by a cold sweep (bounded by e4m3
-        pool headroom) count as free."""
-        need = -(-max(int(length), 1) // self.config.page_size)
-        avail = len(self._free)
-        if self.compress:
-            cold = sum(self._cold_count(s)
-                       for s in range(self.config.slots))
-            avail += min(cold, len(self._cfree))
-        return need <= avail
+        pool headroom) count as free.  Under page pressure the prefix
+        tree's ``reclaim_cb`` is asked to demote/evict unreferenced
+        cached prefixes first -- live traffic always outranks cache
+        residency."""
 
-    def reserve(self, slot: int, length: int) -> None:
+        def avail() -> int:
+            a = len(self._free)
+            if self.compress:
+                cold = sum(self._cold_count(s)
+                           for s in range(self.config.slots))
+                a += min(cold, len(self._cfree))
+            return a
+
+        need = -(-max(int(length), 1) // self.config.page_size)
+        if need > avail() and self.reclaim_cb is not None:
+            self.reclaim_cb(need - avail())
+        return need <= avail()
+
+    def reserve(self, slot: int, length: int,
+                writable_from: Optional[int] = None) -> None:
         """Ensure slot ``slot`` has pages for ``length`` tokens,
         compressing other slots' cold pages on demand when the f32 free
-        list runs short."""
+        list runs short.
+
+        ``writable_from``: token position of the first upcoming WRITE
+        (the decode step's append point).  Every page covering
+        ``writable_from ..`` is made private first -- the copy-on-write
+        guard for shared prefix pages."""
         c = self.config
         if length > c.max_len:
             raise ValueError(f"length {length} exceeds max_len {c.max_len}")
@@ -229,6 +329,9 @@ class PagedKVCache:
         have = int(self._allocated[slot])
         if need > have:
             short = need - have - len(self._free)
+            if short > 0 and self.reclaim_cb is not None:
+                self.reclaim_cb(short)
+                short = need - have - len(self._free)
             if short > 0 and self.compress:
                 self._reclaim(short, exclude=slot)
             if need - have > len(self._free):
@@ -236,8 +339,45 @@ class PagedKVCache:
                     f"KV page pool exhausted: slot {slot} needs "
                     f"{need - have} page(s), {len(self._free)} free")
             for i in range(have, need):
-                self.page_table[slot, i] = self._free.pop()
+                pid = self._free.pop()
+                self._refcount[pid] = 1
+                self.page_table[slot, i] = pid
             self._allocated[slot] = need
+        if writable_from is not None:
+            self._make_writable(slot, writable_from)
+
+    def _make_writable(self, slot: int, from_pos: int) -> None:
+        """Copy-on-write guard: clone every still-shared page covering
+        positions ``>= from_pos`` into a private page before the slot
+        writes there.  The shared original is never mutated -- holders
+        reading it through the tree or another slot keep seeing the
+        exact bytes they attached (bitwise, by construction: the write
+        lands in the clone)."""
+        c = self.config
+        for i in range(int(from_pos) // c.page_size,
+                       int(self._allocated[slot])):
+            if self.compress and self.comp_mask[slot, i]:
+                raise RuntimeError(
+                    f"slot {slot} page {i} is fp8-demoted inside the "
+                    "write range; demotion must stay strictly below "
+                    "the write head")
+            pid = int(self.page_table[slot, i])
+            if self._refcount[pid] <= 1:
+                continue
+            if not self._free and self.reclaim_cb is not None:
+                self.reclaim_cb(1)
+            if not self._free and self.compress:
+                self._reclaim(1, exclude=slot)
+            if not self._free:
+                raise RuntimeError(
+                    "KV page pool exhausted during copy-on-write "
+                    f"divergence of slot {slot}")
+            new = self._free.pop()
+            self._refcount[new] = 1
+            self.k = self.k.at[:, new].set(self.k[:, pid])
+            self.v = self.v.at[:, new].set(self.v[:, pid])
+            self.page_table[slot, i] = new
+            self.drop_page_ref(pid)
 
     def _reclaim(self, pages: int, exclude: Optional[int] = None) -> int:
         """Compress cold pages across slots until ``pages`` f32 pages
@@ -253,22 +393,21 @@ class PagedKVCache:
     def compress_cold(self, slot: int, max_pages: Optional[int] = None
                       ) -> int:
         """Migrate up to ``max_pages`` of ``slot``'s cold pages into the
-        e4m3 pool (prefix order -- compression always extends the cold
-        prefix), returning their f32 pages to the free list.  The freed
-        f32 table entries are pointed at the scratch page; gathers never
-        read them (``comp_mask`` blends the e4m3 page in) but a sound
-        table beats a dangling one."""
+        e4m3 pool (lowest table index first -- compression grows from
+        the prefix end; shared pages are skipped, other holders still
+        read them through f32), returning their f32 pages to the free
+        list.  The freed f32 table entries are pointed at the scratch
+        page; gathers never read them (``comp_mask`` blends the e4m3
+        page in) but a sound table beats a dangling one."""
         if not self.compress:
             raise RuntimeError("cache built without compress=True")
         c = self.config
-        n = self._cold_count(slot)
+        idxs = self._cold_indices(slot)
         if max_pages is not None:
-            n = min(n, max_pages)
-        n = min(n, len(self._cfree))
-        if n <= 0:
+            idxs = idxs[:max_pages]
+        idxs = idxs[:len(self._cfree)]
+        if not idxs:
             return 0
-        start = int(self._cheld[slot])
-        idxs = list(range(start, start + n))
         pids = np.asarray([self.page_table[slot, i] for i in idxs],
                           np.int32)
         cpids = np.asarray([self._cfree.pop() for _ in idxs], np.int32)
@@ -283,22 +422,26 @@ class PagedKVCache:
         for i, cpid, pid in zip(idxs, cpids, pids):
             self.cpage_table[slot, i] = cpid
             self.comp_mask[slot, i] = True
+            self._crefcount[cpid] = 1
             self.page_table[slot, i] = c.scratch_page
-            self._free.append(int(pid))
-        self._cheld[slot] = start + n
-        return n
+            self.drop_page_ref(int(pid))
+        self._cheld[slot] += len(idxs)
+        return len(idxs)
 
     def free_slot(self, slot: int) -> None:
-        """Return the slot's pages to the pool and mark it idle.  Page
-        CONTENTS are deliberately left in place: the masking contract,
-        not zeroing, is what guarantees no stale attention mass."""
+        """Refcount-decrement the slot's pages and mark it idle.  A
+        private page rejoins the free list immediately; a SHARED page
+        (prefix tree or another slot still holds it) stays resident
+        until its last reference drops.  Page CONTENTS are deliberately
+        left in place either way: the masking contract, not zeroing, is
+        what guarantees no stale attention mass."""
         n = int(self._allocated[slot])
         for i in range(n - 1, -1, -1):
             if self.compress and self.comp_mask[slot, i]:
-                self._cfree.append(int(self.cpage_table[slot, i]))
+                self.drop_page_ref(int(self.cpage_table[slot, i]), "c")
                 self.comp_mask[slot, i] = False
             else:
-                self._free.append(int(self.page_table[slot, i]))
+                self.drop_page_ref(int(self.page_table[slot, i]))
         self._allocated[slot] = 0
         if self.compress:
             self._cheld[slot] = 0
@@ -320,31 +463,123 @@ class PagedKVCache:
                 self.free_slot(slot)
         return freed
 
+    # -- prefix sharing ----------------------------------------------------
+    def attach_pages(self, slot: int,
+                     entries: Sequence[Tuple[str, int]],
+                     length: int) -> None:
+        """Map already-resident pages into an EMPTY slot's table with
+        refcount +1 each -- the prefix-cache hit path: the matched
+        prefix's K/V is live without a single prefill FLOP.  Entries
+        are ``("f", page)`` f32 or ``("c", cpage)`` fp8-demoted; the
+        slot's first ``length`` tokens (``len(entries)`` full pages)
+        are then readable and the tail prefill continues at ``start=
+        length`` via :meth:`write_prefill`."""
+        c = self.config
+        if int(self._allocated[slot]):
+            raise RuntimeError(
+                f"attach_pages: slot {slot} is not empty")
+        if len(entries) * c.page_size != int(length):
+            raise ValueError(
+                f"attach_pages: {len(entries)} page(s) cannot back "
+                f"{length} tokens at page_size {c.page_size}")
+        for i, (kind, pid) in enumerate(entries):
+            if kind == "c":
+                if not self.compress:
+                    raise RuntimeError(
+                        "compressed prefix entry on a compress=False "
+                        "cache")
+                self.cpage_table[slot, i] = pid
+                self.comp_mask[slot, i] = True
+                self.page_table[slot, i] = c.scratch_page
+                self._cheld[slot] += 1
+            else:
+                self.page_table[slot, i] = pid
+            self.add_page_ref(pid, kind)
+        self._allocated[slot] = len(entries)
+        self.lengths[slot] = int(length)
+
+    def gather_pages(self, entries: Sequence[Tuple[str, int]]) -> tuple:
+        """Materialize page contents as chunked-prefill ``past``
+        operands: ``(k, v)`` each ``[num_layers, 1, n * page_size,
+        num_kv_heads, head_dim]``, fp8-demoted pages dequantized
+        through their per-row scales (same blend the decode gather
+        does)."""
+        c = self.config
+        fp = np.asarray([pid if kind == "f" else c.scratch_page
+                         for kind, pid in entries], np.int32)
+        any_c = any(kind == "c" for kind, _ in entries)
+        cp = np.asarray([pid if kind == "c" else 0
+                         for kind, pid in entries], np.int32)
+        cmask = np.asarray([kind == "c" for kind, _ in entries], bool)
+        out = []
+        for pool, qpool, scale in (
+                (self.k, getattr(self, "kq", None),
+                 getattr(self, "kscale", None)),
+                (self.v, getattr(self, "vq", None),
+                 getattr(self, "vscale", None))):
+            view = pool[:, jnp.asarray(fp)]        # [L, n, ps, H, D]
+            if any_c:
+                cpd = jnp.asarray(cp)
+                deq = (qpool[:, cpd].astype(jnp.float32)
+                       * scale[:, cpd][..., None, None]).astype(
+                           view.dtype)
+                view = jnp.where(
+                    jnp.asarray(cmask)[None, :, None, None, None],
+                    deq, view)
+            l, n, ps, hh, dd = view.shape
+            out.append(view.reshape(l, n * ps, hh, dd)[:, None])
+        return tuple(out)
+
+    def demote_page(self, pid: int) -> int:
+        """Quantize ONE tree-held f32 page into the e4m3 pool (the PR
+        14 codec) and return the compressed page id at refcount 1.  The
+        caller drops its f32 reference afterwards -- the prefix tree's
+        demotion tier under page pressure."""
+        if not self.compress:
+            raise RuntimeError("cache built without compress=True")
+        if not self._cfree:
+            raise RuntimeError("e4m3 pool exhausted")
+        cpid = int(self._cfree.pop())
+        dev = jnp.asarray(np.asarray([pid], np.int32))
+        kq, ksc = _quantize_pages(self.k, dev)
+        vq, vsc = _quantize_pages(self.v, dev)
+        cp = jnp.asarray(np.asarray([cpid], np.int32))
+        self.kq = self.kq.at[:, cp].set(kq)
+        self.vq = self.vq.at[:, cp].set(vq)
+        self.kscale = self.kscale.at[:, cp].set(ksc)
+        self.vscale = self.vscale.at[:, cp].set(vsc)
+        self._crefcount[cpid] = 1
+        return cpid
+
     # -- device writes -----------------------------------------------------
-    def write_prefill(self, slot: int, k_layers, v_layers) -> None:
+    def write_prefill(self, slot: int, k_layers, v_layers,
+                      start: int = 0) -> None:
         """Scatter a prefilled prompt's K/V into the slot's pages.
 
         ``k_layers``/``v_layers``: ``[num_layers, t, num_kv_heads,
         head_dim]`` (post-RoPE, as the decode step expects).  Reserves
-        pages for ``t`` tokens and sets ``lengths[slot] = t``.
-        """
+        pages for ``start + t`` tokens and sets ``lengths[slot] =
+        start + t``.  ``start`` is the prefix-cache seam: a matched
+        prefix's pages are already attached and immutable, only the
+        tail ``[start:]`` is scattered (through the copy-on-write
+        guard, so a partial shared page is cloned first)."""
         c = self.config
         t = int(k_layers.shape[1])
-        self.reserve(slot, t)
-        pos = np.arange(t)
+        self.reserve(slot, start + t, writable_from=start)
+        pos = np.arange(start, start + t)
         pages = jnp.asarray(self.page_table[slot][pos // c.page_size])
         offs = jnp.asarray(pos % c.page_size)
         dt = jnp.dtype(c.dtype)
         # One scatter per pool: [L, t, H, D] lands at (page, off) pairs.
         self.k = self.k.at[:, pages, offs].set(k_layers.astype(dt))
         self.v = self.v.at[:, pages, offs].set(v_layers.astype(dt))
-        self.lengths[slot] = t
+        self.lengths[slot] = start + t
 
     def grow(self, slot: int) -> None:
         """Account one decoded token (the decode step already wrote its
         K/V in-step); reserves the next page at a boundary crossing."""
         new_len = int(self.lengths[slot]) + 1
-        self.reserve(slot, new_len)
+        self.reserve(slot, new_len, writable_from=new_len - 1)
         self.lengths[slot] = new_len
 
     # -- step operands -----------------------------------------------------
@@ -371,6 +606,284 @@ class PagedKVCache:
 
     def layout(self) -> dict:
         return self.config.layout()
+
+
+class _PrefixNode:
+    """One full page of prompt tokens in the radix tree.  ``key`` is
+    the page's token-id tuple, ``page`` the backing page id (``kind``
+    ``"f"`` f32 or ``"c"`` fp8-demoted), ``touch`` the LRU clock,
+    ``pins`` the live-session pin count."""
+
+    __slots__ = ("key", "parent", "children", "kind", "page", "touch",
+                 "pins", "dead")
+
+    def __init__(self, key, parent, kind, page, touch):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[tuple, "_PrefixNode"] = {}
+        self.kind = kind
+        self.page = page
+        self.touch = touch
+        self.pins = 0
+        self.dead = False
+
+
+class PrefixCache:
+    """Radix tree over token-id prefixes -> refcounted KV pages.
+
+    The tree's unit is one FULL page (``page_size`` token ids); a
+    request's prompt is matched page-chunk by page-chunk, and every
+    matched chunk's K/V is already resident -- :meth:`match` +
+    :meth:`PagedKVCache.attach_pages` make the whole matched prefix
+    live with zero prefill FLOPs, only the tail runs through the PR 14
+    chunked flash prefill.  After a prefill the slot's full prompt
+    pages are :meth:`insert`-ed, so the NEXT request sharing the prefix
+    hits (the tree holds its own +1 reference per page; tree-held pages
+    survive ``free_slot``).
+
+    Multi-turn sessions: :meth:`pin_session` pins the node path of a
+    session's context so it stays warm across requests; pins expire
+    after ``session_ttl_steps`` engine steps without reuse
+    (:meth:`tick`).  Under page pressure (:meth:`release_pages`,
+    installed as the cache's ``reclaim_cb``) tree-only f32 pages are
+    first DEMOTED into the fp8 cold-page pool (still matchable, ~4x
+    cheaper), then evicted leaf-first in LRU order -- unpinned entries
+    before pinned ones, so live sessions are the last thing page
+    pressure takes.
+    """
+
+    def __init__(self, cache: PagedKVCache,
+                 session_ttl_steps: int = 0):
+        self.cache = cache
+        self.session_ttl_steps = int(session_ttl_steps)
+        self._children: Dict[tuple, _PrefixNode] = {}
+        self._clock = 0
+        self._sessions: "collections.OrderedDict[object, dict]" = \
+            collections.OrderedDict()
+        self.queries = 0
+        self.hits = 0
+        self.nodes = 0
+        reg = _registry()
+        self._g_hit = reg.gauge(
+            "horovod_serving_prefix_hit_rate",
+            "Fraction of prefill queries that matched a cached prefix")
+        self._g_pages = reg.gauge(
+            "horovod_serving_prefix_pages",
+            "KV pages pinned by the prefix tree")
+        self._g_sessions = reg.gauge(
+            "horovod_serving_sessions_live",
+            "Sessions with pinned warm KV context")
+        self._m_tok = reg.counter(
+            "horovod_serving_prefix_tokens_total",
+            "Prefill tokens by provenance (cached = prefill FLOPs "
+            "avoided)", labelnames=("source",))
+        cache.reclaim_cb = self.release_pages
+
+    # -- stats -------------------------------------------------------------
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.queries if self.queries else 0.0
+
+    @property
+    def sessions_live(self) -> int:
+        return len(self._sessions)
+
+    def stats(self) -> dict:
+        return {"queries": self.queries, "hits": self.hits,
+                "hit_rate": self.hit_rate, "nodes": self.nodes,
+                "sessions": len(self._sessions)}
+
+    # -- the radix walk ----------------------------------------------------
+    def _chunk(self, prompt, i: int) -> tuple:
+        ps = self.cache.config.page_size
+        return tuple(int(x) for x in prompt[i * ps:(i + 1) * ps])
+
+    def match(self, prompt) -> Tuple[int, List[Tuple[str, int]]]:
+        """Deepest cached prefix of ``prompt`` in full pages, capped at
+        ``len(prompt) - 1`` tokens so the tail prefill always has at
+        least one token to produce first-token logits from.  Returns
+        ``(matched_tokens, [(kind, page), ...])`` ready for
+        :meth:`PagedKVCache.attach_pages`."""
+        ps = self.cache.config.page_size
+        limit = (len(prompt) - 1) // ps
+        entries: List[Tuple[str, int]] = []
+        children = self._children
+        for i in range(limit):
+            node = children.get(self._chunk(prompt, i))
+            if node is None:
+                break
+            node.touch = self._clock
+            entries.append((node.kind, node.page))
+            children = node.children
+        self.queries += 1
+        if entries:
+            self.hits += 1
+        matched = len(entries) * ps
+        self._m_tok.labels(source="cached").inc(matched)
+        self._m_tok.labels(source="computed").inc(len(prompt) - matched)
+        self._g_hit.set(self.hit_rate)
+        return matched, entries
+
+    def insert(self, prompt, slot: int) -> int:
+        """Register ``slot``'s resident full prompt pages under their
+        token chunks (tree refcount +1 each); chunks already present
+        are touched, not duplicated.  Returns newly registered pages."""
+        cache = self.cache
+        n = min(len(prompt), int(cache.lengths[slot])) \
+            // cache.config.page_size
+        children = self._children
+        parent = None
+        new = 0
+        for i in range(n):
+            key = self._chunk(prompt, i)
+            node = children.get(key)
+            if node is None:
+                if cache.compress and cache.comp_mask[slot, i]:
+                    kind, pid = "c", int(cache.cpage_table[slot, i])
+                else:
+                    kind, pid = "f", int(cache.page_table[slot, i])
+                node = _PrefixNode(key, parent, kind, pid, self._clock)
+                cache.add_page_ref(pid, kind)
+                children[key] = node
+                self.nodes += 1
+                new += 1
+            node.touch = self._clock
+            parent = node
+            children = node.children
+        self._g_pages.set(self.nodes)
+        return new
+
+    # -- sessions ----------------------------------------------------------
+    def pin_session(self, sid, prompt) -> None:
+        """Pin the node path backing ``prompt``'s full pages under
+        session id ``sid`` -- the multi-turn warm set.  Re-pinning the
+        same session releases its previous pins first (the context
+        grew) and refreshes its TTL."""
+        nodes: List[_PrefixNode] = []
+        children = self._children
+        n = len(prompt) // self.cache.config.page_size
+        for i in range(n):
+            node = children.get(self._chunk(prompt, i))
+            if node is None:
+                break
+            nodes.append(node)
+            children = node.children
+        old = self._sessions.pop(sid, None)
+        if old is not None:
+            for nd in old["nodes"]:
+                if not nd.dead:
+                    nd.pins -= 1
+        for nd in nodes:
+            nd.pins += 1
+        self._sessions[sid] = {"nodes": nodes, "step": self._clock}
+        self._g_sessions.set(len(self._sessions))
+
+    def touch_session(self, sid) -> bool:
+        """Refresh a session's TTL on reuse; True when it was warm."""
+        entry = self._sessions.get(sid)
+        if entry is None:
+            return False
+        entry["step"] = self._clock
+        self._sessions.move_to_end(sid)
+        return True
+
+    def _expire_session(self, sid) -> None:
+        entry = self._sessions.pop(sid)
+        for nd in entry["nodes"]:
+            if not nd.dead:
+                nd.pins -= 1
+        self._g_sessions.set(len(self._sessions))
+
+    def tick(self, steps: int = 1) -> None:
+        """Advance the LRU/TTL clock (one call per engine step).
+        Sessions idle past ``session_ttl_steps`` lose their pins --
+        their pages stay cached but become ordinary LRU fodder."""
+        self._clock += int(steps)
+        if not self.session_ttl_steps:
+            return
+        while self._sessions:
+            sid, entry = next(iter(self._sessions.items()))
+            if self._clock - entry["step"] <= self.session_ttl_steps:
+                break
+            self._expire_session(sid)
+
+    # -- pressure: demote, then evict --------------------------------------
+    def _iter_nodes(self):
+        stack = list(self._children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            yield node
+
+    def _drop(self, node: _PrefixNode) -> bool:
+        """Remove one leaf; True when its f32 page actually freed."""
+        owner = self._children if node.parent is None \
+            else node.parent.children
+        owner.pop(node.key, None)
+        node.dead = True
+        self.nodes -= 1
+        freed = self.cache.drop_page_ref(node.page, node.kind)
+        self._g_pages.set(self.nodes)
+        return freed and node.kind == "f"
+
+    def _demote(self, need: int) -> int:
+        """fp8 demotion tier: quantize LRU tree-only f32 pages into the
+        cold pool, freeing their f32 pages while keeping the prefix
+        matchable."""
+        cache = self.cache
+        if not cache.compress:
+            return 0
+        cand = [nd for nd in self._iter_nodes()
+                if nd.kind == "f" and cache._refcount[nd.page] == 1]
+        cand.sort(key=lambda nd: nd.touch)
+        freed = 0
+        for nd in cand:
+            if freed >= need or not cache._cfree:
+                break
+            cpid = cache.demote_page(nd.page)
+            if cache.drop_page_ref(nd.page):
+                freed += 1
+            nd.kind, nd.page = "c", cpid
+        return freed
+
+    def _evict(self, need: int) -> int:
+        """LRU leaf eviction, unpinned entries strictly before pinned
+        ones (a live session's warm set is the last thing to go)."""
+        freed = 0
+        for take_pinned in (False, True):
+            while freed < need:
+                leaves = [nd for nd in self._iter_nodes()
+                          if not nd.children
+                          and (nd.pins > 0) == take_pinned]
+                if not leaves:
+                    break
+                if self._drop(min(leaves, key=lambda nd: nd.touch)):
+                    freed += 1
+            if freed >= need:
+                break
+        return freed
+
+    def release_pages(self, need: int) -> int:
+        """Give back ``need`` f32 pages to live traffic: demote first
+        (residency survives at e4m3 cost), evict LRU after.  Installed
+        as the cache's ``reclaim_cb``."""
+        freed = self._demote(need)
+        if freed < need:
+            freed += self._evict(need - freed)
+        return freed
+
+    def drop_all(self) -> None:
+        """Release every tree reference and session pin (drain/leak
+        check: afterwards the pool must be fully free again)."""
+        for sid in list(self._sessions):
+            self._expire_session(sid)
+        while True:
+            leaves = [nd for nd in self._iter_nodes()
+                      if not nd.children]
+            if not leaves:
+                break
+            for nd in leaves:
+                self._drop(nd)
 
 
 def _quantize_pages(pool, pids):

@@ -8,7 +8,20 @@ queueing delay from the TTFT distribution).
 
 Everything is driven by one seeded ``numpy.random.RandomState``:
 identical :class:`LoadSpec` -> identical request stream, byte for byte
-(asserted in tests), so bench rounds are reproducible.
+(asserted in tests), so bench rounds are reproducible.  The PR 16
+traffic shapes draw from the SAME stream in a fixed order, so turning
+them off reproduces the pre-PR-16 streams exactly:
+
+* prefix sharing -- ``prefix_share`` of requests prepend one of
+  ``num_prefixes`` fixed shared prefixes (system prompts / RAG
+  templates) to their unique tail, the workload the prefix cache's
+  radix matching converts into avoided prefill FLOPs;
+* multi-turn sessions -- ``session_share`` of requests open a session
+  whose follow-up turns EXTEND the previous turn's prompt (same
+  ``session_id``), exercising the warm-KV session path;
+* tenant mix -- ``tenants`` assigns each request an SLO class name by
+  weight, so the scheduler's weighted admission and the fairness gate
+  have a mixed (or adversarial) population to schedule.
 """
 
 from __future__ import annotations
@@ -34,6 +47,22 @@ class LoadSpec:
     vocab_size: int = 256
     num_adapters: int = 0                      # 0: base model only
     seed: int = 0
+    # Prefix-shared traffic (0.0 disables, streams stay pre-PR-16
+    # byte-identical): a shared request's prompt = one of
+    # ``num_prefixes`` fixed prefixes (length from ``prefix_lens``)
+    # ++ a unique tail of ``prompt_lens`` tokens.
+    prefix_share: float = 0.0
+    num_prefixes: int = 1
+    prefix_lens: Tuple[int, ...] = (64,)
+    # Multi-turn sessions: ``session_share`` of non-continuation
+    # requests open a session; later requests continue the oldest open
+    # session (prompt = previous turn's prompt ++ fresh delta) until it
+    # reaches ``session_turns`` turns.
+    session_share: float = 0.0
+    session_turns: int = 1
+    # Tenant mix: ``((name, arrival_weight), ...)``; empty = everyone
+    # is the single implicit "default" tenant.
+    tenants: Tuple[Tuple[str, float], ...] = ()
 
     def __post_init__(self):
         if self.num_requests < 1:
@@ -49,6 +78,24 @@ class LoadSpec:
                 raise ValueError(
                     f"{name}_weights length {len(weights)} != "
                     f"{len(lens)} choices")
+        if not 0.0 <= self.prefix_share <= 1.0:
+            raise ValueError(
+                f"prefix_share must be in [0, 1]: {self.prefix_share}")
+        if not 0.0 <= self.session_share <= 1.0:
+            raise ValueError(
+                f"session_share must be in [0, 1]: {self.session_share}")
+        if self.prefix_share > 0 and (
+                self.num_prefixes < 1 or not self.prefix_lens
+                or any(x < 1 for x in self.prefix_lens)):
+            raise ValueError(
+                "prefix_share > 0 needs num_prefixes >= 1 and positive "
+                "prefix_lens")
+        if self.session_turns < 1:
+            raise ValueError("session_turns must be >= 1")
+        for t in self.tenants:
+            if len(t) != 2 or not t[0] or float(t[1]) <= 0:
+                raise ValueError(
+                    f"tenants entries are (name, weight > 0): {t}")
 
 
 def _norm(weights: Optional[Sequence[float]], n: int):
@@ -70,20 +117,86 @@ def long_prompt_spec(**overrides) -> LoadSpec:
     return LoadSpec(**base)
 
 
+def prefix_spec(**overrides) -> LoadSpec:
+    """The BENCH_r17 prefix-shared mixture: >= 50% of requests share
+    one of a handful of fixed 64-token system prefixes, a quarter open
+    two-turn sessions, and arrivals split across a gold/bronze tenant
+    mix -- the workload where the radix prefix cache's avoided-prefill
+    win is measurable."""
+    base = dict(num_requests=40, rate_rps=30.0,
+                prompt_lens=(8, 16), output_lens=(8, 16),
+                prefix_share=0.6, num_prefixes=4, prefix_lens=(64,),
+                session_share=0.25, session_turns=2,
+                tenants=(("gold", 4.0), ("bronze", 1.0)), seed=0)
+    base.update(overrides)
+    return LoadSpec(**base)
+
+
 def generate(spec: LoadSpec) -> List[Request]:
-    """Materialize the request stream for ``spec`` (sorted by arrival)."""
+    """Materialize the request stream for ``spec`` (sorted by arrival).
+
+    Determinism contract: one RandomState, draws in a FIXED order per
+    request, and each PR 16 feature draws only when enabled -- identical
+    specs yield byte-identical streams, and all-defaults specs yield the
+    exact pre-PR-16 streams.
+    """
     rng = np.random.RandomState(spec.seed)
     pw = _norm(spec.prompt_weights, len(spec.prompt_lens))
     ow = _norm(spec.output_weights, len(spec.output_lens))
+    tenant_names = [str(t[0]) for t in spec.tenants]
+    tw = _norm([float(t[1]) for t in spec.tenants],
+               len(spec.tenants)) if spec.tenants else None
+    prefixes: List[np.ndarray] = []
+    if spec.prefix_share > 0:
+        for i in range(spec.num_prefixes):
+            plen = int(spec.prefix_lens[i % len(spec.prefix_lens)])
+            prefixes.append(rng.randint(
+                0, spec.vocab_size, size=plen).astype(np.int32))
+    sessions_on = spec.session_share > 0 and spec.session_turns > 1
+    open_sessions: List[dict] = []   # FIFO of {sid, ctx, turns}
+    next_sid = 0
     out: List[Request] = []
     t = 0.0
     for rid in range(spec.num_requests):
         # Poisson process: exponential inter-arrival gaps.
         t += float(rng.exponential(1.0 / spec.rate_rps))
+        tenant = "default"
+        if tenant_names:
+            tenant = tenant_names[int(rng.choice(len(tenant_names),
+                                                 p=tw))]
+        cont = None
+        if sessions_on and open_sessions and rng.rand() < 0.5:
+            cont = open_sessions.pop(0)
+        base = None
+        if cont is None and prefixes and rng.rand() < spec.prefix_share:
+            base = prefixes[int(rng.randint(len(prefixes)))]
+        # Legacy draw order from here (gap happened above): prompt
+        # length, output length, prompt tokens -- all-defaults specs
+        # reproduce the pre-PR-16 streams byte for byte.
         plen = int(rng.choice(spec.prompt_lens, p=pw))
         olen = int(rng.choice(spec.output_lens, p=ow))
-        prompt = rng.randint(0, spec.vocab_size, size=plen).astype(np.int32)
+        tail = rng.randint(0, spec.vocab_size,
+                           size=plen).astype(np.int32)
+        sid: Optional[int] = None
+        if cont is not None:
+            # Session continuation: the previous turn's prompt plus a
+            # fresh delta -- the stored context radix-matches whole.
+            prompt = np.concatenate([cont["ctx"], tail])
+            sid = cont["sid"]
+            cont["turns"] += 1
+            cont["ctx"] = prompt
+            if cont["turns"] < spec.session_turns:
+                open_sessions.append(cont)
+        else:
+            prompt = tail if base is None \
+                else np.concatenate([base, tail])
+            if sessions_on and rng.rand() < spec.session_share:
+                sid = next_sid
+                next_sid += 1
+                open_sessions.append(
+                    {"sid": sid, "ctx": prompt, "turns": 1})
         adapter = rid % spec.num_adapters if spec.num_adapters else 0
         out.append(Request(rid=rid, prompt=prompt, max_new_tokens=olen,
-                           adapter_id=adapter, arrival_s=t))
+                           adapter_id=adapter, arrival_s=t,
+                           tenant=tenant, session_id=sid))
     return out

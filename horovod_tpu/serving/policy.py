@@ -104,6 +104,10 @@ class SLOSample:
     healthy: Tuple[int, ...]       # global device ids still usable
     dead_ranks: Tuple[int, ...] = ()
     evict_candidate: Optional[Tuple[int, float]] = None  # (rank, lateness)
+    # Radix prefix-cache hit rate 0..1 (None when the cache is off):
+    # a policy can weigh a scale-down differently when most prefill is
+    # being absorbed by cached pages.
+    prefix_hit_rate: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,17 +27,29 @@ mesh.  Every transition is instrumented through the PR 6
   draft tokens proposed vs accepted (acceptance rate =
   accepted / proposed),
 * ``horovod_serving_ttft_seconds`` / ``horovod_serving_token_latency_seconds``
-  histograms (time-to-first-token, per-output-token latency)
+  histograms (time-to-first-token, per-output-token latency),
+* per-tenant SLO families (PR 16):
+  ``horovod_serving_ttft_by_tenant_seconds{tenant}``,
+  ``horovod_serving_tenant_occupancy{tenant}``,
+  ``horovod_serving_tenant_queue_depth{tenant}``
 
 -- the same families the bench serving block and ``serving_probe``
 scrape back out of ``/metrics``.
+
+Multi-tenancy (PR 16): :class:`TenantClass` declares per-class weight,
+TTFT SLO budget and slot-share cap; admission becomes stride scheduling
+over per-tenant FIFO heads (weighted fair service, no class starves,
+an adversarial flood is capped at its ``max_share`` of the batch).
+With no classes configured the scheduler is the original single-tenant
+strict-FIFO, unchanged.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import List, Optional, Tuple
+import math
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -47,6 +59,48 @@ from ..timeline.metrics import registry as _registry
 # sweet spot; extend the low end so p50 lands inside a bucket.
 LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
                    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantClass:
+    """One SLO class in the multi-tenant scheduler.
+
+    ``weight`` drives stride-scheduled admission (a tenant's share of
+    admitted prefill+decode work is proportional to its weight under
+    contention); ``max_share`` caps the fraction of decode slots the
+    tenant may hold while OTHER tenants are queued (an adversarial
+    flood cannot starve the batch); ``ttft_slo_s`` is the class's TTFT
+    p99 budget -- the fairness gate the BENCH_r17 drill asserts."""
+
+    name: str
+    weight: float = 1.0
+    ttft_slo_s: float = 1.0
+    max_share: float = 1.0
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"tenant {self.name}: weight must be > 0")
+        if not 0.0 < self.max_share <= 1.0:
+            raise ValueError(
+                f"tenant {self.name}: max_share must be in (0, 1]")
+
+
+def parse_tenant_classes(spec: str) -> Dict[str, TenantClass]:
+    """``"name:weight[:ttft_slo_s[:max_share]],..."`` -> class map
+    (the ``HOROVOD_TENANT_CLASSES`` wire format)."""
+    out: Dict[str, TenantClass] = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        name = parts[0]
+        weight = float(parts[1]) if len(parts) > 1 else 1.0
+        slo = float(parts[2]) if len(parts) > 2 else 1.0
+        share = float(parts[3]) if len(parts) > 3 else 1.0
+        out[name] = TenantClass(name=name, weight=weight,
+                                ttft_slo_s=slo, max_share=share)
+    return out
 
 
 @dataclasses.dataclass
@@ -65,6 +119,8 @@ class Request:
     first_token_s: Optional[float] = None
     done_s: Optional[float] = None
     token_latencies: List[float] = dataclasses.field(default_factory=list)
+    tenant: str = "default"            # SLO class (TenantClass.name)
+    session_id: Optional[int] = None   # multi-turn warm-KV session key
 
     @property
     def prompt_len(self) -> int:
@@ -84,7 +140,8 @@ class Request:
 class ContinuousBatchScheduler:
     """Admit/evict requests into a fixed-shape decode batch."""
 
-    def __init__(self, slots: int, cache=None, token_budget: int = 1):
+    def __init__(self, slots: int, cache=None, token_budget: int = 1,
+                 tenants: Optional[Dict[str, TenantClass]] = None):
         if slots < 1:
             raise ValueError(f"need at least one slot, got {slots}")
         if token_budget < 1:
@@ -101,6 +158,13 @@ class ContinuousBatchScheduler:
         self.active: dict[int, Request] = {}
         self._free_slots = list(range(slots - 1, -1, -1))  # pop() -> 0, 1...
         self.admitting = True
+        # Multi-tenant SLO classes: empty means single-tenant strict
+        # FIFO (the pre-PR-16 behavior, byte for byte).  With classes,
+        # admission is stride-scheduled per tenant (weighted fair) and
+        # per-tenant occupancy caps apply under contention.
+        self.tenants: Dict[str, TenantClass] = dict(tenants or {})
+        self._tenant_pass: Dict[str, float] = {}
+        self._tenants_seen = {"default"} | set(self.tenants)
         reg = _registry()
         self._m_requests = reg.counter(
             "horovod_serving_requests_total",
@@ -127,6 +191,20 @@ class ContinuousBatchScheduler:
             "horovod_serving_spec_tokens_total",
             "Speculative-decoding draft tokens by outcome",
             labelnames=("outcome",))
+        # Per-tenant SLO families, registered alongside the slot-state
+        # gauges so the control plane's policies can read them.
+        self._m_ttft_tenant = reg.histogram(
+            "horovod_serving_ttft_by_tenant_seconds",
+            "Time to first token per SLO class",
+            buckets=LATENCY_BUCKETS, labelnames=("tenant",))
+        self._m_tenant_occ = reg.gauge(
+            "horovod_serving_tenant_occupancy",
+            "Decode-batch slot fraction held per SLO class",
+            labelnames=("tenant",))
+        self._m_tenant_queue = reg.gauge(
+            "horovod_serving_tenant_queue_depth",
+            "Requests waiting for a slot per SLO class",
+            labelnames=("tenant",))
 
     # -- state gauges ------------------------------------------------------
     @property
@@ -145,6 +223,45 @@ class ContinuousBatchScheduler:
         self._m_slot_states.labels(state="active").set(
             len(self.active) - draining)
         self._m_slot_states.labels(state="free").set(len(self._free_slots))
+        for tname in self._tenants_seen:
+            self._m_tenant_occ.labels(tenant=tname).set(
+                sum(1 for r in self.active.values()
+                    if r.tenant == tname) / self.slots)
+            self._m_tenant_queue.labels(tenant=tname).set(
+                sum(1 for r in self.queue if r.tenant == tname))
+
+    # -- tenant fairness ---------------------------------------------------
+    def _tclass(self, name: str) -> TenantClass:
+        return self.tenants.get(name) or TenantClass(name=name)
+
+    def _pick_index(self) -> int:
+        """Index into ``queue`` of the next admission candidate.
+
+        Single-tenant: 0 -- strict FIFO, the head blocks (no
+        head-of-line bypass, TTFT ordering stays honest).  With tenant
+        classes: stride scheduling over each tenant's FIFO head -- the
+        tenant with the lowest weight-normalized virtual pass goes
+        next, skipping tenants at their ``max_share`` occupancy cap
+        while others wait.  -1 when every waiting tenant is capped."""
+        if not self.tenants:
+            return 0
+        heads: Dict[str, int] = {}
+        for qi, req in enumerate(self.queue):
+            if req.tenant not in heads:
+                heads[req.tenant] = qi
+        active_by: Dict[str, int] = {}
+        for r in self.active.values():
+            active_by[r.tenant] = active_by.get(r.tenant, 0) + 1
+        best = None
+        for tname, qi in heads.items():
+            tc = self._tclass(tname)
+            cap = max(1, math.ceil(tc.max_share * self.slots))
+            if len(heads) > 1 and active_by.get(tname, 0) >= cap:
+                continue
+            key = (self._tenant_pass.get(tname, 0.0), qi)
+            if best is None or key < best[0]:
+                best = (key, qi)
+        return -1 if best is None else best[1]
 
     # -- transitions -------------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -154,33 +271,51 @@ class ContinuousBatchScheduler:
             raise ValueError(f"request {req.rid}: empty prompt")
         req.state = "queued"
         self.queue.append(req)
+        if req.tenant not in self._tenants_seen:
+            self._tenants_seen.add(req.tenant)
+        if self.tenants and req.tenant not in self._tenant_pass:
+            # A late-joining tenant starts at the current minimum pass,
+            # not zero -- stride scheduling's no-catchup-monopoly rule.
+            self._tenant_pass[req.tenant] = min(
+                self._tenant_pass.values(), default=0.0)
         self._m_requests.labels(event="submitted").inc()
         self._update_gauges()
 
     def admit(self, now_s: float) -> List[Tuple[int, Request]]:
         """Move queued requests into free slots while pages allow.
 
-        FIFO admission: the head of the queue blocks (no head-of-line
-        bypass -- keeps TTFT ordering honest under overload).  Returns
-        ``(slot, request)`` pairs the engine must now prefill.
+        Single-tenant: FIFO, the head of the queue blocks (no
+        head-of-line bypass -- keeps TTFT ordering honest under
+        overload).  With tenant classes the candidate comes from
+        :meth:`_pick_index` (weighted fair, occupancy-capped) and that
+        CANDIDATE blocks on pages -- ordering stays honest per class.
+        Returns ``(slot, request)`` pairs the engine must now prefill.
         """
         out: List[Tuple[int, Request]] = []
         if not self.admitting:
             self._update_gauges()
             return out
         while self.queue and self._free_slots:
-            req = self.queue[0]
+            qi = self._pick_index()
+            if qi < 0:
+                break
+            req = self.queue[qi]
             # + token_budget: room for a full step's worth of generated
             # tokens beyond the prompt (1 plain, k+1 speculative).
             if self.cache is not None and not self.cache.can_admit(
                     req.prompt_len + self.token_budget):
                 break
-            self.queue.popleft()
+            del self.queue[qi]
             slot = self._free_slots.pop()
             req.slot = slot
             req.state = "prefill"
             req.admit_s = now_s
             self.active[slot] = req
+            if self.tenants:
+                tc = self._tclass(req.tenant)
+                self._tenant_pass[req.tenant] = \
+                    self._tenant_pass.get(req.tenant, 0.0) \
+                    + (req.prompt_len + self.token_budget) / tc.weight
             self._m_requests.labels(event="admitted").inc()
             out.append((slot, req))
         self._update_gauges()
@@ -194,6 +329,8 @@ class ContinuousBatchScheduler:
         self._m_tokens.labels(phase="prefill").inc(req.prompt_len)
         self._m_tokens.labels(phase="decode").inc()  # the sampled token
         self._m_ttft.observe(max(now_s - req.arrival_s, 0.0))
+        self._m_ttft_tenant.labels(tenant=req.tenant).observe(
+            max(now_s - req.arrival_s, 0.0))
 
     def note_decode_token(self, req: Request, latency_s: float) -> None:
         self._m_tokens.labels(phase="decode").inc()
@@ -211,6 +348,16 @@ class ContinuousBatchScheduler:
         self._m_spec.labels(outcome="proposed").inc(proposed)
         self._m_spec.labels(outcome="accepted").inc(accepted)
 
+    def _release(self, slot: int) -> None:
+        """The ONE place a slot and its KV pages return to the pool --
+        completion (:meth:`release`) and drain (:meth:`suspend`) both
+        land here, so the refcounted page release (shared prefix pages
+        decrement; the last holder frees) cannot diverge between
+        paths."""
+        self._free_slots.append(slot)
+        if self.cache is not None:
+            self.cache.free_slot(slot)
+
     def release(self, slot: int, now_s: float, *,
                 completed: bool = True) -> Request:
         """done: recycle the slot (and its KV pages) immediately."""
@@ -218,9 +365,7 @@ class ContinuousBatchScheduler:
         req.state = "done"
         req.done_s = now_s
         req.slot = -1
-        self._free_slots.append(slot)
-        if self.cache is not None:
-            self.cache.free_slot(slot)
+        self._release(slot)
         self._m_requests.labels(
             event="completed" if completed else "evicted").inc()
         self._update_gauges()
@@ -254,9 +399,7 @@ class ContinuousBatchScheduler:
         req = self.active.pop(slot)
         req.state = "suspended"
         req.slot = -1
-        self._free_slots.append(slot)
-        if self.cache is not None:
-            self.cache.free_slot(slot)
+        self._release(slot)
         self._m_requests.labels(event="suspended").inc()
         self._update_gauges()
         return req

@@ -2024,3 +2024,217 @@ def test_bench_sdc_mode_flags(monkeypatch):
     monkeypatch.delenv("BENCH_SDC_STEPS")
     b = importlib.reload(bench)
     assert not b.SDC_BENCH
+
+def scan_prefix_entries(bench_dir):
+    """Return [(path, why), ...] for malformed prefix-cache bench
+    entries (the BENCH_r17 round-17 gates)."""
+    bad = []
+    tol = 1e-3
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            if not str(parsed.get("config", "")).endswith("_prefix"):
+                continue
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "prefix vs_baseline must be null"))
+            p = parsed.get("prefix") or {}
+            hit = p.get("hit") or {}
+            q, h = hit.get("queries"), hit.get("hits")
+            if not q or not isinstance(h, int) or h < 1:
+                bad.append((path, f"no prefix hits earned: {hit!r}"))
+            elif abs(hit.get("hit_rate", -1) - h / q) > tol:
+                bad.append((path, f"hit_rate inconsistent with "
+                                  f"hits/queries: {hit!r}"))
+            pf = p.get("prefill") or {}
+            cached = pf.get("tokens_cached", 0)
+            total = cached + pf.get("tokens_computed", 0)
+            avoided = pf.get("flops_avoided", -1)
+            if not total or abs(avoided - cached / total) > tol:
+                bad.append((path, f"flops_avoided inconsistent with "
+                                  f"token counts: {pf!r}"))
+            if not isinstance(avoided, (int, float)) or avoided < 0.4:
+                bad.append((path, f"prefill flops avoided under 0.4: "
+                                  f"{avoided!r}"))
+            if (p.get("load") or {}).get("prefix_share", 0) < 0.5:
+                bad.append((path, "prefix share of traffic under 0.5"))
+            t = p.get("ttft") or {}
+            if not (t.get("warm_p99_ms", float("inf"))
+                    < t.get("cold_p99_ms", 0)):
+                bad.append((path, f"warm TTFT p99 not strictly under "
+                                  f"cold: {t!r}"))
+            if (t.get("warm_p50_ms", 0) > t.get("warm_p99_ms", 0)
+                    or t.get("cold_p50_ms", 0) > t.get("cold_p99_ms", 0)):
+                bad.append((path, f"TTFT p50 exceeds p99: {t!r}"))
+            tp = p.get("throughput") or {}
+            warm = tp.get("warm_tokens_per_s")
+            if warm != parsed.get("value"):
+                bad.append((path, "headline value must be the warm "
+                                  "end-to-end tokens/s"))
+            if not isinstance(warm, (int, float)) or warm < tp.get(
+                    "baseline_r15_tokens_per_s", float("inf")):
+                bad.append((path, f"warm tokens/s under the r15 "
+                                  f"headline: {tp!r}"))
+            if warm is None or warm < tp.get("cold_tokens_per_s",
+                                             float("inf")):
+                bad.append((path, f"warm tokens/s under cold: {tp!r}"))
+            d = p.get("drain") or {}
+            if d.get("leaked_pages") != 0:
+                bad.append((path, f"leaked pages at drain: "
+                                  f"{d.get('leaked_pages')!r}"))
+            if d.get("refcounts_balanced") is not True:
+                bad.append((path, "refcounts not balanced at drain"))
+            fair = p.get("fairness") or {}
+            classes = fair.get("classes") or {}
+            if not classes:
+                bad.append((path, "missing fairness classes"))
+            for name, c in classes.items():
+                if not c.get("met") or c.get("ttft_p99_s", float("inf")) \
+                        > c.get("slo_s", 0):
+                    bad.append((path, f"tenant class {name} blew its "
+                                      f"TTFT SLO budget: {c!r}"))
+            ratio = fair.get("throughput_ratio")
+            uni = fair.get("uniform_tokens_per_s")
+            adv = fair.get("adversarial_tokens_per_s")
+            if not isinstance(ratio, (int, float)) or ratio < 0.9:
+                bad.append((path, f"adversarial-mix throughput under "
+                                  f"90% of uniform: {ratio!r}"))
+            elif not uni or abs(ratio - adv / uni) > tol:
+                bad.append((path, f"throughput_ratio inconsistent: "
+                                  f"{fair!r}"))
+    return bad
+
+
+def test_committed_prefix_entries_well_formed():
+    assert scan_prefix_entries(REPO) == []
+
+
+def test_committed_prefix_round_passes_all_gates():
+    """The committed round-17 artifact must prove the full chain: radix
+    hits earned in the timed run, avoided prefill, TTFT win, clean
+    drain, fairness under the adversarial mix."""
+    with open(os.path.join(REPO, "BENCH_r17.json")) as f:
+        doc = json.load(f)
+    parsed = doc["parsed"]
+    assert parsed["metric"] == "serving_prefix_tokens_per_sec"
+    assert "error" not in parsed
+    p = parsed["prefix"]
+    assert p["hit"]["hits"] >= 1
+    assert p["prefill"]["flops_avoided"] >= 0.4
+    assert p["sessions"]["resumes"] >= 1
+    assert p["ttft"]["warm_p99_ms"] < p["ttft"]["cold_p99_ms"]
+    assert p["drain"] == {"leaked_pages": 0, "refcounts_balanced": True}
+    assert set(p["fairness"]["classes"]) == {"gold", "bronze"}
+
+
+def _write_prefix(tmp_path, name, **overrides):
+    prefix = {
+        "hit": {"queries": 28, "hits": 21, "hit_rate": 0.75},
+        "prefill": {"tokens_cached": 19776, "tokens_computed": 3840,
+                    "flops_avoided": 0.8374},
+        "ttft": {"cold_p50_ms": 1189.1, "cold_p99_ms": 3633.1,
+                 "warm_p50_ms": 206.3, "warm_p99_ms": 448.9},
+        "throughput": {"cold_tokens_per_s": 3475.11,
+                       "warm_tokens_per_s": 6457.73,
+                       "baseline_r15_tokens_per_s": 975.11,
+                       "vs_r15": 6.62},
+        "sessions": {"resumes": 5},
+        "drain": {"leaked_pages": 0, "refcounts_balanced": True},
+        "fairness": {
+            "classes": {
+                "gold": {"ttft_p99_s": 0.19, "slo_s": 3.0, "met": True},
+                "bronze": {"ttft_p99_s": 0.24, "slo_s": 10.0,
+                           "met": True}},
+            "uniform_tokens_per_s": 4680.91,
+            "adversarial_tokens_per_s": 4650.56,
+            "throughput_ratio": round(4650.56 / 4680.91, 4)},
+        "load": {"prefix_share": 0.75},
+    }
+    parsed = {"metric": "serving_prefix_tokens_per_sec", "value": 6457.73,
+              "unit": "tokens/s", "vs_baseline": None,
+              "config": "llama_serve_w8_slots8_prefix",
+              "baseline_config": "llama_serve_w8_slots8_coldcache",
+              "prefix": prefix}
+    parsed.update({k: v for k, v in overrides.items() if k != "prefix"})
+    for k, v in (overrides.get("prefix") or {}).items():
+        prefix[k].update(v) if isinstance(v, dict) else prefix.update(
+            {k: v})
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 1, "cmd": "bench.py", "rc": 0, "tail": "", "parsed": parsed}))
+
+
+def test_prefix_validator_accepts_well_formed_entry(tmp_path):
+    _write_prefix(tmp_path, "BENCH_r90.json")
+    assert scan_prefix_entries(str(tmp_path)) == []
+    # ...and the >=0.98 throughput gate ignores it (vs_baseline null).
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_prefix_validator_trips_on_weak_cache_win(tmp_path):
+    _write_prefix(tmp_path, "BENCH_r91.json",
+                  prefix={"prefill": {"tokens_cached": 900,
+                                      "tokens_computed": 3000,
+                                      "flops_avoided": round(900 / 3900,
+                                                             4)}})
+    _write_prefix(tmp_path, "BENCH_r92.json",
+                  prefix={"ttft": {"warm_p99_ms": 4000.0}})
+    _write_prefix(tmp_path, "BENCH_r93.json",
+                  prefix={"hit": {"hit_rate": 0.5}})
+    bad = dict(scan_prefix_entries(str(tmp_path)))
+    assert "flops avoided under 0.4" in bad[str(tmp_path /
+                                               "BENCH_r91.json")]
+    assert "not strictly under" in bad[str(tmp_path / "BENCH_r92.json")]
+    assert "hit_rate inconsistent" in bad[str(tmp_path /
+                                              "BENCH_r93.json")]
+
+
+def test_prefix_validator_trips_on_leak_or_throughput_regression(tmp_path):
+    _write_prefix(tmp_path, "BENCH_r94.json",
+                  prefix={"drain": {"leaked_pages": 3}})
+    _write_prefix(tmp_path, "BENCH_r95.json", value=100.0,
+                  prefix={"throughput": {"warm_tokens_per_s": 100.0,
+                                         "cold_tokens_per_s": 90.0}})
+    _write_prefix(tmp_path, "BENCH_r96.json", vs_baseline=1.2)
+    bad = dict(scan_prefix_entries(str(tmp_path)))
+    assert "leaked pages" in bad[str(tmp_path / "BENCH_r94.json")]
+    assert "r15 headline" in bad[str(tmp_path / "BENCH_r95.json")]
+    assert "vs_baseline" in bad[str(tmp_path / "BENCH_r96.json")]
+
+
+def test_prefix_validator_trips_on_fairness_violations(tmp_path):
+    _write_prefix(tmp_path, "BENCH_r97.json",
+                  prefix={"fairness": {"classes": {
+                      "gold": {"ttft_p99_s": 5.0, "slo_s": 3.0,
+                               "met": False},
+                      "bronze": {"ttft_p99_s": 0.2, "slo_s": 10.0,
+                                 "met": True}}}})
+    _write_prefix(tmp_path, "BENCH_r98.json",
+                  prefix={"fairness": {
+                      "adversarial_tokens_per_s": 3000.0,
+                      "throughput_ratio": round(3000.0 / 4680.91, 4)}})
+    bad = dict(scan_prefix_entries(str(tmp_path)))
+    assert "SLO budget" in bad[str(tmp_path / "BENCH_r97.json")]
+    assert "under 90%" in bad[str(tmp_path / "BENCH_r98.json")]
+
+
+def test_bench_prefix_mode_flags(monkeypatch):
+    """BENCH_PREFIX=1 selects the prefix-cache drill; BENCH_PREFIX_*
+    size the load."""
+    import importlib
+
+    import bench
+    monkeypatch.setenv("BENCH_PREFIX", "1")
+    b = importlib.reload(bench)
+    assert b.PREFIX_BENCH and b.PREFIX_REQUESTS == 28
+    monkeypatch.setenv("BENCH_PREFIX_REQUESTS", "12")
+    b = importlib.reload(bench)
+    assert b.PREFIX_REQUESTS == 12
+    monkeypatch.delenv("BENCH_PREFIX")
+    monkeypatch.delenv("BENCH_PREFIX_REQUESTS")
+    b = importlib.reload(bench)
+    assert not b.PREFIX_BENCH

@@ -19,10 +19,11 @@ from horovod_tpu.analysis.trace_audit import audit_step
 from horovod_tpu.models.transformer import LLAMA_SERVE, LlamaLM
 from horovod_tpu.ops.attention import decode_attention
 from horovod_tpu.serving import (CacheConfig, ContinuousBatchScheduler,
-                                 LoadSpec, PagedKVCache, Request,
-                                 RequestPrefetcher, ServingEngine,
-                                 build_decode_step, cache_sharding,
-                                 generate, prefill_forward, stack_adapters)
+                                 LoadSpec, PagedKVCache, PrefixCache,
+                                 Request, RequestPrefetcher, ServingEngine,
+                                 TenantClass, build_decode_step,
+                                 cache_sharding, generate, prefill_forward,
+                                 prefix_spec, stack_adapters)
 from horovod_tpu.timeline import spans
 from horovod_tpu.timeline.metrics import render_prometheus
 
@@ -277,6 +278,35 @@ def test_loadgen_deterministic_and_open_loop():
     assert any((x.prompt.shape != y.prompt.shape or
                 (x.prompt != y.prompt).any()) for x, y in zip(a, c))
 
+    # The PR 16 prefix/session/tenant traffic shape is just as
+    # seed-deterministic -- same spec, byte-identical stream including
+    # the new fields.
+    pspec = prefix_spec(num_requests=48, seed=9)
+    p, q = generate(pspec), generate(pspec)
+    assert all((x.prompt == y.prompt).all() and
+               x.arrival_s == y.arrival_s and
+               x.tenant == y.tenant and
+               x.session_id == y.session_id for x, y in zip(p, q))
+    # Structure: shared requests really share -- at most num_prefixes
+    # distinct prefix_len-token heads among the long prompts.
+    plen = pspec.prefix_lens[0]
+    heads = {tuple(r.prompt[:plen]) for r in p
+             if r.prompt_len > plen and r.session_id is None}
+    assert 1 <= len(heads) <= pspec.num_prefixes
+    # Sessions: a later turn EXTENDS an earlier turn's prompt.
+    by_sid = {}
+    for r in p:
+        if r.session_id is not None:
+            by_sid.setdefault(r.session_id, []).append(r)
+    multi = [turns for turns in by_sid.values() if len(turns) > 1]
+    assert multi
+    for turns in multi:
+        first, second = turns[0], turns[1]
+        assert second.prompt_len > first.prompt_len
+        assert (second.prompt[:first.prompt_len] == first.prompt).all()
+    # Tenants drawn from the declared mix.
+    assert {r.tenant for r in p} == {"gold", "bronze"}
+
 
 def test_request_prefetcher_order_and_error():
     reqs = [_req(i) for i in range(5)]
@@ -505,3 +535,289 @@ def test_engine_env_defaults(base_params, monkeypatch):
     eng = ServingEngine(CFG, params, mesh=mesh_1d(1))
     assert (eng.slots, eng.page_size, eng.max_len,
             eng.prefetch_depth) == (3, 4, 32, 5)
+
+
+# ---------------------------------------------------------------------------
+# Prefix-shared KV cache (PR 16): radix matching, COW pages, tenants
+# ---------------------------------------------------------------------------
+
+
+def test_shared_prefix_page_read_bitwise_and_cow_isolation(base_params):
+    """Extends the eviction/reuse proof to SHARED pages: a slot reading
+    a shared prefix page decodes bitwise-identically to a private copy
+    of the same bytes, and copy-on-write divergence never mutates the
+    shared original."""
+    model, params = base_params
+    mesh, ccfg, cache = _make_cache(1, slots=4, page_size=8, max_len=64)
+    step = build_decode_step(CFG, mesh, slots=ccfg.slots,
+                             page_size=ccfg.page_size,
+                             pages_per_slot=ccfg.pages_per_slot)
+    pc = PrefixCache(cache)
+    rng = np.random.RandomState(11)
+    prefix = rng.randint(0, CFG.vocab_size, (1, 16))   # 2 full pages
+    prompt1 = np.concatenate(
+        [prefix, rng.randint(0, CFG.vocab_size, (1, 4))], 1)
+    prompt2 = np.concatenate(
+        [prefix, rng.randint(0, CFG.vocab_size, (1, 4))], 1)
+
+    # Slot 0: whole-prompt prefill, then register the prefix pages.
+    _, kl, vl = prefill_forward(params, CFG, jnp.asarray(prompt1))
+    cache.write_prefill(0, kl[:, 0], vl[:, 0])
+    assert pc.insert(prompt1[0], 0) == 2
+
+    # Slot 1: radix hit -> attach the SHARED pages, prefill the tail
+    # only (conditioned on the cached pages as past K/V).
+    matched, entries = pc.match(prompt2[0])
+    assert matched == 16 and [k for k, _ in entries] == ["f", "f"]
+    cache.attach_pages(1, entries, matched)
+    shared_pids = [int(p) for _, p in entries]
+    np.testing.assert_array_equal(cache.page_table[1, :2],
+                                  cache.page_table[0, :2])
+    past = cache.gather_pages(entries)
+    _, kl2, vl2 = prefill_forward(params, CFG,
+                                  jnp.asarray(prompt2[:, 16:]), past=past)
+    cache.write_prefill(1, kl2[:, 0, 16:], vl2[:, 0, 16:], start=16)
+
+    # Slot 3: the UNSHARED control -- attach the same pages and the
+    # same tail bytes, then force the copy-on-write clone so it reads
+    # private pages holding identical bytes.
+    cache.attach_pages(3, entries, matched)
+    cache.write_prefill(3, kl2[:, 0, 16:], vl2[:, 0, 16:], start=16)
+    cache.reserve(3, 20, writable_from=0)   # COW: clone pages 0..1
+    assert all(int(cache.page_table[3, i]) not in shared_pids
+               for i in range(2))
+
+    # Slot 2: COW DIVERGENCE -- attach the shared pages, then rewrite
+    # the whole context with different tokens from position 0.
+    orig_bytes_k = np.asarray(cache.k)[:, shared_pids].copy()
+    orig_bytes_v = np.asarray(cache.v)[:, shared_pids].copy()
+    other = rng.randint(0, CFG.vocab_size, (1, 20))
+    cache.attach_pages(2, entries, matched)
+    _, klo, vlo = prefill_forward(params, CFG, jnp.asarray(other))
+    cache.write_prefill(2, klo[:, 0], vlo[:, 0])   # start=0: full rewrite
+    assert all(int(cache.page_table[2, i]) not in shared_pids
+               for i in range(2))
+    # The divergence landed in clones; the shared originals are
+    # bit-for-bit untouched.
+    np.testing.assert_array_equal(np.asarray(cache.k)[:, shared_pids],
+                                  orig_bytes_k)
+    np.testing.assert_array_equal(np.asarray(cache.v)[:, shared_pids],
+                                  orig_bytes_v)
+
+    # Shared read (slot 1) == private-copy read (slot 3), bitwise --
+    # decoded AFTER the divergence next door.
+    seq2 = jnp.asarray(np.concatenate([prompt2, prompt2[:, :6]], 1))
+    got = _decode_sequence(params, step, cache, seq2, 20, 26, slot=1)
+    want = _decode_sequence(params, step, cache, seq2, 20, 26, slot=3)
+    np.testing.assert_array_equal(got, want)
+
+    # Drain: slots + tree release every reference, zero leaks.
+    for s in range(4):
+        cache.free_slot(s)
+    pc.drop_all()
+    assert cache.live_pages == 0
+    assert cache.free_pages == ccfg.num_pages
+    assert cache.refcounts_balanced()
+
+
+def test_prefix_cache_radix_match_insert_and_refcounts():
+    ccfg = CacheConfig(num_layers=1, num_kv_heads=2, head_dim=4, slots=2,
+                       page_size=4, max_len=16)
+    cache = PagedKVCache(ccfg)
+    pc = PrefixCache(cache, session_ttl_steps=4)
+    prompt = np.arange(10, dtype=np.int32)   # 2 full pages + tail
+    assert pc.match(prompt) == (0, [])       # cold tree
+    kl = jnp.ones((1, 10, 2, 4), jnp.float32)
+    cache.write_prefill(0, kl, kl)
+    assert pc.insert(prompt, 0) == 2
+    assert pc.insert(prompt, 0) == 0         # idempotent
+
+    # Same-prefix prompt hits both registered pages.
+    p2 = np.concatenate([prompt[:8], np.asarray([9, 9], np.int32)])
+    matched, entries = pc.match(p2)
+    assert matched == 8 and len(entries) == 2
+    # The cap: a prompt can never match ALL of itself (the tail
+    # prefill must produce first-token logits), so an exact-page
+    # prompt matches one page short.
+    assert pc.match(prompt[:8])[0] == 4
+
+    # Tree references outlive the slot: only the unregistered tail
+    # page returns to the free list.
+    free_before = cache.free_pages
+    cache.free_slot(0)
+    assert cache.free_pages == free_before + 1
+    assert cache.live_pages == 2
+
+    # Attaching bumps refcounts; detaching drops them; pressure evicts
+    # the tree's own references; drain leaves the pool whole.
+    cache.attach_pages(1, entries, 8)
+    assert int(cache.lengths[1]) == 8 and cache.live_pages == 2
+    cache.free_slot(1)
+    assert pc.release_pages(2) == 2
+    pc.drop_all()
+    assert cache.live_pages == 0 and cache.refcounts_balanced()
+    assert pc.stats()["hit_rate"] == pc.hit_rate > 0
+
+
+def test_prefix_cache_session_pin_ttl_expiry():
+    ccfg = CacheConfig(num_layers=1, num_kv_heads=2, head_dim=4, slots=2,
+                       page_size=4, max_len=16)
+    cache = PagedKVCache(ccfg)
+    pc = PrefixCache(cache, session_ttl_steps=3)
+    prompt = np.arange(8, dtype=np.int32)
+    kl = jnp.ones((1, 8, 2, 4), jnp.float32)
+    cache.write_prefill(0, kl, kl)
+    pc.insert(prompt, 0)
+    cache.free_slot(0)
+
+    pc.pin_session("s0", prompt)
+    assert pc.sessions_live == 1 and pc.touch_session("s0")
+    # Pinned nodes survive an eviction demand while unpinned ones
+    # exist... here everything is pinned, so LRU takes them last but
+    # WILL take them (a cache, not a lease).
+    pc.tick(2)
+    assert pc.touch_session("s0")            # reuse refreshes the TTL
+    pc.tick(2)
+    assert pc.sessions_live == 1             # within TTL again
+    pc.tick(4)                               # idle past TTL -> expired
+    assert pc.sessions_live == 0
+    assert not pc.touch_session("s0")
+    pc.drop_all()
+    assert cache.live_pages == 0 and cache.refcounts_balanced()
+
+
+def test_prefix_cache_demotes_to_fp8_then_stays_matchable():
+    ccfg = CacheConfig(num_layers=1, num_kv_heads=2, head_dim=4, slots=2,
+                       page_size=4, max_len=16, compress=True)
+    cache = PagedKVCache(ccfg)
+    pc = PrefixCache(cache)
+    rng = np.random.RandomState(3)
+    prompt = np.arange(8, dtype=np.int32)
+    kl = jnp.asarray(rng.randn(1, 8, 2, 4).astype(np.float32))
+    vl = jnp.asarray(rng.randn(1, 8, 2, 4).astype(np.float32))
+    cache.write_prefill(0, kl, vl)
+    pc.insert(prompt, 0)
+    cache.free_slot(0)
+    assert cache.live_pages == 2
+
+    # Page pressure: the demotion tier quantizes tree-only f32 pages
+    # into the e4m3 pool -- the f32 pages come back, the prefix stays
+    # matchable at fp8 cost.
+    assert pc.release_pages(2) == 2
+    assert cache.live_pages == 0             # f32 pool fully free
+    matched, entries = pc.match(np.concatenate([prompt, prompt[:4]]))
+    assert matched == 8 and all(k == "c" for k, _ in entries)
+
+    # gather_pages dequantizes the demoted pages for the tail prefill.
+    pk, pv = cache.gather_pages(entries)
+    assert pk.shape == (1, 1, 8, 2, 4)
+    np.testing.assert_allclose(np.asarray(pk)[0, 0], np.asarray(kl)[0],
+                               rtol=0.2, atol=0.1)
+    pc.drop_all()
+    assert cache.refcounts_balanced()
+
+
+def _treq(rid, tenant, plen=4, out=4):
+    return Request(rid=rid, prompt=np.full((plen,), rid % 7, np.int32),
+                   max_new_tokens=out, arrival_s=0.0, tenant=tenant)
+
+
+def test_scheduler_tenant_stride_admission_and_share_cap():
+    ccfg = CacheConfig(num_layers=1, num_kv_heads=2, head_dim=4, slots=3,
+                       page_size=4, max_len=16)
+    cache = PagedKVCache(ccfg)
+    tenants = {"gold": TenantClass("gold"),
+               "bronze": TenantClass("bronze", max_share=0.25)}
+    sched = ContinuousBatchScheduler(3, cache, tenants=tenants)
+    for i in range(3):
+        sched.submit(_treq(i, "bronze"))
+    sched.submit(_treq(3, "gold"))
+    sched.submit(_treq(4, "gold"))
+    admitted = sched.admit(0.0)
+    # Stride order: bronze leads (earliest queue position at equal
+    # pass), then gold; bronze's max_share (ceil(0.25 * 3) = 1 slot)
+    # caps it while gold still waits, so gold takes the third slot.
+    assert [r.tenant for _, r in admitted] == ["bronze", "gold", "gold"]
+    assert [r.rid for _, r in admitted] == [0, 3, 4]
+    assert len(sched.queue) == 2             # bronze 1, 2 held back
+    # When NOBODY else is queued the cap yields (work conservation).
+    for slot, _ in admitted:
+        sched.release(slot, 0.1)
+    assert [r.tenant for _, r in sched.admit(0.2)] == ["bronze", "bronze"]
+
+
+def test_scheduler_tenant_weights_skew_admission_share():
+    ccfg = CacheConfig(num_layers=1, num_kv_heads=2, head_dim=4, slots=4,
+                       page_size=4, max_len=16)
+    cache = PagedKVCache(ccfg)
+    tenants = {"gold": TenantClass("gold", weight=3.0),
+               "bronze": TenantClass("bronze", weight=1.0)}
+    sched = ContinuousBatchScheduler(4, cache, tenants=tenants)
+    for i in range(4):
+        sched.submit(_treq(i, "bronze"))
+    for i in range(4, 8):
+        sched.submit(_treq(i, "gold"))
+    admitted = [r.tenant for _, r in sched.admit(0.0)]
+    # Equal passes admit bronze's head first; after that gold's 3x
+    # weight advances its pass 3x slower, so gold fills the rest.
+    assert admitted == ["bronze", "gold", "gold", "gold"]
+
+
+def test_parse_tenant_classes_wire_format():
+    from horovod_tpu.serving import parse_tenant_classes
+    got = parse_tenant_classes("gold:4:0.5:0.75, bronze:1, free")
+    assert set(got) == {"gold", "bronze", "free"}
+    assert got["gold"] == TenantClass("gold", weight=4.0, ttft_slo_s=0.5,
+                                      max_share=0.75)
+    assert got["bronze"].weight == 1.0 and got["free"].max_share == 1.0
+    with pytest.raises(ValueError):
+        parse_tenant_classes("bad:-1")
+
+
+def test_engine_prefix_cache_end_to_end(base_params):
+    _, params = base_params
+    eng = ServingEngine(CFG, params, mesh=mesh_1d(1), slots=4,
+                        page_size=8, max_len=128, prefix_cache=True,
+                        session_ttl_steps=64)
+    spec = prefix_spec(num_requests=12, prompt_lens=(8,), output_lens=(4,),
+                       prefix_lens=(32,), num_prefixes=2,
+                       vocab_size=CFG.vocab_size)
+    report = eng.serve(generate(spec))
+    assert report.completed == 12 and report.rejected == 0
+    assert report.prefix_queries == 12
+    assert report.prefix_hits > 0
+    assert 0.0 < report.prefix_hit_rate <= 1.0
+    assert report.prefill_tokens_cached > 0
+    assert 0.0 < report.prefill_flops_avoided < 1.0
+    # Drain-time leak proof: slots released during serve, the tree is
+    # the only remaining holder; dropping it must empty the pool.
+    eng._prefix.drop_all()
+    assert eng.cache.live_pages == 0
+    assert eng.cache.refcounts_balanced()
+    # The prefix and per-tenant metric families are live alongside the
+    # slot-state gauges (the control plane reads these).
+    text = render_prometheus()
+    for fam in ("horovod_serving_prefix_hit_rate",
+                "horovod_serving_prefix_pages",
+                "horovod_serving_sessions_live",
+                "horovod_serving_prefix_tokens_total",
+                "horovod_serving_ttft_by_tenant_seconds",
+                "horovod_serving_tenant_occupancy",
+                "horovod_serving_tenant_queue_depth"):
+        assert fam in text
+
+
+def test_engine_prefix_cache_with_chunked_tail(base_params):
+    """A prefix hit whose tail still exceeds the chunk budget runs the
+    PR 14 chunked path seeded from the cached pages."""
+    _, params = base_params
+    eng = ServingEngine(CFG, params, mesh=mesh_1d(1), slots=2,
+                        page_size=8, max_len=128, prefix_cache=True,
+                        prefill_chunk=8)
+    spec = prefix_spec(num_requests=8, prompt_lens=(24,), output_lens=(3,),
+                       prefix_lens=(32,), num_prefixes=1,
+                       session_share=0.0, vocab_size=CFG.vocab_size)
+    report = eng.serve(generate(spec))
+    assert report.completed == 8
+    assert report.prefix_hits > 0
+    assert report.prefill_flops_avoided > 0.0
