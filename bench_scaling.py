@@ -114,6 +114,16 @@ PARITY_BOUND = 1.25
 HIER_ICI = 32
 HIER_DCN_CODEC = "fp8"
 
+# 3D-parallelism variant (--models bert-3d --ns 8 16): DP x TP on one
+# build_3d_mesh, dcn_size x (data, model) virtual meshes sharing the TP
+# extent -- 8 = 2x(2,2), 16 = 2x(4,2).  Because tp=2 on both meshes, the
+# LOCAL (tp-sharded) gradient leaves are identical across mesh sizes, so
+# every fp16 DP-exchange bucket -- and with it the whole DP gradient leg
+# -- must be BYTE-IDENTICAL: the 3D gate is exact equality against the
+# explain_plan closed form over the local leaves, not a tolerance band.
+THREED_TP = 2
+THREED_DCN = 2
+
 # CNN cases: (constructor kwargs, image size).  Spatial size does not
 # affect gradient payload EXCEPT for VGG (the 224x224 fc1 holds most of
 # its 138M params), so VGG compiles at full resolution; Inception needs
@@ -344,6 +354,80 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         # noise next to MiB-scale buckets).
         wire_itemsize = 1 if model.endswith("-fp8") else 2
         payload = sum(l.size * wire_itemsize for l in grad_leaves) + 4
+    elif model == "bert-3d":
+        # 3D config (--models bert-3d): BERT on a dcn x (data, model)
+        # mesh from build_3d_mesh -- TP params via tp_param_specs,
+        # fp16 DP exchange over the data axes only, Adam moments
+        # mirrored onto the param shards.  The run_worker counterpart
+        # re-traces the step and splits its psums by dtype: the fp16
+        # ones ARE the DP gradient leg (TP activation psums and the
+        # loss mean run at f32), gated byte-exactly against the
+        # explain_plan closed form below.
+        from jax.sharding import PartitionSpec
+        from horovod_tpu.controller.fusion import explain_plan
+        from horovod_tpu.models import BERT_TINY, Bert, bert_tp_apply
+        from horovod_tpu.parallel import data_axes, tp_param_specs
+        from horovod_tpu.training import mirror_opt_state_specs
+        mesh = hvd.mesh()
+        cfg = BERT_TINY
+        m = Bert(cfg, dtype=jnp.float32)
+        seq = 128
+        pcb = per_chip_batch or 1
+        gb = pcb * (n // THREED_TP)   # batch shards over the data axes
+        tokens = jax.ShapeDtypeStruct((gb, seq), jnp.int32)
+        nsp = jax.ShapeDtypeStruct((gb,), jnp.int32)
+        params = jax.eval_shape(
+            lambda k: m.init(k, jnp.zeros((1, seq), jnp.int32)),
+            jax.random.PRNGKey(0))
+        specs = tp_param_specs(params, axis="model")
+
+        def loss_fn(p, batch):
+            toks, nsp_y = batch
+            mlm, nsp_logits = bert_tp_apply(p, cfg, toks, axis="model")
+            l_mlm = optax.softmax_cross_entropy_with_integer_labels(
+                mlm, toks).mean()
+            l_nsp = optax.softmax_cross_entropy_with_integer_labels(
+                nsp_logits, nsp_y).mean()
+            return l_mlm + l_nsp
+
+        opt = hvd.DistributedOptimizer(optax.adamw(1e-3),
+                                       compression=hvd.Compression.fp16,
+                                       axes=data_axes(mesh))
+        oss = mirror_opt_state_specs(opt, params, specs)
+        opt_state = jax.eval_shape(opt.init, params)
+        step = make_train_step(loss_fn, opt, mesh=mesh, tp=THREED_TP,
+                               param_specs=specs, opt_state_specs=oss)
+        args = (abstract(params, rep), abstract(opt_state, rep),
+                (jax.ShapeDtypeStruct(tokens.shape, tokens.dtype,
+                                      sharding=bat),
+                 jax.ShapeDtypeStruct(nsp.shape, nsp.dtype, sharding=bat)))
+        # The DP exchange buckets the LOCAL (tp-sharded) leaves: shrink
+        # every spec-named dim by the tp extent, then price the fp16
+        # wire with the SAME planner call the runtime makes.  Local
+        # shapes depend only on tp, never on the data extent -- the
+        # cross-mesh equality gate rides on that.
+        spec_leaves = jax.tree.leaves(
+            specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+        local_leaves = [
+            jax.ShapeDtypeStruct(
+                tuple(d // THREED_TP
+                      if i < len(s) and s[i] is not None else d
+                      for i, d in enumerate(leaf.shape)), leaf.dtype)
+            for leaf, s in zip(jax.tree.leaves(params), spec_leaves)]
+        plan_rows = explain_plan(local_leaves,
+                                 compression=hvd.Compression.fp16,
+                                 register=False)
+        dp_leg_bytes = sum(r["wire_bytes"] for r in plan_rows)
+        buckets = len(plan_rows)
+        expected_emitted = None   # mixed psum dtypes; gated in _gate_3d
+        payload = dp_leg_bytes
+        threed_planned = {
+            "dp_leg_bytes": int(dp_leg_bytes),
+            "dp_buckets": buckets,
+            "mesh": [THREED_DCN, n // (THREED_TP * THREED_DCN),
+                     THREED_TP],
+            "tp": THREED_TP,
+        }
     elif model == "rn50-zero1":
         # ZeRO-1 bench config (``--models rn50-zero1``; bench.py's
         # counterpart is ``HOROVOD_ZERO=1``): bare SGD+momentum, gradients
@@ -440,6 +524,8 @@ def _build_case(model: str, n: int, per_chip_batch: int = 0):
         # link -- DCN included: the wire the two-level decomposition plus
         # the DCN codec exists to undercut on the slow cross-slice hop.
         expected["flat_allreduce_bytes"] = grad_bytes
+    if model == "bert-3d":
+        expected["threed_planned"] = threed_planned
     return step, args, expected
 
 
@@ -490,6 +576,16 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
                     f"n={n} does not divide")
             hvd.init(mesh=build_mesh(jax.devices()[:n], hierarchical=True,
                                      dcn_size=n // HIER_ICI))
+        elif model == "bert-3d":
+            from horovod_tpu.parallel.mesh import build_3d_mesh
+            quantum = THREED_TP * THREED_DCN
+            if n % quantum:
+                raise SystemExit(
+                    f"bert-3d meshes are {THREED_DCN}x(n/{quantum}, "
+                    f"{THREED_TP}); n={n} does not divide")
+            hvd.init(mesh=build_3d_mesh(
+                jax.devices()[:n], data=n // quantum, model=THREED_TP,
+                dcn_size=THREED_DCN))
         else:
             hvd.init()
         step, args, expected = _build_case(model, n)
@@ -507,6 +603,34 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
             "legs_recorded": {
                 k: int(v["nbytes"]) for k, v in recorder().legs.items()
                 if k.startswith("hier/")},
+        }
+    threed_block = None
+    if model == "bert-3d":
+        # Re-trace the step and split its psums by dtype: the DP
+        # gradient leg runs at the fp16 wire dtype, everything else
+        # (TP activation psums, the loss mean) at f32 -- so the fp16
+        # byte sum IS the DP leg, comparable byte-for-byte against
+        # the explain_plan closed form in threed_planned.
+        import jax.numpy as jnp
+        from horovod_tpu.analysis.jaxpr_walk import collect_collectives
+        inner = step
+        while hasattr(inner, "_fn"):
+            inner = inner._fn
+        recs = collect_collectives(jax.make_jaxpr(inner)(*args))
+        dp = [r for r in recs if r.kind == "psum"
+              and r.dtype == "float16"]
+        tp_psums = [r for r in recs if r.kind == "psum"
+                    and "model" in r.axes]
+        threed_block = {
+            "mesh": expected["threed_planned"]["mesh"],
+            "dp_psum_bytes": sum(
+                r.elements * jnp.dtype(r.dtype).itemsize for r in dp),
+            "dp_psum_count": len(dp),
+            "dp_axes": sorted({a for r in dp for a in r.axes}),
+            "tp_psum_count": len(tp_psums),
+            "tp_psum_bytes": sum(
+                r.elements * jnp.dtype(r.dtype).itemsize
+                for r in tp_psums),
         }
     emitted = scaling.emitted_collective_stats(lowered.as_text())
     compiled = lowered.compile()
@@ -551,6 +675,7 @@ def run_worker(model: str, n: int, topology: str = "") -> None:
         "donation": scaling.has_buffer_donation(text),
         "schedule": schedule,
         "hier": hier_block,
+        "threed": threed_block,
         **expected,
     }), flush=True)
 
@@ -643,7 +768,16 @@ def _spawn(model: str, n: int, timeout: int = 2400,
                         # an ambient topology spec or autotuner hier axis
                         # must not re-mesh the flat baseline rows.
                         "HOROVOD_HIERARCHICAL", "HVD_TPU_HIERARCHICAL",
-                        "HOROVOD_AUTOTUNE_HIER", "HVD_TPU_AUTOTUNE_HIER")}
+                        "HOROVOD_AUTOTUNE_HIER", "HVD_TPU_AUTOTUNE_HIER",
+                        # The bert-3d worker builds its own 3D mesh; an
+                        # ambient TP/pipeline/MoE knob must not re-mesh
+                        # the flat baseline rows.
+                        "HOROVOD_TP", "HVD_TPU_TP",
+                        "HOROVOD_PIPELINE_STAGES",
+                        "HVD_TPU_PIPELINE_STAGES",
+                        "HOROVOD_MOE_COMPRESSION",
+                        "HVD_TPU_MOE_COMPRESSION",
+                        "HOROVOD_AUTOTUNE_MOE", "HVD_TPU_AUTOTUNE_MOE")}
     cmd = [sys.executable, os.path.abspath(__file__),
            "--parity" if parity else "--worker", model, str(n)]
     if topology:
@@ -720,6 +854,109 @@ def _gate_hier(model, rows, summary) -> bool:
         "buckets": buckets,
     }
     return ok
+
+
+def _gate_3d(model, rows, summary) -> bool:
+    """Gates for the 3D (--models bert-3d) rows.
+
+    D1: the fp16 psum bytes the traced step actually carries on the DP
+    gradient leg equal the ``explain_plan`` closed form over the LOCAL
+    (tp-sharded) leaves -- byte-exact, no tolerance.  D2: those bytes
+    are IDENTICAL across the two virtual mesh shapes (both share tp=2,
+    so the local leaves -- and every fp16 bucket -- are the same; any
+    drift means the DP exchange picked up a mesh-shape dependence).
+    D3: the DP psums span ONLY the data axes (a ``model``/``pipe`` name
+    in a gradient psum means the exchange leaked into the
+    model-parallel domain and tp ranks would stop diverging).  D4: the
+    TP activation psums are present and their count is mesh-invariant
+    (forward row-psums plus the Megatron-f backward merges depend on
+    the model, never on the data extent).
+    """
+    ok = True
+    planned0 = rows[0]["threed_planned"]
+    traced0 = rows[0]["threed"]
+    for r in rows:
+        got, want = r["threed"], r["threed_planned"]
+        if got["dp_psum_bytes"] != want["dp_leg_bytes"]:
+            ok = False
+            print(f"FAIL: n={r['n']} traced DP leg "
+                  f"{got['dp_psum_bytes']} B != planner closed form "
+                  f"{want['dp_leg_bytes']} B over the local leaves")
+        if want["dp_leg_bytes"] != planned0["dp_leg_bytes"] or \
+                got["dp_psum_bytes"] != traced0["dp_psum_bytes"]:
+            ok = False
+            print(f"FAIL: DP leg varies with the mesh: n={r['n']} "
+                  f"{got['dp_psum_bytes']} B != n={rows[0]['n']} "
+                  f"{traced0['dp_psum_bytes']} B")
+        leaked = [a for a in got["dp_axes"] if a not in ("dcn", "data")]
+        if leaked or not got["dp_axes"]:
+            ok = False
+            print(f"FAIL: n={r['n']} DP psums span {got['dp_axes']}; "
+                  f"the gradient exchange must stay on the data axes")
+        if got["tp_psum_count"] < 1 or \
+                got["tp_psum_count"] != traced0["tp_psum_count"]:
+            ok = False
+            print(f"FAIL: n={r['n']} {got['tp_psum_count']} TP psums "
+                  f"(n={rows[0]['n']} had {traced0['tp_psum_count']}); "
+                  f"expected a positive mesh-invariant count")
+    print(f"- DP gradient leg: {traced0['dp_psum_bytes']/2**20:.2f} "
+          f"MiB/step fp16 over {planned0['dp_buckets']} bucket(s) "
+          f"(mesh-invariant, == planner closed form)")
+    print(f"- TP activation psums: {traced0['tp_psum_count']} f32 "
+          f"({traced0['tp_psum_bytes']/2**20:.2f} MiB) over the model "
+          f"axis; DP psum axes: {traced0['dp_axes']}")
+    summary[model] = {
+        "tp": planned0["tp"],
+        "ns": [r["n"] for r in rows],
+        "meshes": {str(r["n"]): r["threed"]["mesh"] for r in rows},
+        "dp_leg_bytes": traced0["dp_psum_bytes"],
+        "dp_buckets": planned0["dp_buckets"],
+        "dp_axes": traced0["dp_axes"],
+        "tp_psum_count": traced0["tp_psum_count"],
+        "tp_psum_bytes": traced0["tp_psum_bytes"],
+        "dp_leg_matches_plan":
+            traced0["dp_psum_bytes"] == planned0["dp_leg_bytes"],
+        "mesh_invariant": all(
+            r["threed"]["dp_psum_bytes"] == traced0["dp_psum_bytes"]
+            for r in rows),
+    }
+    return ok
+
+
+def _write_3d_round(args, ts, ok) -> None:
+    """``--out BENCH_r<k>.json`` after a bert-3d run: emit the round
+    record shape bench.py --trajectory and tests/test_bench_guard.py's
+    ``scan_3d_entries`` consume."""
+    import re
+    m = re.search(r"r(\d+)", os.path.basename(args.out))
+    rec = {
+        "n": int(m.group(1)) if m else 0,
+        "cmd": "JAX_PLATFORMS=cpu python bench_scaling.py --models "
+               + " ".join(args.models)
+               + " --ns " + " ".join(str(n) for n in args.ns),
+        "rc": 0 if ok else 1,
+        "tail": f"3D exchange: DP gradient leg "
+                f"{ts['dp_leg_bytes']/2**20:.2f} MiB fp16 over the data "
+                f"axes, byte-equal to the planner closed form on the "
+                f"local leaves and invariant across n={args.ns}; "
+                f"{ts['tp_psum_count']} TP activation psums on the "
+                f"model axis",
+        "parsed": {
+            "metric": "threed_dp_leg_mib",
+            "value": round(ts["dp_leg_bytes"] / 2**20, 2), "unit": "MiB",
+            # A virtual-CPU wire drill is never throughput-comparable to
+            # the measured baseline config.
+            "vs_baseline": None,
+            "config": f"bert_tiny_3d_dcn{THREED_DCN}_tp{THREED_TP}"
+                      f"_fp16dp",
+            "baseline_config": "batch256_s2d_bf16",
+            "threed": ts,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(f"wrote {args.out}")
 
 
 def _write_hier_round(args, hs, ok) -> None:
@@ -904,6 +1141,17 @@ def main() -> int:
                 ok = False
                 print("FAIL: buffer donation missing")
             continue
+        if model == "bert-3d":
+            # 3D rows gate on the DP-leg/planner byte equality (exact),
+            # not the flat eq-AR drift band: the TP activation psums
+            # span only the model axis, which the generic full-mesh
+            # wire normalization misprices by design.  Donation must
+            # still hold.
+            ok &= _gate_3d(model, rows, summary)
+            if not all(r["donation"] for r in rows):
+                ok = False
+                print("FAIL: buffer donation missing")
+            continue
         # Gate 1: payload matches the fusion planner's prediction.
         drift = abs(payloads[0] - predicted) / predicted
         if drift > args.tolerance:
@@ -992,6 +1240,8 @@ def main() -> int:
         hier_models = [m for m in summary if m.endswith("-hier")]
         if hier_models:
             _write_hier_round(args, summary[hier_models[0]], ok)
+        elif "bert-3d" in summary:
+            _write_3d_round(args, summary["bert-3d"], ok)
     return 0 if ok else 1
 
 

@@ -66,6 +66,7 @@ from .training import (  # noqa: F401
     replicated_sharding, sync_batch_norm,
     make_train_loop, make_flax_train_loop, stack_steps, shard_steps,
     stacked_batch_sharding, steps_per_execution, microbatches,
+    mirror_opt_state_specs,
 )
 from .data import DevicePrefetcher, prefetch_to_device  # noqa: F401
 from . import serving  # noqa: F401  (continuous-batching inference)

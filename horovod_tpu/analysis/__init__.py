@@ -23,15 +23,16 @@ from .findings import (ERROR, WARNING, Finding, apply_baseline,
                        render_findings)
 from .lints import read_env_vars, rule_catalogue, run_lints
 from .stepmodel import ExpectedExchange, ExpectedOp, expected_exchange
-from .trace_audit import (STANDARD_CONFIGS, AuditReport,
-                          audit_standard_configs, audit_step,
-                          build_standard_config)
+from .trace_audit import (PARALLEL3D_CONFIGS, STANDARD_CONFIGS,
+                          AuditReport, audit_standard_configs,
+                          audit_step, build_standard_config)
 
 __all__ = [
     "ERROR", "WARNING", "Finding", "apply_baseline",
     "default_baseline_path", "errors", "load_baseline", "render_findings",
     "read_env_vars", "rule_catalogue", "run_lints",
     "ExpectedExchange", "ExpectedOp", "expected_exchange",
-    "STANDARD_CONFIGS", "AuditReport", "audit_standard_configs",
+    "PARALLEL3D_CONFIGS", "STANDARD_CONFIGS", "AuditReport",
+    "audit_standard_configs",
     "audit_step", "build_standard_config",
 ]

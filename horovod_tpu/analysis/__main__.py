@@ -6,9 +6,11 @@ Modes (default ``--all``):
 - ``--step-audit``: trace-audit the reference step configurations
   (plain DP, ZeRO-1, powersgd+EF, microbatches=2 on the flat mesh, the
   serving tp-decode step at full tp and on the post-shrink resized
-  mesh, then the hierarchical trio -- plain hier, hier+ZeRO-1,
-  hier+EF-on-DCN -- on a two-level remesh of the same virtual CPU
-  devices) and cross-check emitted collectives against their plans;
+  mesh, the 3-D parallelism trio -- TP, TP+ZeRO-1, TP+pipeline+micro
+  on their own 2x2x2 meshes -- then the hierarchical trio -- plain
+  hier, hier+ZeRO-1, hier+EF-on-DCN -- on a two-level remesh of the
+  same virtual CPU devices) and cross-check emitted collectives
+  against their plans;
 - ``--all``: both.
 
 Findings matching ``analysis_baseline.txt`` (``--baseline`` to override)
@@ -66,13 +68,18 @@ def _run_step_audit(devices: int):
     force_host_device_count(devices, cpu=True)
     import horovod_tpu as hvd
     hvd.init()
-    from .trace_audit import (HIER_CONFIGS, SERVING_CONFIGS,
-                              audit_standard_configs)
+    from .trace_audit import (HIER_CONFIGS, PARALLEL3D_CONFIGS,
+                              SERVING_CONFIGS, audit_standard_configs)
     try:
         reports = audit_standard_configs()
         # Serving decode contract, at full tp and on the post-shrink
         # mesh the elastic control plane leaves behind.
         reports.update(audit_standard_configs(SERVING_CONFIGS))
+        if devices >= 8:
+            # 3-D parallelism trio (TP, TP+ZeRO-1, TP+pipeline+micro):
+            # each builds its own 2x2x2 mesh over the first 8 devices,
+            # so the DP-leg plan matching bites on model-parallel steps.
+            reports.update(audit_standard_configs(PARALLEL3D_CONFIGS))
     finally:
         hvd.shutdown()
     if devices >= 4 and devices % 2 == 0:

@@ -188,6 +188,11 @@ def _expected_exchange(params, meta: dict) -> ExpectedExchange:
 
     if meta.get("kind") in ("serving_decode", "serving_verify"):
         return _expected_serving_decode(meta)
+    if (int(meta.get("tp", 1) or 1) > 1
+            or int(meta.get("pipeline_stages", 1) or 1) > 1):
+        # Model-parallel step on a build_3d_mesh: the DP leg prices over
+        # the LOCAL (model-sharded) leaves and the data axes only.
+        return _expected_3d(params, meta)
     world = int(meta.get("world", 1))
     if world <= 1:
         return _expected_world1(params, meta)
@@ -479,7 +484,9 @@ def _expected_microbatch(leaves, exchange, k: int, world: int
     return ExpectedExchange(ops=ops, plan_rows=rows)
 
 
-def _expected_zero(leaves, meta: dict, world: int) -> ExpectedExchange:
+def _expected_zero(leaves, meta: dict, world: int,
+                   axes_shape: Optional[Tuple[int, ...]] = None
+                   ) -> ExpectedExchange:
     """ZeRO-1 arena exchange: reduce-scatter + compressed allgather.
 
     On the two-level ``(dcn, ici)`` mesh the multi-axis collectives
@@ -487,7 +494,13 @@ def _expected_zero(leaves, meta: dict, world: int) -> ExpectedExchange:
     axis order; ``ops.allgather`` gathers in reverse order), and a
     per-leg ``ici:...,dcn:...`` codec additionally flips the scatter to
     (ici, dcn) order so only the 1/n_ici shard crosses DCN, with each
-    allgather hop riding its own leg codec (``zero_apply`` parity)."""
+    allgather hop riding its own leg codec (``zero_apply`` parity).
+
+    ``axes_shape`` overrides the axis decomposition for steps whose
+    exchange runs over a SUBSET of the mesh (the 3-D path's data axes):
+    a 2-tuple prices the per-axis decomposition over that outer/inner
+    pair, any other length forces the single-axis exchange -- ``None``
+    keeps the global-mesh ``hier_mesh_shape()`` probe."""
     from ..collectives.compression import is_hier_legs
     from ..controller.fusion import hier_mesh_shape
     from ..optim import zero as _zero
@@ -499,7 +512,11 @@ def _expected_zero(leaves, meta: dict, world: int) -> ExpectedExchange:
             (f"unmodeled zero allgather codec: {comp.__name__}",))
     spec = _zero.plan_arena(leaves, world)
     use_rs = _zero._use_reducescatter()
-    two_level = hier_mesh_shape()
+    if axes_shape is None:
+        two_level = hier_mesh_shape()
+    else:
+        two_level = tuple(int(n) for n in axes_shape) \
+            if len(axes_shape) == 2 else None
     hier = is_hier_legs(comp) and two_level is not None
     if hier and is_fp8(comp.dcn):
         return _unsupported(("unmodeled zero DCN-leg codec: fp8 "
@@ -554,3 +571,176 @@ def _expected_zero(leaves, meta: dict, world: int) -> ExpectedExchange:
                      "shard": buf.shard, "codec": comp.__name__,
                      "kind": "zero-arena"})
     return ExpectedExchange(ops=ops, plan_rows=rows, notes=tuple(notes))
+
+
+def _local_leaves(params, meta: dict):
+    """Per-device leaf shapes under the step's ``param_specs``: each
+    spec-named dim divided by that mesh axis's extent.  The gradient
+    exchange inside ``shard_map`` plans its buckets/arena from these
+    LOCAL shards, so the expectation must too.  Returns ``None`` when
+    the meta carries no specs or a spec does not divide its dim."""
+    from jax.sharding import PartitionSpec as P
+    specs = meta.get("param_specs")
+    if specs is None:
+        return None
+    mesh_shape = dict(meta.get("mesh_shape") or ())
+    leaves = jax.tree.leaves(params)
+    spec_leaves = jax.tree.flatten(
+        specs, is_leaf=lambda x: x is None or isinstance(x, P))[0]
+    if len(spec_leaves) != len(leaves):
+        return None
+    out = []
+    for leaf, sp in zip(leaves, spec_leaves):
+        shape = list(leaf.shape)
+        if isinstance(sp, P):
+            for i, entry in enumerate(sp):
+                if entry is None:
+                    continue
+                names = entry if isinstance(entry, tuple) else (entry,)
+                for nm in names:
+                    ext = int(mesh_shape.get(nm, 1))
+                    if ext <= 1:
+                        continue
+                    if i >= len(shape) or shape[i] % ext:
+                        return None
+                    shape[i] //= ext
+        out.append(jax.ShapeDtypeStruct(tuple(shape),
+                                        jnp.dtype(leaf.dtype)))
+    return out
+
+
+def _expected_3d(params, meta: dict) -> ExpectedExchange:
+    """DP x TP x pipeline step on a ``build_3d_mesh`` (PR 18).
+
+    Two contributions:
+
+    - the DP gradient leg, priced with the SAME planner calls as the
+      flat model but over each device's LOCAL (model-sharded) parameter
+      leaves (``_local_leaves``) and the DATA-axes world only -- plain
+      per-bucket psums, the two-level decomposition when the data axes
+      are the ``(dcn, data)`` pair and hier is requested, the ZeRO-1
+      per-axis arena exchange, or the microbatch RS+AG pipe;
+    - the model-parallel activation legs of the REFERENCE 3-D configs,
+      declared via ``meta["model_parallel"]`` (``d_model``, ``act_rows``
+      = rows entering the loss per call, optional ``pipe_microbatches``
+      and ``dtype``): per loss call, tensor parallelism contributes one
+      forward + one backward row-parallel psum of the full activation;
+      a pipeline stage shifts activations with one forward + one
+      backward ppermute (recorded once per scan) and closes with the
+      stage-select allreduce pair.  Arbitrary TP/pipeline losses carry
+      no declaration and are declined, not guessed.
+    """
+    from ..collectives.compression import is_hier_legs
+    from ..collectives.reduce_op import Average, Sum
+    from ..controller.fusion import (exchange_chunk_bytes, explain_plan,
+                                     hier_requested)
+
+    tp = int(meta.get("tp", 1) or 1)
+    pipe = int(meta.get("pipeline_stages", 1) or 1)
+    data_mesh = tuple(int(n) for n in (meta.get("data_mesh") or ()))
+    world = int(meta.get("world", 1))
+    k_micro = int(meta.get("microbatches", 1))
+    local = _local_leaves(params, meta)
+    if local is None:
+        return _unsupported((
+            "model-parallel step without param_specs meta: cannot derive "
+            "the local leaf shapes the exchange plans over",))
+    if world <= 1:
+        return _unsupported((
+            "3-D step with data world 1: unmodeled degenerate exchange",))
+    mp = meta.get("model_parallel")
+    if not (isinstance(mp, dict) and "d_model" in mp and "act_rows" in mp):
+        return _unsupported((
+            "model-parallel step without a declared activation contract "
+            "(meta['model_parallel'] with d_model/act_rows): the 3-D "
+            "reference configs declare theirs, arbitrary TP/pipeline "
+            "losses are not priced",))
+
+    # -- the DP gradient leg over the data axes --------------------------
+    if meta.get("zero_stage"):
+        base = _expected_zero(
+            local, meta, world,
+            axes_shape=data_mesh if len(data_mesh) == 2 else ())
+    else:
+        optimizer = meta.get("optimizer")
+        exchange = getattr(getattr(optimizer, "update", None),
+                           "_hvd_exchange", None)
+        if k_micro > 1:
+            base = _expected_microbatch(local, exchange, k_micro, world)
+        elif exchange is None:
+            base = ExpectedExchange(ops=[], plan_rows=[], notes=(
+                "bare optimizer: no gradient exchange",))
+        else:
+            comp = parse_compression(exchange["compression"])
+            op = exchange.get("op") or Average
+            if (is_error_feedback(comp) or is_fp8(comp)
+                    or op not in (Sum, Average)
+                    or exchange.get("process_set") is not None):
+                return _unsupported((
+                    "unmodeled 3-D DP exchange (EF/fp8 codec, non-sum op "
+                    "or process set)",))
+            if exchange_chunk_bytes() > 0:
+                return _unsupported((
+                    "unmodeled 3-D chunked DP exchange",))
+            rows = explain_plan(local,
+                                threshold_bytes=exchange["fusion_threshold"],
+                                compression=comp, register=False)
+            hier = ((hier_requested(comp) or is_hier_legs(comp))
+                    and len(data_mesh) == 2)
+            if hier:
+                hops = []
+                for r in rows:
+                    hops += _hier_bucket_ops(
+                        f"bucket{r['bucket']}({r['dtype']})", r["elements"],
+                        r["dtype"], comp, *data_mesh)
+                base = ExpectedExchange(ops=hops, plan_rows=rows, notes=(
+                    f"two-level DP leg on the {data_mesh} data axes",))
+            elif is_hier_legs(comp):
+                return _unsupported((
+                    "per-leg codec without the (dcn, data) pair: the "
+                    "runtime raises",))
+            else:
+                base = ExpectedExchange(
+                    ops=[ExpectedOp(
+                        "psum", _wire_dtype(comp, r["dtype"]),
+                        r["elements"],
+                        f"bucket{r['bucket']}({r['dtype']})/allreduce")
+                        for r in rows],
+                    plan_rows=rows)
+    if not base.supported:
+        return base
+
+    # -- the declared model-parallel activation legs ---------------------
+    d = int(mp["d_model"])
+    act_rows = int(mp["act_rows"])
+    act_dt = str(jnp.dtype(mp.get("dtype", "float32")))
+    m_pipe = max(1, int(mp.get("pipe_microbatches", 1)))
+    ops = list(base.ops)
+    for mb in range(k_micro):
+        tag = f"mb{mb}" if k_micro > 1 else "act"
+        if pipe > 1:
+            rp = act_rows // m_pipe
+            # One ppermute per scan direction (jaxpr_walk records a
+            # scan-body collective once), stage-select psum pair on the
+            # stacked outputs.
+            ops.append(ExpectedOp("ppermute", act_dt, rp * d,
+                                  f"{tag}/pipe-shift-fwd"))
+            ops.append(ExpectedOp("ppermute", act_dt, rp * d,
+                                  f"{tag}/pipe-shift-bwd"))
+            ops.append(ExpectedOp("psum", act_dt, act_rows * d,
+                                  f"{tag}/pipe-out-fwd"))
+            ops.append(ExpectedOp("psum", act_dt, act_rows * d,
+                                  f"{tag}/pipe-out-bwd"))
+            if tp > 1:
+                ops.append(ExpectedOp("psum", act_dt, rp * d,
+                                      f"{tag}/tp-row-fwd"))
+                ops.append(ExpectedOp("psum", act_dt, rp * d,
+                                      f"{tag}/tp-row-bwd"))
+        elif tp > 1:
+            ops.append(ExpectedOp("psum", act_dt, act_rows * d,
+                                  f"{tag}/tp-row-fwd"))
+            ops.append(ExpectedOp("psum", act_dt, act_rows * d,
+                                  f"{tag}/tp-row-bwd"))
+    notes = tuple(base.notes) + (
+        f"3-D config: tp={tp} pipe={pipe} data={data_mesh or (world,)}",)
+    return ExpectedExchange(ops=ops, plan_rows=base.plan_rows, notes=notes)

@@ -269,6 +269,15 @@ HIER_CONFIGS = ("hier", "hier_zero1", "hier_powersgd_ef")
 SERVING_CONFIGS = ("serving_decode", "serving_decode_resized",
                    "serving_verify")
 
+# 3-D parallelism reference configurations (PR 18): the DP gradient leg
+# priced over LOCAL (model-sharded) leaves and the data axes only, plus
+# the declared TP/pipeline activation legs.  ``tp2`` runs TP=2 with the
+# fp16 DP exchange on the hierarchical (dcn, data) pair; ``tp2_zero1``
+# shards the optimizer arena over the same data axes; ``tp2_pipe_micro``
+# stacks TP=2 x pipe=2 x microbatches=2 on a flat data axis.  All three
+# build their own mesh over the first 8 devices.
+PARALLEL3D_CONFIGS = ("tp2", "tp2_zero1", "tp2_pipe_micro")
+
 # Threshold chosen so the tiny parameter tree below splits into TWO f32
 # buckets (256 + 192 elements), exercising multi-bucket matching.
 _TINY_THRESHOLD = 1024
@@ -356,11 +365,119 @@ def build_standard_config(config: str):
             opt_state = opt.init(params)
     elif config in SERVING_CONFIGS:
         return _build_serving_config(config)
+    elif config in PARALLEL3D_CONFIGS:
+        return _build_3d_config(config)
     else:
+        known = (STANDARD_CONFIGS + HIER_CONFIGS + SERVING_CONFIGS
+                 + PARALLEL3D_CONFIGS)
         raise ValueError(
-            f"unknown standard config {config!r}; pick from "
-            f"{STANDARD_CONFIGS + HIER_CONFIGS + SERVING_CONFIGS}")
+            f"unknown standard config {config!r}; pick from {known}")
     # donate_argnums mirrors make_train_step's own (0, 1) donation.
+    return step, (params, opt_state, batch), (0, 1), f"step:{config}"
+
+
+def _build_3d_config(config: str):
+    """``(step, args, donate, name)`` for the 3-D parallelism audits.
+
+    Tiny TP=2 MLP (d_model=16, d_ff=32) with stacked-leading-dim sharded
+    weights: ``param_specs`` put the TP shards on the ``model`` axis (and
+    stage shards on ``pipe``), so the DP exchange plans over each
+    device's local slices.  Each builder declares its activation contract
+    in ``step._meta["model_parallel"]`` (d_model, rows per loss call,
+    pipeline microbatches) -- the quantities :func:`stepmodel._expected_3d`
+    prices the TP row-parallel psums and pipeline ppermute/select legs
+    from.  Requires >= 8 devices; each config builds its own mesh.
+    """
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from .. import training as _training
+    from ..collectives.compression import Compression
+    from ..optim import distributed as _dist
+    from ..optim import zero as _zero
+    from ..parallel import build_3d_mesh, data_axes, tp_mlp
+
+    if len(jax.devices()) < 8:
+        raise ValueError(
+            f"config {config!r} needs 8 devices for the 2x2x2 meshes "
+            f"(got {len(jax.devices())})")
+
+    d_model, d_ff, tp = 16, 32, 2
+    rng = np.random.default_rng(0)
+
+    def tp_params():
+        return {
+            "w_up": jnp.asarray(rng.normal(size=(tp, d_model, d_ff // tp)),
+                                jnp.float32),
+            "w_down": jnp.asarray(rng.normal(size=(tp, d_ff // tp, d_model)),
+                                  jnp.float32),
+            "bias": jnp.linspace(0.5, 1.5, d_model, dtype=jnp.float32),
+        }
+
+    tp_specs = {"w_up": P("model"), "w_down": P("model"), "bias": P()}
+
+    def tp_loss(params, batch):
+        y = tp_mlp(batch + params["bias"], params["w_up"][0],
+                   params["w_down"][0], axis="model")
+        return jnp.mean(y * y)
+
+    if config in ("tp2", "tp2_zero1"):
+        mesh = build_3d_mesh(jax.devices()[:8], data=2, model=2,
+                             dcn_size=2)
+        params = tp_params()
+        batch = jnp.ones((4 * 2, d_model), jnp.float32)
+        if config == "tp2":
+            opt = _dist.DistributedOptimizer(
+                optax.sgd(0.01), compression=Compression.fp16,
+                fusion_threshold=_TINY_THRESHOLD, axes=data_axes(mesh))
+            step = _training.make_train_step(tp_loss, opt, mesh=mesh,
+                                             tp=tp, param_specs=tp_specs)
+            opt_state = opt.init(params)
+        else:
+            opt = optax.sgd(0.01)
+            step = _training.make_train_step(tp_loss, opt, mesh=mesh,
+                                             tp=tp, zero_stage=1,
+                                             param_specs=tp_specs)
+            opt_state = _zero.zero_init(opt, params, mesh=mesh,
+                                        param_specs=tp_specs)
+        # 8 global rows / 4 data-parallel devices = 2 rows per loss call.
+        step._meta["model_parallel"] = {"d_model": d_model, "act_rows": 2}
+    else:  # tp2_pipe_micro
+        from ..parallel import pipeline_apply, split_microbatches
+        mesh = build_3d_mesh(jax.devices()[:8], data=2, pipe=2, model=2)
+        params = {
+            "w_up": jnp.asarray(
+                rng.normal(size=(2, tp, d_model, d_ff // tp)), jnp.float32),
+            "w_down": jnp.asarray(
+                rng.normal(size=(2, tp, d_ff // tp, d_model)), jnp.float32),
+        }
+        pp_specs = {"w_up": P("pipe", "model"),
+                    "w_down": P("pipe", "model")}
+
+        def pipe_loss(sp, batch):
+            mb = split_microbatches(batch, 2)
+
+            def stage_fn(stage_params, x):
+                return tp_mlp(x, stage_params["w_up"][0],
+                              stage_params["w_down"][0], axis="model")
+
+            out = pipeline_apply(stage_fn, sp, mb, axis="pipe")
+            y = jnp.concatenate(list(out), axis=0)
+            return jnp.mean(y * y)
+
+        opt = _dist.DistributedOptimizer(
+            optax.sgd(0.01), compression=Compression.fp16,
+            fusion_threshold=_TINY_THRESHOLD, axes=data_axes(mesh))
+        step = _training.make_train_step(
+            pipe_loss, opt, mesh=mesh, tp=tp, pipeline_stages=2,
+            microbatches=2, param_specs=pp_specs)
+        opt_state = opt.init(params)
+        batch = jnp.ones((2 * 8, d_model), jnp.float32)
+        # 16 global rows / 2 data devices / 2 train microbatches = 4 rows
+        # per loss call, halved again by the 2 pipeline microbatches.
+        step._meta["model_parallel"] = {"d_model": d_model, "act_rows": 4,
+                                        "pipe_microbatches": 2}
     return step, (params, opt_state, batch), (0, 1), f"step:{config}"
 
 

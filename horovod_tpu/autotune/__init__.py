@@ -91,19 +91,23 @@ COMP_CODEC_BASE = 4
 # the cross-slice hop of the two-level exchange when the hierarchical
 # axis is on.  0 keeps the sample's plain codec on every leg.
 HIER_DCN_NONE, HIER_DCN_BF16, HIER_DCN_FP16, HIER_DCN_FP8 = 0, 1, 2, 3
+# MoE all_to_all codec axis encoding (grid member 9): the wire dtype of
+# the dispatch/combine shuffle in ``parallel.moe.moe_ffn`` (PR 18).
+MOE_NONE, MOE_BF16, MOE_FP16 = 0, 1, 2
+_MOE_CODES = {MOE_NONE: "none", MOE_BF16: "bf16", MOE_FP16: "fp16"}
 
 
 def _grid(thresholds, cycles, hiers, comps, zeros, chunks, steps, micros,
-          hcodecs) -> List[Tuple[int, float, int, int, int, int, int, int,
-                                 int]]:
+          hcodecs, moes) -> List[Tuple[int, float, int, int, int, int, int,
+                                       int, int, int]]:
     # A DCN-leg codec without the hierarchical schedule is meaningless
     # (there is no separate DCN hop to compress), so those combinations
     # are pruned rather than burning sample budget re-measuring the flat
     # exchange.
-    return [(t, c, h, k, z, ch, sp, mb, hc) for t in thresholds
+    return [(t, c, h, k, z, ch, sp, mb, hc, mo) for t in thresholds
             for c in cycles for h in hiers for k in comps for z in zeros
             for ch in chunks for sp in steps for mb in micros
-            for hc in hcodecs if not (h == 0 and hc != 0)]
+            for hc in hcodecs for mo in moes if not (h == 0 and hc != 0)]
 
 
 def modeled_exchange_seconds(payload_bytes: float, *, n_dcn: int,
@@ -247,16 +251,29 @@ class Autotuner:
         hcodecs = [HIER_DCN_NONE, HIER_DCN_BF16, HIER_DCN_FP16,
                    HIER_DCN_FP8] if self.tunes_hier_codec \
             else [HIER_DCN_NONE]
+        # MoE all_to_all codec axis (opt-in, HOROVOD_AUTOTUNE_MOE=1; it
+        # narrows the expert shuffle's wire numerics): which codec the
+        # dispatch/combine all_to_all pair of ``parallel.moe.moe_ffn``
+        # casts its slot tensors to.  Trace-time -- the cast is part of
+        # the traced step -- so it rides trace_key.  Without the opt-in
+        # the axis pins to the configured HOROVOD_MOE_COMPRESSION.
+        configured_moe = {v: k for k, v in _MOE_CODES.items()}.get(
+            str(getattr(config, "moe_compression", None) or "none").lower(),
+            MOE_NONE)
+        self.tunes_moe = bool(_env_bool("AUTOTUNE_MOE"))
+        moes = [MOE_NONE, MOE_BF16, MOE_FP16] if self.tunes_moe \
+            else [configured_moe]
         self.grid = _grid(sorted(self.candidates), sorted(cycles), hiers,
-                          comps, zeros, chunks, steps, micros, hcodecs)
+                          comps, zeros, chunks, steps, micros, hcodecs,
+                          moes)
         self.steps_per_sample = steps_per_sample
         self.max_samples = min(max_samples, len(self.grid))
         self.log_path = config.autotune_log
         self.warm_start_skipped = 0
         self._opt = BayesianOptimizer(
             [(float(t), c, float(h), float(k), float(z), float(ch),
-              float(sp), float(mb), float(hc))
-             for t, c, h, k, z, ch, sp, mb, hc in self.grid])
+              float(sp), float(mb), float(hc), float(mo))
+             for t, c, h, k, z, ch, sp, mb, hc, mo in self.grid])
         self._samples: List[tuple] = []
         self._best: Optional[Tuple[int, float]] = None
         self._step = 0
@@ -273,7 +290,7 @@ class Autotuner:
 
     # -- current knobs ----------------------------------------------------
     def _current(self) -> Tuple[int, float, int, int, int, int, int, int,
-                                int]:
+                                int, int]:
         return self._best or self.grid[self._idx]
 
     def fusion_threshold(self) -> int:
@@ -350,15 +367,22 @@ class Autotuner:
         part of :meth:`trace_key`."""
         return int(self._current()[7])
 
+    def moe_codec(self) -> str:
+        """MoE all_to_all wire codec of the current sample
+        (``"none"``/``"bf16"``/``"fp16"``; ``parallel.moe.moe_ffn``)."""
+        return _MOE_CODES[int(self._current()[9])]
+
     def trace_key(self) -> tuple:
         """The TRACE-TIME knobs of the current sample (the compiled step
         cache in ``training.make_train_step`` keys on this).  Cycle time
         is deliberately excluded: it is a RUNTIME knob applied through
         ``_apply_to_batcher``, and keying on it would recompile an
         identical trace for every cycle-axis sample.  Steps-per-exec and
-        microbatches are likewise excluded (build-time structural knobs)."""
-        thr, _cyc, hier, comp, zero, chunk, _sp, _mb, hc = self._current()
-        return (thr, hier, comp, zero, chunk, hc)
+        microbatches are likewise excluded (build-time structural knobs).
+        The MoE codec IS a member: the cast is part of the traced step."""
+        thr, _cyc, hier, comp, zero, chunk, _sp, _mb, hc, mo = \
+            self._current()
+        return (thr, hier, comp, zero, chunk, hc, mo)
 
     @property
     def done(self) -> bool:
@@ -463,18 +487,18 @@ class Autotuner:
                 try:
                     if len(parts) == 3:     # pre-round-3 log format
                         cfg = (int(float(parts[0])), float(parts[1]),
-                               0, COMP_DEFAULT, 0, 0, 1, 1, 0)
+                               0, COMP_DEFAULT, 0, 0, 1, 1, 0, 0)
                         score = float(parts[2])
                     elif len(parts) == 5:   # rounds 3-5: no zero axis
                         cfg = (int(float(parts[0])), float(parts[1]),
                                int(float(parts[2])),
-                               int(float(parts[3])), 0, 0, 1, 1, 0)
+                               int(float(parts[3])), 0, 0, 1, 1, 0, 0)
                         score = float(parts[4])
                     elif len(parts) == 6:   # PR-1: zero, no chunk/steps
                         cfg = (int(float(parts[0])), float(parts[1]),
                                int(float(parts[2])),
                                int(float(parts[3])),
-                               int(float(parts[4])), 0, 1, 1, 0)
+                               int(float(parts[4])), 0, 1, 1, 0, 0)
                         score = float(parts[5])
                     elif len(parts) == 8:   # PR-2: chunk + steps axes
                         cfg = (int(float(parts[0])), float(parts[1]),
@@ -482,10 +506,13 @@ class Autotuner:
                                int(float(parts[3])),
                                int(float(parts[4])),
                                int(float(parts[5])),
-                               int(float(parts[6])), 1, 0)
+                               int(float(parts[6])), 1, 0, 0)
                         score = float(parts[7])
-                    elif len(parts) in (9, 10):  # PR-3: microbatch axis;
-                        # PR-11 appends the hier DCN-codec axis
+                    elif len(parts) in (9, 10, 11):
+                        # PR-3: microbatch axis; PR-11 appends the hier
+                        # DCN-codec axis; PR-18 appends the MoE codec
+                        # axis.  Positional: missing trailing axes load
+                        # as their pre-widening default (0).
                         cfg = (int(float(parts[0])), float(parts[1]),
                                int(float(parts[2])),
                                int(float(parts[3])),
@@ -494,7 +521,9 @@ class Autotuner:
                                int(float(parts[6])),
                                int(float(parts[7])),
                                int(float(parts[8]))
-                               if len(parts) == 10 else 0)
+                               if len(parts) >= 10 else 0,
+                               int(float(parts[9]))
+                               if len(parts) == 11 else 0)
                         score = float(parts[-1])
                     else:                   # unknown column count
                         skipped += 1
@@ -530,9 +559,10 @@ class Autotuner:
         with open(self.log_path, "w") as f:
             f.write("fusion_threshold_bytes,cycle_time_ms,hierarchical,"
                     "compression,zero,exchange_chunk_bytes,steps_per_exec,"
-                    "microbatches,hier_dcn_codec,score_bytes_per_s\n")
-            for thr, cyc, hier, comp, zero, chunk, sp, mb, hc, score \
+                    "microbatches,hier_dcn_codec,moe_codec,"
+                    "score_bytes_per_s\n")
+            for thr, cyc, hier, comp, zero, chunk, sp, mb, hc, mo, score \
                     in self._samples:
                 f.write(f"{thr},{cyc},{hier},{comp},{zero},{chunk},{sp},"
-                        f"{mb},{hc},{score}\n")
+                        f"{mb},{hc},{mo},{score}\n")
             f.write("# best," + ",".join(str(v) for v in self._best) + "\n")

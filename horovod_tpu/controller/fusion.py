@@ -391,6 +391,38 @@ def plan_hier_legs(size: int, dtype, *, n_dcn: int, n_ici: int,
     ]
 
 
+def plan_moe_alltoall(n_experts: int, capacity: int, d_model: int, *,
+                      dtype=jnp.float32, compression=None,
+                      axis: str = "model") -> List[ExchangeLeg]:
+    """Closed-form leg plan for one MoE layer's all_to_all pair.
+
+    Mirrors ``parallel.moe.moe_ffn`` exactly: the dispatch leg moves the
+    f32 ``(E, C, d)`` slot tensor (split experts, concat slots), the
+    combine leg moves the same payload back, and ``compression`` (the
+    ``HOROVOD_MOE_COMPRESSION`` / autotuner-MoE-axis codec) narrows both
+    legs' wire dtype.  ``elements`` is the per-device operand element
+    count the jaxpr auditor records for each ``all_to_all``; ``nbytes``
+    matches the ``moe/a2a_*`` ``note_leg`` accounting byte-for-byte.
+    """
+    from ..parallel.moe import _MOE_CODECS, resolve_moe_compression
+    codec = resolve_moe_compression(compression)
+    wire = _MOE_CODECS[codec]
+    dt = jnp.dtype(dtype)
+    wire_dt = jnp.dtype(wire) if wire is not None else dt
+    elements = int(n_experts) * int(capacity) * int(d_model)
+    nbytes = elements * wire_dt.itemsize
+    return [
+        ExchangeLeg(tag="moe/a2a_dispatch", axis=axis,
+                    collective="all_to_all", codec=codec,
+                    wire_dtype=str(wire_dt), elements=elements,
+                    nbytes=nbytes),
+        ExchangeLeg(tag="moe/a2a_combine", axis=axis,
+                    collective="all_to_all", codec=codec,
+                    wire_dtype=str(wire_dt), elements=elements,
+                    nbytes=nbytes),
+    ]
+
+
 # -- plan introspection ----------------------------------------------------
 
 def _fence_policy() -> str:
@@ -409,7 +441,8 @@ def _fence_policy() -> str:
 
 def explain_plan(params, threshold_bytes: Optional[int] = None,
                  compression=None, reverse: bool = False,
-                 extra: Tuple = (), register: bool = True) -> List[dict]:
+                 extra: Tuple = (), register: bool = True,
+                 moe: Optional[dict] = None) -> List[dict]:
     """Render the planner's decision for ``params`` as structured rows.
 
     One dict per bucket: ``bucket`` index, ``dtype``, ``leaves`` count,
@@ -425,6 +458,13 @@ def explain_plan(params, threshold_bytes: Optional[int] = None,
     ``register=True`` also publishes the rows as ``horovod_plan_*``
     gauges so ``/metrics`` exposes the current plan.  Printable via
     ``python -m horovod_tpu.run --explain-plan`` (:func:`render_plan`).
+
+    ``moe`` prices a model's MoE all_to_all traffic alongside the
+    gradient buckets: a dict with ``n_experts``, ``capacity`` and
+    ``d_model`` (optional ``layers`` -- MoE layer count, default 1 --
+    plus ``compression`` and ``axis``) appends one extra row whose legs
+    come from :func:`plan_moe_alltoall`, one dispatch/combine pair per
+    layer.
     """
     from ..collectives.compression import (is_error_feedback,
                                            parse_compression,
@@ -472,6 +512,27 @@ def explain_plan(params, threshold_bytes: Optional[int] = None,
                 + (["rev"] if reverse else [])),
             "legs": [dataclasses.asdict(l) for l in legs]
             if legs is not None else None,
+        })
+    if moe is not None:
+        layers = int(moe.get("layers", 1))
+        pair = plan_moe_alltoall(
+            moe["n_experts"], moe["capacity"], moe["d_model"],
+            dtype=moe.get("dtype", jnp.float32),
+            compression=moe.get("compression"),
+            axis=moe.get("axis", "model"))
+        moe_legs = pair * layers
+        elements = sum(l.elements for l in moe_legs)
+        raw = elements * jnp.dtype(moe.get("dtype", jnp.float32)).itemsize
+        rows.append({
+            "bucket": len(rows), "dtype": pair[0].wire_dtype,
+            "leaves": 0, "elements": int(elements), "bytes": int(raw),
+            "wire_bytes": int(sum(l.nbytes for l in moe_legs)),
+            "codec": pair[0].codec, "fence": fence,
+            "fuse_key": "|".join(
+                ["moe", f"E={int(moe['n_experts'])}",
+                 f"C={int(moe['capacity'])}", f"d={int(moe['d_model'])}",
+                 f"L={layers}", pair[0].codec]),
+            "legs": [dataclasses.asdict(l) for l in moe_legs],
         })
     if register:
         register_plan_gauges(rows)

@@ -131,6 +131,22 @@ class Config:
     # biases convergence.
     ef_residual: bool = True
 
+    # 3-D parallelism defaults for train steps built without explicit
+    # arguments (training.py).  HOROVOD_TP: tensor-parallel degree --
+    # params shard over the mesh's "model" axis and the TP collectives
+    # (row-parallel allreduce) run inside a slice.  HOROVOD_PIPELINE_STAGES:
+    # pipeline-stage count over the "pipe" axis.  1 = off (pure DP,
+    # bitwise-identical traces to the pre-3D build).
+    tp: int = 1
+    pipeline_stages: int = 1
+
+    # MoE all-to-all wire codec (HOROVOD_MOE_COMPRESSION): none|bf16|fp16.
+    # Casts the dispatch/combine slot buffers before each all_to_all and
+    # restores f32 after -- the expert-parallel analogue of the gradient
+    # exchange codecs.  The autotuner's MoE axis (HOROVOD_AUTOTUNE_MOE=1)
+    # overrides this per sample.
+    moe_compression: Optional[str] = None
+
     # Chunked gradient exchange (HOROVOD_EXCHANGE_CHUNK_MB, megabytes;
     # 0 disables).  Decomposes each fusion bucket's allreduce into
     # chunk-sized reduce-scatter + all-gather pairs so XLA's latency-hiding
@@ -315,6 +331,9 @@ def load_config() -> Config:
         zero_stage=_env_int("ZERO", 0),
         steps_per_exec=_env_int("STEPS_PER_EXEC", 1),
         microbatches=_env_int("MICROBATCHES", 1),
+        tp=_env_int("TP", 1),
+        pipeline_stages=_env_int("PIPELINE_STAGES", 1),
+        moe_compression=_env("MOE_COMPRESSION"),
         compression=_env("COMPRESSION"),
         ef_residual=_env_bool("EF_RESIDUAL", True),
         deferred_fuse=_env_bool("DEFERRED_FUSE", True),

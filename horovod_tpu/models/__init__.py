@@ -8,7 +8,8 @@ from .inception import InceptionV3  # noqa: F401
 from .vgg import VGG, VGG16, VGG19  # noqa: F401
 from .transformer import (  # noqa: F401
     BERT_BASE, BERT_LARGE, BERT_TINY, Bert, BertConfig, LLAMA3_8B,
-    LLAMA_1B, LLAMA_SERVE, LLAMA_TINY, LlamaConfig, LlamaLM, lora_mask,
+    LLAMA_1B, LLAMA_SERVE, LLAMA_TINY, LlamaConfig, LlamaLM,
+    bert_tp_apply, lora_mask,
     merge_frozen,
     merge_lora, quantize_frozen_base, quantize_int8, split_frozen,
 )

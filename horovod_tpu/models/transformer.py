@@ -428,6 +428,79 @@ class Bert(nn.Module):
         return mlm_logits, nsp_logits
 
 
+def bert_tp_apply(params, config: BertConfig, tokens, token_types=None, *,
+                  axis: str = "model", dtype: Dtype = jnp.float32):
+    """Tensor-parallel :class:`Bert` forward over LOCAL param shards.
+
+    The Megatron split of the encoder, as an SPMD function for use inside
+    ``jax.shard_map`` over a ``build_3d_mesh`` ``model`` axis: per block,
+    ``wq``/``wk``/``wv``/``w_in`` are column shards (heads and the FFN
+    hidden split over tp, biases split with them -- the
+    ``parallel.tp_param_specs`` layout), ``wo``/``w_out`` row shards
+    closing in one psum each, and everything else (embeddings,
+    layernorms, the MLM/NSP heads) replicated.  Exactly two allreduces
+    per block forward, both of the full ``(b, t, d_model)`` activation;
+    numerics match ``Bert.apply`` on the unsharded tree to float
+    tolerance.
+
+    ``params`` is the ``Bert.init`` variables dict (``{"params": ...}``)
+    as sliced by the spec tree; requires ``num_heads`` and ``ffn_hidden``
+    divisible by the tp extent.
+    """
+    from ..parallel.tp import copy_to_tp, row_parallel
+
+    cfg = config
+    p = params["params"]
+    b, t = tokens.shape
+    if token_types is None:
+        token_types = jnp.zeros_like(tokens)
+
+    def ln(x, node):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + 1e-12)
+        return (y * node["scale"] + node["bias"]).astype(dtype)
+
+    def dense(x, node):
+        return x @ node["kernel"].astype(dtype) + node["bias"].astype(dtype)
+
+    emb = p["tok_embed"]
+    x = (emb[tokens] + p["pos_embed"][None, :t]
+         + p["type_embed"][token_types]).astype(dtype)
+    x = ln(x, p["embed_norm"])
+    for i in range(cfg.num_layers):
+        blk = p[f"layer_{i}"]
+        # copy_to_tp is Megatron's "f": identity forward, one backward
+        # psum merging the per-rank partial input cotangents of the
+        # column layers it feeds (q/k/v here, w_in below).
+        h = copy_to_tp(ln(x, blk["attn_norm"]), axis=axis)
+        # Local head count comes off the sliced kernel, not the mesh.
+        d_local = blk["wq"]["kernel"].shape[-1]
+        head_dim = cfg.d_model // cfg.num_heads
+        heads_local = d_local // head_dim
+        q = dense(h, blk["wq"]).reshape(b, t, heads_local, head_dim)
+        k = dense(h, blk["wk"]).reshape(b, t, heads_local, head_dim)
+        v = dense(h, blk["wv"]).reshape(b, t, heads_local, head_dim)
+        o = flash_attention(q.transpose(0, 2, 1, 3),
+                            k.transpose(0, 2, 1, 3),
+                            v.transpose(0, 2, 1, 3), causal=False)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, d_local)
+        x = x + row_parallel(o, blk["wo"]["kernel"].astype(dtype),
+                             blk["wo"]["bias"].astype(dtype), axis=axis)
+        h = copy_to_tp(ln(x, blk["mlp_norm"]), axis=axis)
+        h = nn.gelu(dense(h, blk["w_in"]), approximate=True)
+        x = x + row_parallel(h, blk["w_out"]["kernel"].astype(dtype),
+                             blk["w_out"]["bias"].astype(dtype), axis=axis)
+    x = ln(x, p["final_norm"])
+    h = nn.gelu(dense(x, p["mlm_transform"]), approximate=True)
+    h = ln(h, p["mlm_norm"])
+    mlm_logits = h.astype(jnp.float32) @ emb.T
+    cls = jnp.tanh(dense(x[:, 0], p["pooler"]))
+    nsp_logits = dense(cls, p["nsp"]).astype(jnp.float32)
+    return mlm_logits, nsp_logits
+
+
 # ---------------------------------------------------------------------------
 # LoRA utilities
 # ---------------------------------------------------------------------------

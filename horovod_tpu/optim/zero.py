@@ -375,7 +375,7 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
 
 
 def zero_init(optimizer, params, mesh: Optional[Mesh] = None,
-              compression=None):
+              compression=None, param_specs=None):
     """Build the sharded optimizer state for ``zero_stage=1``.
 
     Each device runs ``optimizer.init`` on its own arena shard; the
@@ -389,14 +389,25 @@ def zero_init(optimizer, params, mesh: Optional[Mesh] = None,
     :class:`_ZeroEFState` with one zero f32 residual per arena shard,
     sharded like the inner state.  Dtype codecs (fp16/bf16/fp8) carry no
     state and may be omitted here.
+
+    On a model-parallel mesh (``build_3d_mesh`` with ``model``/``pipe``
+    axes) pass ``param_specs`` -- the same pytree of ``PartitionSpec``s
+    the train step was built with.  The arena is then planned over each
+    device's LOCAL (TP/stage-sharded) parameter leaves and sharded over
+    the DATA axes only: every (tp, pipe) group owns an independent ZeRO
+    arena for its own shard of the model, the state still occupies
+    ``1/n_data`` of that group's replicated state per chip, and the
+    returned leaves carry a leading axis of the FULL mesh extent (one
+    arena row per device, sharded over every mesh axis).
     """
     from ..core import basics as _basics
+    from ..parallel.mesh import data_axes as _data_axes
     _reject_distributed(optimizer)
     comp = parse_compression(compression) if compression else Compression.none
     ef = is_error_feedback(comp)
     mesh = mesh or _basics.mesh()
-    axes = tuple(mesh.axis_names)
-    world = int(np.prod(mesh.devices.shape))
+    axes = _data_axes(mesh)
+    world = int(np.prod([mesh.shape[a] for a in axes]))
 
     def local_init(params):
         leaves = jax.tree.leaves(params)
@@ -419,8 +430,10 @@ def zero_init(optimizer, params, mesh: Optional[Mesh] = None,
                 inner=out)
         return out
 
-    fn = jax.shard_map(local_init, mesh=mesh, in_specs=(P(),),
-                       out_specs=P(axes), check_vma=False)
+    p_spec = param_specs if param_specs is not None else P()
+    fn = jax.shard_map(local_init, mesh=mesh, in_specs=(p_spec,),
+                       out_specs=P(tuple(mesh.axis_names)),
+                       check_vma=False)
     return jax.jit(fn)(params)
 
 

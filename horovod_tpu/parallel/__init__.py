@@ -9,15 +9,20 @@ functions composed inside ``jax.shard_map`` over a
 """
 
 from .mesh import (  # noqa: F401
-    DCN_AXIS, DP_AXIS, EP_AXIS, FLAT_AXES, HIER_AXES, HVD_AXIS, ICI_AXIS,
-    PARALLEL_AXES, PP_AXIS, SP_AXIS, TP_AXIS, build_mesh,
-    build_parallel_mesh, mesh_axes, mesh_size,
+    DATA_AXIS, DCN_AXIS, DP_AXIS, EP_AXIS, FLAT_AXES, HIER_AXES, HVD_AXIS,
+    ICI_AXIS, MODEL_AXIS, MODEL_PARALLEL_AXES, PARALLEL_AXES, PIPE_AXIS,
+    PP_AXIS, SP_AXIS, THREED_AXES, TP_AXIS, build_3d_mesh, build_mesh,
+    build_parallel_mesh, data_axes, mesh_axes, mesh_size, model_axes,
 )
 from .tp import (  # noqa: F401
-    column_parallel, row_parallel, shard_tp_params, tp_mlp,
+    column_parallel, copy_to_tp, reduce_from_tp, row_parallel,
+    shard_tp_params, tp_mlp,
+    tp_param_specs,
 )
 from .sequence import ring_attention, ulysses_attention  # noqa: F401
 from .pipeline import (  # noqa: F401
     pipeline_apply, split_microbatches, stack_stage_params,
 )
-from .moe import init_moe_params, moe_ffn  # noqa: F401
+from .moe import (  # noqa: F401
+    init_moe_params, moe_ffn, resolve_moe_compression,
+)

@@ -161,6 +161,88 @@ def build_parallel_mesh(
     return Mesh(grid, PARALLEL_AXES)
 
 
+# Canonical axis names for the 3-D training mesh (outermost first).
+# "data" is the gradient-exchange axis; when DCN splits it, the pair
+# ("dcn", "data") is exactly the two-level communicator of build_mesh, so
+# the DP gradient leg rides the hierarchical exchange: TP (and pipeline)
+# stay inside a slice on ICI, DP crosses slices on DCN.  "model" sits
+# innermost on the fastest ICI loops (TP is latency-critical), "pipe"
+# between them (one ppermute per microbatch tick).
+DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
+MODEL_AXIS = "model"
+THREED_AXES: Tuple[str, ...] = (DCN_AXIS, DATA_AXIS, PIPE_AXIS, MODEL_AXIS)
+
+# Axes that shard the MODEL (parameters / stages), never the batch.  The
+# complement of these in a mesh's axis_names is the gradient-exchange
+# domain -- see :func:`data_axes`.
+MODEL_PARALLEL_AXES: Tuple[str, ...] = (PIPE_AXIS, MODEL_AXIS)
+
+
+def build_3d_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data: int = 1,
+    pipe: int = 1,
+    model: int = 1,
+    dcn_size: int = 1,
+) -> Mesh:
+    """Build the named-sharding mesh for DP x pipeline x TP training.
+
+    Axes are drawn from ``(dcn, data, pipe, model)`` outermost-first, but
+    extent-1 axes are OMITTED (``data`` is always kept) so the mesh's
+    gradient-exchange domain matches what the optimized exchange stack
+    expects: with ``dcn_size > 1`` the data axes are exactly the
+    two-level ``("dcn", "data")`` pair and the DP gradient leg rides the
+    hierarchical ICI x DCN exchange; without it they are the flat
+    ``("data",)`` axis.
+
+    Args:
+      devices: devices to include; defaults to ``jax.devices()``.
+      data: data-parallel extent WITHIN a slice (the ICI leg of the DP
+        exchange when ``dcn_size > 1``).
+      pipe: pipeline-stage extent (``parallel.pipeline`` axis).
+      model: tensor-parallel extent (``parallel.tp`` axis).
+      dcn_size: number of slices the ``data`` axis is split over (the DCN
+        leg); ``1`` keeps the mesh single-slice.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    extents = {DCN_AXIS: int(dcn_size), DATA_AXIS: int(data),
+               PIPE_AXIS: int(pipe), MODEL_AXIS: int(model)}
+    for name, e in extents.items():
+        if e < 1:
+            raise ValueError(
+                f"bad 3-D mesh extent {name}={e}: extents must be >= 1")
+    prod = int(np.prod(list(extents.values())))
+    if prod != n:
+        raise ValueError(
+            f"dcn*data*pipe*model = {prod} != {n} devices ({extents})")
+    axes = tuple(a for a in THREED_AXES
+                 if extents[a] > 1 or a == DATA_AXIS)
+    grid = np.asarray(devices, dtype=object).reshape(
+        *[extents[a] for a in axes])
+    return Mesh(grid, axes)
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The gradient-exchange axes of ``mesh``: every axis that shards the
+    BATCH rather than the model.  For a :func:`build_3d_mesh` mesh this is
+    ``("dcn", "data")`` (hierarchical) or ``("data",)``; for the pure-DP
+    meshes of :func:`build_mesh` it is all axes (unchanged behaviour)."""
+    return tuple(a for a in mesh.axis_names
+                 if a not in MODEL_PARALLEL_AXES
+                 and a not in (EP_AXIS, SP_AXIS, TP_AXIS, PP_AXIS))
+
+
+def model_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The model-parallel axes of ``mesh`` (complement of
+    :func:`data_axes`)."""
+    da = set(data_axes(mesh))
+    return tuple(a for a in mesh.axis_names if a not in da)
+
+
 def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     """The reduction axes for a mesh produced by :func:`build_mesh`."""
     return tuple(mesh.axis_names)

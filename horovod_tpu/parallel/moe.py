@@ -25,11 +25,53 @@ import jax.numpy as jnp
 from ..collectives import ops as _ops
 from .mesh import EP_AXIS
 
+# Wire codecs for the two MoE all_to_all legs: the (E, C, d) f32 slot
+# tensors are cast down before the shuffle and back up after it.  The
+# expert matmuls and the weighted combine still run on the full-precision
+# values, so only the wire payload narrows (same contract as the fp16/bf16
+# gradient codecs in ``collectives.compression``).
+_MOE_CODECS = {"none": None, "bf16": jnp.bfloat16, "fp16": jnp.float16}
+
+
+def resolve_moe_compression(compression=None):
+    """Resolve the MoE all_to_all wire codec: explicit argument, else the
+    autotuner's MoE axis (``HOROVOD_AUTOTUNE_MOE=1``), else the config's
+    ``HOROVOD_MOE_COMPRESSION``.  Returns ``"none"``/``"bf16"``/``"fp16"``."""
+    if compression is None:
+        from ..core.state import global_state
+        st = global_state()
+        tuner = st.autotuner
+        if tuner is not None and getattr(tuner, "tunes_moe", False):
+            compression = tuner.moe_codec()
+        elif st.config is not None and st.config.moe_compression:
+            compression = st.config.moe_compression
+    name = str(compression or "none").lower()
+    if name not in _MOE_CODECS:
+        raise ValueError(
+            f"unknown MoE compression {compression!r}: expected one of "
+            f"{sorted(_MOE_CODECS)}")
+    return name
+
+
+def _a2a_leg(slots, *, axis, split_axis, concat_axis, codec, leg):
+    """One MoE all_to_all leg: note the wire payload for the trace
+    auditor, cast to the wire dtype, shuffle, cast back to f32."""
+    from ..timeline import spans as _spans
+    wire = _MOE_CODECS[codec]
+    itemsize = jnp.dtype(wire).itemsize if wire is not None else 4
+    _spans.note_leg(leg, nbytes=int(slots.size) * itemsize)
+    if wire is not None:
+        slots = slots.astype(wire)
+    out = _ops.alltoall(slots, axes=axis, split_axis=split_axis,
+                        concat_axis=concat_axis)
+    return out.astype(jnp.float32)
+
 
 def moe_ffn(x, router_kernel, w_up, w_down, *, capacity_factor: float = 1.25,
             top_k: int = 1, axis: str = EP_AXIS,
             activation: Callable = jax.nn.gelu,
-            router_noise_rng: Optional[jax.Array] = None):
+            router_noise_rng: Optional[jax.Array] = None,
+            compression: Optional[str] = None):
     """Mixture-of-experts FFN over the ``ep`` axis.
 
     Local shapes: x (t_l, d); router_kernel (d, E) replicated;
@@ -43,7 +85,13 @@ def moe_ffn(x, router_kernel, w_up, w_down, *, capacity_factor: float = 1.25,
     up to ``ep * C`` tokens globally -- the Switch per-device capacity
     rule, and every rank derives the same static C so shapes stay static
     for XLA.
+
+    ``compression`` picks the wire codec for the two all_to_all legs
+    (``"bf16"``/``"fp16"``/``"none"``); ``None`` defers to the autotuner's
+    MoE axis and then ``HOROVOD_MOE_COMPRESSION`` -- see
+    :func:`resolve_moe_compression`.
     """
+    codec = resolve_moe_compression(compression)
     ep = jax.lax.axis_size(axis)
     t_l, d = x.shape
     e_local = w_up.shape[0]
@@ -79,13 +127,14 @@ def moe_ffn(x, router_kernel, w_up, w_down, *, capacity_factor: float = 1.25,
     slots = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     # all_to_all: split the expert dim across ranks, concat token slots ->
     # (E_l, ep * C, d): every slot destined for my local experts.
-    slots = _ops.alltoall(slots, axes=axis, split_axis=0, concat_axis=1)
+    slots = _a2a_leg(slots, axis=axis, split_axis=0, concat_axis=1,
+                     codec=codec, leg="moe/a2a_dispatch")
     h = jnp.einsum("ecd,edf->ecf", slots.astype(x.dtype), w_up)
     h = activation(h)
     out = jnp.einsum("ecf,efd->ecd", h, w_down)
     # Route results back: split slots, concat experts -> (E, C, d).
-    out = _ops.alltoall(out.astype(jnp.float32), axes=axis, split_axis=1,
-                        concat_axis=0)
+    out = _a2a_leg(out.astype(jnp.float32), axis=axis, split_axis=1,
+                   concat_axis=0, codec=codec, leg="moe/a2a_combine")
     y = jnp.einsum("tec,ecd->td", combine, out)
     return y.astype(x.dtype), _load_balance_loss(probs, dispatch)
 

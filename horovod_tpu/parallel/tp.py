@@ -8,9 +8,13 @@ use inside ``jax.shard_map`` over the ``tp`` mesh axis:
 
 * ``column_parallel``: kernel split on the *output* dim; no communication
   in forward (the input is replicated over tp), each rank holds an output
-  shard.  The backward psum over input grads is inserted by autodiff.
+  shard.  The input's cotangent is a per-rank PARTIAL sum; wrap the input
+  with :func:`copy_to_tp` (Megatron's "f" operator) so backward closes it
+  with one psum -- ``shard_map(check_vma=False)`` will NOT insert it.
 * ``row_parallel``: kernel split on the *input* dim; forward ends in one
-  ``psum`` over tp.  Backward needs no collective.
+  ``psum`` over tp whose backward is IDENTITY (the "g" operator, pinned
+  via ``custom_vjp`` -- the raw psum transposes to another psum, which
+  would scale every upstream gradient by the tp extent).
 
 A column->row pair (e.g. FFN up/down, or QKV->output projection) therefore
 costs exactly one allreduce forward and one backward -- both of which XLA
@@ -35,6 +39,44 @@ from ..collectives.reduce_op import Sum
 from .mesh import TP_AXIS
 
 
+def copy_to_tp(x, *, axis: str = TP_AXIS):
+    """Megatron "f": identity forward, ``psum`` over ``axis`` backward.
+
+    Place on an activation that feeds column-parallel layers.  Each tp
+    rank's backward produces only its shard's contribution to the input
+    cotangent; the psum here merges them so everything upstream (layer
+    norms, embeddings, the residual stream) sees the FULL gradient.  One
+    ``copy_to_tp`` covers every column layer reading the same tensor
+    (q/k/v, or up+gate), costing a single backward allreduce per block.
+    """
+
+    @jax.custom_vjp
+    def f(y):
+        return y
+
+    f.defvjp(lambda y: (y, None),
+             lambda _, g: (_ops.allreduce(g, Sum, axes=axis),))
+    return f(x)
+
+
+def reduce_from_tp(x, *, axis: str = TP_AXIS):
+    """Megatron "g": ``psum`` over ``axis`` forward, identity backward.
+
+    The closing allreduce of a row-parallel layer.  The backward MUST be
+    identity -- the output cotangent is already replicated over tp, and
+    the raw psum's transpose is another psum, which would multiply every
+    upstream gradient by the tp extent.
+    """
+
+    @jax.custom_vjp
+    def g_op(y):
+        return _ops.allreduce(y, Sum, axes=axis)
+
+    g_op.defvjp(lambda y: (_ops.allreduce(y, Sum, axes=axis), None),
+                lambda _, g: (g,))
+    return g_op(x)
+
+
 def column_parallel(x, kernel, bias=None, *, axis: str = TP_AXIS):
     """y_local = x @ kernel_local (+ bias_local).
 
@@ -56,9 +98,10 @@ def row_parallel(x, kernel, bias=None, *, axis: str = TP_AXIS):
     ``x``: activation sharded on the feature dim (d_model / tp), as
     produced by :func:`column_parallel`.  ``kernel``: local shard
     (d_in / tp, d_out).  Bias is added *after* the psum (it is replicated;
-    adding per-rank would multiply it by tp).
+    adding per-rank would multiply it by tp).  The psum rides
+    :func:`reduce_from_tp`, so its backward is identity.
     """
-    y = _ops.allreduce(x @ kernel, Sum, axes=axis)
+    y = reduce_from_tp(x @ kernel, axis=axis)
     if bias is not None:
         y = y + bias
     return y
@@ -100,14 +143,58 @@ def shard_tp_params(params, tp_rank, tp_size, *, column_keys=("wq", "wk",
     return jax.tree_util.tree_map_with_path(shard, params)
 
 
+def tp_param_specs(params, *, axis: str = TP_AXIS,
+                   column_keys=("wq", "wk", "wv", "w_gate", "w_up", "w_in"),
+                   row_keys=("wo", "w_down", "w_out")):
+    """PartitionSpec tree for a TP train step over natural-dim shards.
+
+    The train-side counterpart of ``serving.decode_param_specs``, same
+    key convention (`shard_tp_params`): column kernels split on the
+    output dim ``P(None, axis)``, row kernels on the input dim
+    ``P(axis, None)``, everything else replicated -- with one training
+    difference: column-layer BIASES are split ``P(axis)`` too.  A bias
+    added before the row psum lives on the sharded feature dim, so its
+    gradient is per-shard; leaving it replicated (the serving layout,
+    where params are read-only) would let tp ranks diverge, since the
+    DP exchange averages over the data axes only.  Row-layer biases add
+    after the psum on replicated activations and stay ``P()``.
+
+    Pass the result as ``make_train_step(..., tp=..., param_specs=...)``;
+    the checkpoint saved from the step reassembles the FULL tree (the
+    out_specs concatenate the shards), so it loads directly into the
+    serving plane's replicated-params decode path.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(k, "key", "") for k in path]
+        if len(names) < 2 or names[-1] not in ("kernel", "bias"):
+            return P()
+        owner = names[-2]
+        if owner in column_keys:
+            if names[-1] == "kernel" and leaf.ndim == 2:
+                return P(None, axis)
+            if names[-1] == "bias" and leaf.ndim == 1:
+                return P(axis)
+        elif owner in row_keys and names[-1] == "kernel" \
+                and leaf.ndim == 2:
+            return P(axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
 def tp_mlp(x, w_up, w_down, *, axis: str = TP_AXIS,
            activation=jax.nn.silu, w_gate: Optional[jnp.ndarray] = None):
     """Column->row parallel MLP: one fused psum for the whole block.
 
     With ``w_gate`` supplied this is the SwiGLU used by the Llama family;
     without, a plain 2-layer MLP.  ``w_up``/``w_gate`` are column shards,
-    ``w_down`` a row shard.
+    ``w_down`` a row shard.  The input rides one :func:`copy_to_tp` (both
+    column layers read it), so the block costs exactly one allreduce
+    forward and one backward.
     """
+    x = copy_to_tp(x, axis=axis)
     up = column_parallel(x, w_up)
     if w_gate is not None:
         up = activation(column_parallel(x, w_gate)) * up

@@ -30,25 +30,64 @@ from .optim import distributed as _dist
 from .optim import zero as _zero
 
 
-def _opt_state_spec(optimizer, zero_stage: int, axes):
+def _opt_state_spec(optimizer, zero_stage: int, axes, override=None):
     """Partition spec (pytree prefix) for the optimizer-state carry.
 
     ZeRO-1 state is arena-sharded ``P(axes)``.  An error-feedback wrap's
     state mixes specs: the per-rank residual leaves (leading world axis)
     shard ``P(axes)`` while the inner optimizer state stays replicated --
-    expressed as an ``_EFState``-shaped spec prefix.  Everything else is
+    expressed as an ``_EFState``-shaped spec prefix.  ``override`` (the
+    builders' ``opt_state_specs=``) wins for everything else -- the TP
+    case, where a stateful optimizer's param-shaped moments must shard
+    like the params (:func:`mirror_opt_state_specs`).  Default:
     replicated."""
     if zero_stage:
         return P(axes)
     if _dist.is_ef_optimizer(optimizer):
         return _dist._EFState(residuals=P(axes), inner=P())
+    if override is not None:
+        return override
     return P()
 
 
+def mirror_opt_state_specs(optimizer, params, param_specs):
+    """Optimizer-state spec tree mirroring TP/pipeline ``param_specs``.
+
+    A stateful optimizer (Adam moments, SGD momentum) carries param-tree-
+    shaped subtrees in its state; on a model-parallel mesh those must
+    shard exactly like the params or the shard_map in_specs try to place
+    a full-shaped moment next to a sharded param.  This walks
+    ``jax.eval_shape(optimizer.init, params)`` and substitutes
+    ``param_specs`` for every subtree structurally equal to ``params``
+    (scalars such as the Adam step count stay replicated).  Pass the
+    result as ``make_train_step(..., opt_state_specs=...)``.
+    """
+    state = jax.eval_shape(optimizer.init, params)
+    pstruct = jax.tree.structure(params)
+
+    def is_param_tree(node):
+        try:
+            return jax.tree.structure(node) == pstruct
+        except Exception:  # noqa: BLE001 - non-pytree node
+            return False
+
+    def leaf(node):
+        return is_param_tree(node) or not jax.tree.leaves(node) \
+            or isinstance(node, jax.ShapeDtypeStruct)
+
+    return jax.tree.map(
+        lambda n: param_specs if is_param_tree(n) else P(),
+        state, is_leaf=leaf)
+
+
 def batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
-    """Sharding that splits the leading (batch) dim over every mesh axis."""
+    """Sharding that splits the leading (batch) dim over the mesh's DATA
+    axes (all axes on a pure-DP mesh; the batch is replicated over the
+    ``model``/``pipe`` axes of a :func:`~horovod_tpu.parallel.build_3d_mesh`
+    mesh -- every TP rank and pipeline stage sees its DP shard whole)."""
+    from .parallel.mesh import data_axes as _data_axes
     mesh = mesh or _basics.mesh()
-    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
+    return NamedSharding(mesh, P(_data_axes(mesh)))
 
 
 def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
@@ -60,9 +99,10 @@ def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
 def stacked_batch_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
     """Sharding for :func:`stack_steps` output: dim 0 is the (unsharded)
     steps axis the scan loop consumes, dim 1 the global batch split over
-    every mesh axis."""
+    the mesh's data axes."""
+    from .parallel.mesh import data_axes as _data_axes
     mesh = mesh or _basics.mesh()
-    return NamedSharding(mesh, P(None, tuple(mesh.axis_names)))
+    return NamedSharding(mesh, P(None, _data_axes(mesh)))
 
 
 def shard_steps(stacked: Any, mesh: Optional[Mesh] = None) -> Any:
@@ -197,6 +237,98 @@ def _resolve_microbatches(k: Optional[int]) -> int:
     return k
 
 
+def _resolve_tp(tp: Optional[int]) -> int:
+    """``None`` defers to the configured default (``HOROVOD_TP``)."""
+    if tp is None:
+        from .core.state import global_state
+        cfg = global_state().config
+        tp = cfg.tp if cfg is not None else 1
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    return tp
+
+
+def _resolve_pipeline_stages(pipeline_stages: Optional[int]) -> int:
+    """``None`` defers to the configured default
+    (``HOROVOD_PIPELINE_STAGES``)."""
+    if pipeline_stages is None:
+        from .core.state import global_state
+        cfg = global_state().config
+        pipeline_stages = cfg.pipeline_stages if cfg is not None else 1
+    pipeline_stages = int(pipeline_stages)
+    if pipeline_stages < 1:
+        raise ValueError(
+            f"pipeline_stages must be >= 1, got {pipeline_stages}")
+    return pipeline_stages
+
+
+def _resolve_model_axes(mesh: Mesh, tp: int, pipeline_stages: int
+                        ) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """``(data_axes, model_axes)`` of ``mesh`` for a step built with
+    ``tp``/``pipeline_stages``, with the declared extents validated
+    against the mesh shape.
+
+    The data axes are the gradient-exchange domain: every collective the
+    step emits on its own behalf (gradient allreduce, ZeRO arena
+    reduce-scatter/allgather, microbatch overlap, loss average) runs over
+    them ONLY, so TP's in-forward collectives on the ``model`` axis and
+    the pipeline's ``ppermute`` on ``pipe`` never mix with the DP leg.
+    On a pure-DP mesh the data axes are all axes -- bitwise-identical
+    wiring to the pre-3D builder.
+    """
+    from .parallel import mesh as _pmesh
+    names = tuple(mesh.axis_names)
+
+    def check(extent: int, axis: str, knob: str) -> None:
+        have = int(mesh.shape[axis]) if axis in names else 1
+        if extent > 1 and have != extent:
+            raise ValueError(
+                f"{knob}={extent} needs a mesh {axis!r} axis of extent "
+                f"{extent} (build_3d_mesh); mesh axes are "
+                f"{dict(mesh.shape)}")
+        if extent == 1 and have > 1:
+            raise ValueError(
+                f"mesh has a {axis!r} axis of extent {have} but the step "
+                f"was built with {knob}={extent}; pass {knob}={have}")
+
+    check(tp, _pmesh.MODEL_AXIS, "tp")
+    check(pipeline_stages, _pmesh.PIPE_AXIS, "pipeline_stages")
+    d_ax = _pmesh.data_axes(mesh)
+    m_ax = tuple(a for a in names if a not in d_ax)
+    return d_ax, m_ax
+
+
+def _check_model_parallel_exchange(optimizer, d_ax, m_ax) -> None:
+    """Reject optimizer wraps whose gradient exchange would reduce over
+    the model axes.  A :func:`~horovod_tpu.DistributedOptimizer` built
+    without explicit ``axes`` resolves them to ALL mesh axes at trace
+    time, which on a TP/pipeline mesh would sum gradients of DIFFERENT
+    parameter shards -- silently wrong math, so it fails the build."""
+    if not m_ax:
+        return
+    upd = getattr(optimizer, "update", None)
+    if not getattr(upd, "_hvd_allreduce", False):
+        return  # bare optimizer: the step emits no exchange for it
+    if _dist.is_ef_optimizer(optimizer):
+        raise NotImplementedError(
+            "error-feedback codecs (powersgd/topk) do not yet compose "
+            "with tp/pipeline_stages: the residual carry is planned from "
+            "the global parameter shapes, not the TP-local shards.  Use "
+            "fp16/bf16 (or per-leg ici:...,dcn:fp16) compression on the "
+            "DP leg instead")
+    ex = getattr(upd, "_hvd_exchange", None)
+    ax = ex.get("axes") if ex is not None else None
+    ax = tuple((ax,) if isinstance(ax, str) else ax) if ax is not None \
+        else None
+    if ax != tuple(d_ax):
+        raise ValueError(
+            f"DistributedOptimizer on a model-parallel mesh must be built "
+            f"with axes={tuple(d_ax)} (the data axes) so the gradient "
+            f"exchange never reduces over the model axes {tuple(m_ax)}; "
+            f"got axes={ax!r}")
+
+
 def _microbatch_unwrap(optimizer):
     """Decompose an optimizer for the microbatched exchange.
 
@@ -318,6 +450,10 @@ def make_train_step(
     zero_stage: Optional[int] = None,
     zero_compression=None,
     microbatches: Optional[int] = None,
+    tp: Optional[int] = None,
+    pipeline_stages: Optional[int] = None,
+    param_specs=None,
+    opt_state_specs=None,
 ) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
     """Build ``step(params, opt_state, batch) -> (params, opt_state, loss)``.
 
@@ -362,6 +498,25 @@ def make_train_step(
     per-example-mean loss, a local batch divisible by k, and is
     incompatible with ``zero_stage=1``, Adasum, fp8 compression, process
     sets, and ``backward_passes_per_step > 1``.
+
+    With ``tp=t > 1`` / ``pipeline_stages=s > 1`` (defaults from
+    ``HOROVOD_TP`` / ``HOROVOD_PIPELINE_STAGES``) the step runs 3-D
+    parallel over a :func:`~horovod_tpu.parallel.build_3d_mesh` mesh:
+    the gradient exchange, ZeRO-1 arena, microbatch overlap and loss
+    average all run over the mesh's DATA axes only (``("dcn", "data")``
+    when DCN splits the data axis -- the DP leg then rides the
+    hierarchical ICI x DCN exchange -- else ``("data",)``), while
+    ``loss_fn`` computes with TP collectives on the ``model`` axis
+    (:mod:`horovod_tpu.parallel.tp`) and pipeline ``ppermute`` on
+    ``pipe`` (:func:`~horovod_tpu.parallel.pipeline_apply`).  Pass
+    ``param_specs``: a pytree (prefix) of ``PartitionSpec``s placing the
+    stacked TP/stage parameter leaves, e.g. ``P("model")`` on a
+    ``[tp, d, f/tp]`` column-stacked kernel or ``P("pipe")`` on
+    ``[s, ...]`` stage-stacked leaves (each leaf arrives in ``loss_fn``
+    with those leading axes of LOCAL extent 1).  A
+    :func:`~horovod_tpu.DistributedOptimizer` must then be built with
+    ``axes=<data axes>``; ``zero_stage=1`` needs ``zero_init(...,
+    param_specs=...)`` so each device's arena holds its own TP shard.
     """
     if aux_mode not in ("stacked", "averaged"):
         raise ValueError(f"unknown aux_mode {aux_mode!r}")
@@ -375,30 +530,38 @@ def make_train_step(
                 "overlap it via HOROVOD_EXCHANGE_CHUNK_MB instead)")
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
-    axes = tuple(mesh.axis_names)
+    tp = _resolve_tp(tp)
+    pipeline_stages = _resolve_pipeline_stages(pipeline_stages)
+    axes, model_ax = _resolve_model_axes(mesh, tp, pipeline_stages)
+    _check_model_parallel_exchange(optimizer, axes, model_ax)
     guard_on, guard_limit = _resolve_guard()
     if k_micro > 1:
         inner, exchange = _microbatch_unwrap(optimizer)
         local_step = _build_microbatch_local_step(
             loss_fn, inner, exchange, axes, loss_has_aux, aux_mode,
             with_frozen, k_micro, guard=guard_on,
-            guard_norm_limit=guard_limit)
+            guard_norm_limit=guard_limit,
+            guard_axes=tuple(mesh.axis_names))
     else:
         local_step = _build_local_step(loss_fn, optimizer, axes,
                                        loss_has_aux, aux_mode, with_frozen,
                                        zero_stage, zero_compression,
                                        guard=guard_on,
-                                       guard_norm_limit=guard_limit)
+                                       guard_norm_limit=guard_limit,
+                                       guard_axes=tuple(mesh.axis_names))
 
     aux_spec = () if not loss_has_aux else \
         ((P(),) if aux_mode == "averaged" else (P(axes),))
     guard_spec = (P(),) if guard_on else ()
     frozen_spec = (P(),) if with_frozen else ()
-    opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
+    p_spec = param_specs if param_specs is not None else P()
+    opt_spec = _opt_state_spec(optimizer, zero_stage,
+                               tuple(mesh.axis_names),
+                               override=opt_state_specs)
     shard = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), opt_spec, P(axes)) + frozen_spec,
-        out_specs=(P(), opt_spec, P()) + aux_spec + guard_spec,
+        in_specs=(p_spec, opt_spec, P(axes)) + frozen_spec,
+        out_specs=(p_spec, opt_spec, P()) + aux_spec + guard_spec,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
@@ -407,14 +570,20 @@ def make_train_step(
             "zero_compression": zero_compression,
             "microbatches": k_micro,
             "guard": guard_on,
-            "world": int(mesh.devices.size)}
+            "tp": tp,
+            "pipeline_stages": pipeline_stages,
+            "data_mesh": tuple(int(mesh.shape[a]) for a in axes),
+            "mesh_shape": tuple((a, int(mesh.shape[a]))
+                                for a in mesh.axis_names),
+            "param_specs": param_specs,
+            "world": int(math.prod(mesh.shape[a] for a in axes))}
     step = _maybe_tuned(shard, donate_argnums, loss_index=2, meta=meta)
     return _GuardedStep(step, meta) if guard_on else step
 
 
 def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
                       with_frozen, zero_stage, zero_compression,
-                      guard=False, guard_norm_limit=0.0):
+                      guard=False, guard_norm_limit=0.0, guard_axes=None):
     """The per-device step body shared by :func:`make_train_step` (one
     shard_map call) and :func:`make_train_loop` (the ``lax.scan`` body).
     Sharing the exact closure is what makes the k-step loop bitwise
@@ -424,7 +593,14 @@ def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
     count and squared norm (one extra f32[2] psum, before any exchange or
     update) and a poisoned step selects the OLD params/opt-state carry
     wholesale; the step then emits a trailing replicated ``f32[3]``
-    ``[nonfinite, grad_norm, skipped]`` vector for the host policy."""
+    ``[nonfinite, grad_norm, skipped]`` vector for the host policy.
+
+    ``guard_axes`` (default ``axes``) is the screen's psum domain: on a
+    model-parallel mesh it spans ALL mesh axes -- TP shards partition the
+    gradient, so only the full-mesh sum gives every rank the same verdict
+    (a data-axes-only sum would diverge across TP ranks and fork the
+    carry)."""
+    g_axes = tuple(guard_axes) if guard_axes is not None else axes
 
     def local_step(params, opt_state, batch, *frozen):
         lf = (lambda p, b: loss_fn(p, frozen[0], b)) if with_frozen \
@@ -437,7 +613,8 @@ def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
             aux = None
         if guard:
             old_params, old_opt = params, opt_state
-            gvec = _ops.allreduce(_guard_screen_vec(grads), Sum, axes=axes)
+            gvec = _ops.allreduce(_guard_screen_vec(grads), Sum,
+                                  axes=g_axes)
         if zero_stage:
             params, opt_state = _zero.zero_apply(
                 optimizer, grads, opt_state, params, axes=axes,
@@ -582,7 +759,8 @@ def _split_microbatches(tree, k):
 
 def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
                                  loss_has_aux, aux_mode, with_frozen, k,
-                                 guard=False, guard_norm_limit=0.0):
+                                 guard=False, guard_norm_limit=0.0,
+                                 guard_axes=None):
     """Per-device step body for ``microbatches=k > 1``: an UNROLLED loop
     over k sub-batches whose trace interleaves each microbatch's bucket
     reduce-scatters between backward segments (the HLO-structure the
@@ -600,6 +778,7 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
     ef = exchange is not None and _is_ef_exchange(exchange)
     accumulate, finalize = _microbatch_grad_pipe(
         None if ef else exchange, axes)
+    g_axes = tuple(guard_axes) if guard_axes is not None else axes
 
     def local_step(params, opt_state, batch, *frozen):
         lf = (lambda p, b: loss_fn(p, frozen[0], b)) if with_frozen \
@@ -634,7 +813,7 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
             # _EFState on the ef path), structure-matched to the new one.
             old_params, old_opt = params, opt_state
             gvec = _ops.allreduce(_guard_screen_vec(reduced), Sum,
-                                  axes=axes)
+                                  axes=g_axes)
         if ef:
             reduced, new_res = _dist.ef_exchange(
                 reduced, residuals, compression=exchange["compression"],
@@ -677,7 +856,8 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
 
 def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
                                       axes, k, guard=False,
-                                      guard_norm_limit=0.0):
+                                      guard_norm_limit=0.0,
+                                      guard_axes=None):
     """Flax counterpart of :func:`_build_microbatch_local_step`.
 
     BatchNorm note: batch statistics CHAIN through the k microbatches
@@ -694,6 +874,7 @@ def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
     ef = exchange is not None and _is_ef_exchange(exchange)
     accumulate, finalize = _microbatch_grad_pipe(
         None if ef else exchange, axes)
+    g_axes = tuple(guard_axes) if guard_axes is not None else axes
 
     def local_step(params, batch_stats, opt_state, batch):
         x, y = batch
@@ -731,7 +912,7 @@ def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
         if guard:
             old_params, old_opt = params, opt_state
             gvec = _ops.allreduce(_guard_screen_vec(reduced), Sum,
-                                  axes=axes)
+                                  axes=g_axes)
         if ef:
             reduced, new_res = _dist.ef_exchange(
                 reduced, residuals, compression=exchange["compression"],
@@ -773,6 +954,10 @@ def make_train_loop(
     zero_stage: Optional[int] = None,
     zero_compression=None,
     microbatches: Optional[int] = None,
+    tp: Optional[int] = None,
+    pipeline_stages: Optional[int] = None,
+    param_specs=None,
+    opt_state_specs=None,
 ) -> Callable[[Any, Any, Any], Tuple[Any, Any, jnp.ndarray]]:
     """Steps-per-execution runner: k train steps as ONE executable.
 
@@ -811,7 +996,10 @@ def make_train_loop(
                 "overlap it via HOROVOD_EXCHANGE_CHUNK_MB instead)")
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
-    axes = tuple(mesh.axis_names)
+    tp = _resolve_tp(tp)
+    pipeline_stages = _resolve_pipeline_stages(pipeline_stages)
+    axes, model_ax = _resolve_model_axes(mesh, tp, pipeline_stages)
+    _check_model_parallel_exchange(optimizer, axes, model_ax)
     k = _resolve_steps(steps_per_execution)
     guard_on, guard_limit = _resolve_guard()
     if k_micro > 1:
@@ -819,13 +1007,15 @@ def make_train_loop(
         local_step = _build_microbatch_local_step(
             loss_fn, inner, exchange, axes, loss_has_aux, aux_mode,
             with_frozen, k_micro, guard=guard_on,
-            guard_norm_limit=guard_limit)
+            guard_norm_limit=guard_limit,
+            guard_axes=tuple(mesh.axis_names))
     else:
         local_step = _build_local_step(loss_fn, optimizer, axes,
                                        loss_has_aux, aux_mode, with_frozen,
                                        zero_stage, zero_compression,
                                        guard=guard_on,
-                                       guard_norm_limit=guard_limit)
+                                       guard_norm_limit=guard_limit,
+                                       guard_axes=tuple(mesh.axis_names))
 
     def local_loop(params, opt_state, batches, *frozen):
         def body(carry, batch):
@@ -844,11 +1034,14 @@ def make_train_loop(
         ((P(),) if aux_mode == "averaged" else (P(None, axes),))
     guard_spec = (P(),) if guard_on else ()
     frozen_spec = (P(),) if with_frozen else ()
-    opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
+    p_spec = param_specs if param_specs is not None else P()
+    opt_spec = _opt_state_spec(optimizer, zero_stage,
+                               tuple(mesh.axis_names),
+                               override=opt_state_specs)
     shard = jax.shard_map(
         local_loop, mesh=mesh,
-        in_specs=(P(), opt_spec, P(None, axes)) + frozen_spec,
-        out_specs=(P(), opt_spec, P()) + aux_spec + guard_spec,
+        in_specs=(p_spec, opt_spec, P(None, axes)) + frozen_spec,
+        out_specs=(p_spec, opt_spec, P()) + aux_spec + guard_spec,
         check_vma=False)
     donate_argnums = (0, 1) if donate else ()
 
@@ -857,7 +1050,13 @@ def make_train_loop(
             "zero_compression": zero_compression,
             "microbatches": k_micro,
             "guard": guard_on,
-            "world": int(mesh.devices.size)}
+            "tp": tp,
+            "pipeline_stages": pipeline_stages,
+            "data_mesh": tuple(int(mesh.shape[a]) for a in axes),
+            "mesh_shape": tuple((a, int(mesh.shape[a]))
+                                for a in mesh.axis_names),
+            "param_specs": param_specs,
+            "world": int(math.prod(mesh.shape[a] for a in axes))}
     step = _maybe_tuned(shard, donate_argnums, loss_index=2, steps=k,
                         meta=meta)
     return _GuardedStep(step, meta) if guard_on else step
@@ -1094,6 +1293,10 @@ def make_flax_train_step(
     zero_stage: Optional[int] = None,
     zero_compression=None,
     microbatches: Optional[int] = None,
+    tp: Optional[int] = None,
+    pipeline_stages: Optional[int] = None,
+    param_specs=None,
+    opt_state_specs=None,
 ):
     """Data-parallel train step for flax modules with mutable batch stats.
 
@@ -1112,6 +1315,10 @@ def make_flax_train_step(
     backward-overlap exchange as in :func:`make_train_step`.  BatchNorm
     statistics chain through the k sub-batches (see
     :func:`_build_flax_microbatch_local_step` for the semantics).
+
+    ``tp``/``pipeline_stages``/``param_specs`` behave as in
+    :func:`make_train_step` (3-D parallelism over a ``build_3d_mesh``
+    mesh; batch stats stay replicated).
     """
     zero_stage = _resolve_zero_stage(zero_stage)
     k_micro = _resolve_microbatches(microbatches)
@@ -1123,25 +1330,35 @@ def make_flax_train_step(
                 "overlap it via HOROVOD_EXCHANGE_CHUNK_MB instead)")
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
-    axes = tuple(mesh.axis_names)
+    tp = _resolve_tp(tp)
+    pipeline_stages = _resolve_pipeline_stages(pipeline_stages)
+    axes, model_ax = _resolve_model_axes(mesh, tp, pipeline_stages)
+    _check_model_parallel_exchange(optimizer, axes, model_ax)
     guard_on, guard_limit = _resolve_guard()
     if k_micro > 1:
         inner, exchange = _microbatch_unwrap(optimizer)
         local_step = _build_flax_microbatch_local_step(
             apply_fn, inner, exchange, loss_fn, axes, k_micro,
-            guard=guard_on, guard_norm_limit=guard_limit)
+            guard=guard_on, guard_norm_limit=guard_limit,
+            guard_axes=tuple(mesh.axis_names))
     else:
         local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn,
                                             axes, zero_stage,
                                             zero_compression,
                                             guard=guard_on,
-                                            guard_norm_limit=guard_limit)
+                                            guard_norm_limit=guard_limit,
+                                            guard_axes=tuple(
+                                                mesh.axis_names))
 
     guard_spec = (P(),) if guard_on else ()
-    opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
+    p_spec = param_specs if param_specs is not None else P()
+    opt_spec = _opt_state_spec(optimizer, zero_stage,
+                               tuple(mesh.axis_names),
+                               override=opt_state_specs)
     shard = jax.shard_map(local_step, mesh=mesh,
-                          in_specs=(P(), P(), opt_spec, P(axes)),
-                          out_specs=(P(), P(), opt_spec, P()) + guard_spec,
+                          in_specs=(p_spec, P(), opt_spec, P(axes)),
+                          out_specs=(p_spec, P(), opt_spec, P())
+                          + guard_spec,
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
     # Autotune applies here too (HOROVOD_AUTOTUNE=1): loss is element 3.
@@ -1150,14 +1367,20 @@ def make_flax_train_step(
             "zero_compression": zero_compression,
             "microbatches": k_micro,
             "guard": guard_on,
-            "world": int(mesh.devices.size)}
+            "tp": tp,
+            "pipeline_stages": pipeline_stages,
+            "data_mesh": tuple(int(mesh.shape[a]) for a in axes),
+            "mesh_shape": tuple((a, int(mesh.shape[a]))
+                                for a in mesh.axis_names),
+            "param_specs": param_specs,
+            "world": int(math.prod(mesh.shape[a] for a in axes))}
     step = _maybe_tuned(shard, donate_argnums, loss_index=3, meta=meta)
     return _GuardedStep(step, meta) if guard_on else step
 
 
 def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
                            zero_compression, guard=False,
-                           guard_norm_limit=0.0):
+                           guard_norm_limit=0.0, guard_axes=None):
     """Per-device flax step body shared by :func:`make_flax_train_step`
     and :func:`make_flax_train_loop` (bitwise parity, as with
     :func:`_build_local_step`).  The guard additionally pins the OLD
@@ -1166,6 +1389,7 @@ def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
     if loss_fn is None:
         def loss_fn(logits, y):
             return _softmax_xent(logits, y)
+    g_axes = tuple(guard_axes) if guard_axes is not None else axes
 
     def local_step(params, batch_stats, opt_state, batch):
         x, y = batch
@@ -1183,7 +1407,8 @@ def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
         (loss, new_stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
         if guard:
             old_params, old_opt = params, opt_state
-            gvec = _ops.allreduce(_guard_screen_vec(grads), Sum, axes=axes)
+            gvec = _ops.allreduce(_guard_screen_vec(grads), Sum,
+                                  axes=g_axes)
         if zero_stage:
             params, opt_state = _zero.zero_apply(
                 optimizer, grads, opt_state, params, axes=axes,
@@ -1217,6 +1442,10 @@ def make_flax_train_loop(
     zero_stage: Optional[int] = None,
     zero_compression=None,
     microbatches: Optional[int] = None,
+    tp: Optional[int] = None,
+    pipeline_stages: Optional[int] = None,
+    param_specs=None,
+    opt_state_specs=None,
 ):
     """Steps-per-execution runner for flax modules with batch stats.
 
@@ -1241,20 +1470,26 @@ def make_flax_train_loop(
                 "overlap it via HOROVOD_EXCHANGE_CHUNK_MB instead)")
         _zero._reject_distributed(optimizer)
     mesh = mesh or _basics.mesh()
-    axes = tuple(mesh.axis_names)
+    tp = _resolve_tp(tp)
+    pipeline_stages = _resolve_pipeline_stages(pipeline_stages)
+    axes, model_ax = _resolve_model_axes(mesh, tp, pipeline_stages)
+    _check_model_parallel_exchange(optimizer, axes, model_ax)
     k = _resolve_steps(steps_per_execution)
     guard_on, guard_limit = _resolve_guard()
     if k_micro > 1:
         inner, exchange = _microbatch_unwrap(optimizer)
         local_step = _build_flax_microbatch_local_step(
             apply_fn, inner, exchange, loss_fn, axes, k_micro,
-            guard=guard_on, guard_norm_limit=guard_limit)
+            guard=guard_on, guard_norm_limit=guard_limit,
+            guard_axes=tuple(mesh.axis_names))
     else:
         local_step = _build_flax_local_step(apply_fn, optimizer, loss_fn,
                                             axes, zero_stage,
                                             zero_compression,
                                             guard=guard_on,
-                                            guard_norm_limit=guard_limit)
+                                            guard_norm_limit=guard_limit,
+                                            guard_axes=tuple(
+                                                mesh.axis_names))
 
     def local_loop(params, batch_stats, opt_state, batches):
         def body(carry, batch):
@@ -1266,10 +1501,14 @@ def make_flax_train_loop(
         return (params, batch_stats, opt_state) + tuple(ys)
 
     guard_spec = (P(),) if guard_on else ()
-    opt_spec = _opt_state_spec(optimizer, zero_stage, axes)
+    p_spec = param_specs if param_specs is not None else P()
+    opt_spec = _opt_state_spec(optimizer, zero_stage,
+                               tuple(mesh.axis_names),
+                               override=opt_state_specs)
     shard = jax.shard_map(local_loop, mesh=mesh,
-                          in_specs=(P(), P(), opt_spec, P(None, axes)),
-                          out_specs=(P(), P(), opt_spec, P()) + guard_spec,
+                          in_specs=(p_spec, P(), opt_spec, P(None, axes)),
+                          out_specs=(p_spec, P(), opt_spec, P())
+                          + guard_spec,
                           check_vma=False)
     donate_argnums = (0, 1, 2) if donate else ()
     meta = {"optimizer": optimizer,
@@ -1277,7 +1516,13 @@ def make_flax_train_loop(
             "zero_compression": zero_compression,
             "microbatches": k_micro,
             "guard": guard_on,
-            "world": int(mesh.devices.size)}
+            "tp": tp,
+            "pipeline_stages": pipeline_stages,
+            "data_mesh": tuple(int(mesh.shape[a]) for a in axes),
+            "mesh_shape": tuple((a, int(mesh.shape[a]))
+                                for a in mesh.axis_names),
+            "param_specs": param_specs,
+            "world": int(math.prod(mesh.shape[a] for a in axes))}
     step = _maybe_tuned(shard, donate_argnums, loss_index=3, steps=k,
                         meta=meta)
     return _GuardedStep(step, meta) if guard_on else step

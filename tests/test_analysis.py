@@ -431,3 +431,57 @@ def test_pallas_lint_clean_on_repo_tree():
     from horovod_tpu.analysis.lints.pallas_tests import \
         PallasInterpretTestRule
     assert not list(PallasInterpretTestRule().run(LintContext()))
+
+
+def test_parallel3d_configs_audit_green(hvd):
+    """The 3-D trio (TP, TP+ZeRO-1, TP+pipeline+micro) audits at zero
+    errors: the DP leg priced over LOCAL leaves and data axes only, the
+    declared TP/pipeline activation legs matched exactly."""
+    from horovod_tpu.analysis.trace_audit import PARALLEL3D_CONFIGS
+    reports = audit_standard_configs(PARALLEL3D_CONFIGS)
+    assert set(reports) == {"tp2", "tp2_zero1", "tp2_pipe_micro"}
+    for name, report in reports.items():
+        assert report.ok(), report.render()
+        s = report.summary
+        assert s["unaccounted_ops"] == 0 and s["missing_ops"] == 0, \
+            report.render()
+        assert s["matched_ops"] == s["expected_ops"] > 0
+
+
+def test_parallel3d_expected_leg_counts(hvd):
+    """Documented 3-D exchange shapes: tp2 = 3 DP buckets (over local
+    shards) + 2 TP row psums; tp2_zero1 = per-axis RS+AG (4 legs) + 2 TP
+    psums; tp2_pipe_micro = (2RS+AG) x 2 buckets + per-microbatch
+    (2 ppermute + 2 stage-select + 2 TP) x 2."""
+    from horovod_tpu.analysis.trace_audit import PARALLEL3D_CONFIGS
+    reports = audit_standard_configs(PARALLEL3D_CONFIGS)
+    assert reports["tp2"].summary["expected_ops"] == 5
+    assert reports["tp2_zero1"].summary["expected_ops"] == 6
+    assert reports["tp2_pipe_micro"].summary["expected_ops"] == 18
+    tp2 = reports["tp2"]
+    # The DP buckets plan over the LOCAL (TP-sharded) leaves: fp16 wire
+    # over 16 + 256 + 256 elements, and the TP activation legs ride at
+    # f32 (2 rows x d_model=16 per loss call, forward + backward).
+    sigs = sorted(op.sig() for op in tp2.expected.ops)
+    assert sigs == [("psum", "float16", 16), ("psum", "float16", 256),
+                    ("psum", "float16", 256), ("psum", "float32", 32),
+                    ("psum", "float32", 32)]
+
+
+def test_expected_3d_declines_without_specs_or_contract(hvd):
+    """A model-parallel meta without param_specs (or without the
+    activation contract) is declined, not guessed."""
+    from horovod_tpu.analysis.stepmodel import expected_exchange
+    from horovod_tpu.analysis.trace_audit import (PARALLEL3D_CONFIGS,
+                                                  build_standard_config)
+    step, args, _, _ = build_standard_config(PARALLEL3D_CONFIGS[0])
+    meta = dict(step._meta)
+    no_specs = dict(meta, param_specs=None)
+    exp = expected_exchange(args[0], no_specs)
+    assert not exp.supported
+    assert any("param_specs" in n for n in exp.notes)
+    no_contract = dict(meta)
+    no_contract.pop("model_parallel")
+    exp = expected_exchange(args[0], no_contract)
+    assert not exp.supported
+    assert any("model_parallel" in n for n in exp.notes)

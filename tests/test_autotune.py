@@ -236,7 +236,7 @@ def test_autotuner_steps_axis_is_opt_in_and_build_time(monkeypatch):
     monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_EXEC", "1")
     t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
     assert {cfg[6] for cfg in t2.grid} == {1, 4, 16}
-    assert len(t2.trace_key()) == 6  # thr, hier, comp, zero, chunk, hc -- no k
+    assert len(t2.trace_key()) == 7  # thr,hier,comp,zero,chunk,hc,moe -- no k
     for want in (1, 4, 16):
         for i, cfg in enumerate(t2.grid):
             if cfg[6] == want:
@@ -256,7 +256,7 @@ def test_autotuner_pr1_log_format_warm_starts(tmp_path):
         "zero,score_bytes_per_s\n"
         f"{thr},{Config().cycle_time},0,0,0,456.0\n")
     t = Autotuner(cfg, steps_per_sample=1)
-    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 0, 456.0) in [
+    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 0, 0, 456.0) in [
         tuple(s) for s in t._samples]
 
 
@@ -451,7 +451,7 @@ def test_autotuner_old_log_format_warm_starts(tmp_path):
     log.write_text("fusion_threshold_bytes,cycle_time_ms,score\n"
                    f"{thr},{Config().cycle_time},123.0\n")
     t = Autotuner(cfg, steps_per_sample=1)
-    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 0, 123.0) in [
+    assert (thr, Config().cycle_time, 0, 0, 0, 0, 1, 1, 0, 0, 123.0) in [
         tuple(s) for s in t._samples]
 
 
@@ -472,7 +472,7 @@ def test_autotuner_microbatch_axis_is_opt_in_and_build_time(monkeypatch):
     monkeypatch.setenv("HOROVOD_AUTOTUNE_MICROBATCH", "1")
     t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
     assert {cfg[7] for cfg in t2.grid} == {1, 2, 4}
-    assert len(t2.trace_key()) == 6  # thr, hier, comp, zero, chunk, hc only
+    assert len(t2.trace_key()) == 7  # no microbatch member
     for want in (1, 2, 4):
         for i, cfg in enumerate(t2.grid):
             if cfg[7] == want:
@@ -506,7 +506,7 @@ def test_autotuner_warm_start_skips_unusable_rows(tmp_path):
     with pytest.warns(RuntimeWarning, match="skipped 4 unusable row"):
         t = Autotuner(cfg, steps_per_sample=1)
     assert t.warm_start_skipped == 4
-    assert (thr, ct, 0, 0, 0, 0, 1, 1, 0, 123.0) in [
+    assert (thr, ct, 0, 0, 0, 0, 1, 1, 0, 0, 123.0) in [
         tuple(s) for s in t._samples]
 
 
@@ -521,3 +521,52 @@ def test_autotuner_warm_start_clean_log_no_warning(tmp_path):
         _w.simplefilter("error", RuntimeWarning)
         t = Autotuner(cfg, steps_per_sample=1)
     assert t.warm_start_skipped == 0
+
+
+def test_autotuner_moe_axis_is_opt_in_and_trace_time(monkeypatch):
+    """HOROVOD_AUTOTUNE_MOE=1 opens the MoE all_to_all codec axis; it is
+    TRACE-time (the wire cast is part of the traced step) so it rides
+    the trace key, unlike the build-time microbatch/steps axes."""
+    t = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert {cfg[9] for cfg in t.grid} == {0}
+    assert t.moe_codec() == "none"
+    assert not t.tunes_moe
+
+    # Without the opt-in the axis pins to the configured codec.
+    t1 = Autotuner(Config(autotune=True, moe_compression="bf16"),
+                   steps_per_sample=1)
+    assert {cfg[9] for cfg in t1.grid} == {1}
+    assert t1.moe_codec() == "bf16"
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_MOE", "1")
+    t2 = Autotuner(Config(autotune=True), steps_per_sample=1)
+    assert t2.tunes_moe
+    assert {cfg[9] for cfg in t2.grid} == {0, 1, 2}
+    for want, name in ((0, "none"), (1, "bf16"), (2, "fp16")):
+        for i, cfg in enumerate(t2.grid):
+            if cfg[9] == want:
+                t2._idx = i
+                break
+        assert t2.moe_codec() == name
+        assert t2.trace_key()[6] == want  # retrace per MoE codec
+
+
+def test_autotuner_pr11_log_format_warm_starts(tmp_path):
+    """10-column logs from before the MoE-codec axis load onto the
+    moe=0 plane (positional compat, no skip and no crash)."""
+    log = tmp_path / "pr11.csv"
+    cfg = Config(autotune=True, autotune_log=str(log))
+    thr = 32 * 1024 * 1024
+    ct = Config().cycle_time
+    log.write_text(
+        "fusion_threshold_bytes,cycle_time_ms,hierarchical,compression,"
+        "zero,exchange_chunk_bytes,steps_per_exec,microbatches,"
+        "hier_dcn_codec,score_bytes_per_s\n"
+        f"{thr},{ct},0,0,0,0,1,1,0,321.0\n")
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)
+        t = Autotuner(cfg, steps_per_sample=1)
+    assert t.warm_start_skipped == 0
+    assert (thr, ct, 0, 0, 0, 0, 1, 1, 0, 0, 321.0) in [
+        tuple(s) for s in t._samples]

@@ -2238,3 +2238,138 @@ def test_bench_prefix_mode_flags(monkeypatch):
     monkeypatch.delenv("BENCH_PREFIX_REQUESTS")
     b = importlib.reload(bench)
     assert not b.PREFIX_BENCH
+
+
+# ---------------------------------------------------------------------------
+# 3D-parallelism entries (PR 18)
+# ---------------------------------------------------------------------------
+
+def scan_3d_entries(bench_dir):
+    """Return [(path, why), ...] for malformed 3D-parallelism entries.
+
+    A 3D entry records the bert-3d bench round: the fp16 DP gradient
+    leg of a DP x TP train step on two virtual ``build_3d_mesh`` shapes
+    sharing the TP extent.  The leg must be positive, byte-equal to the
+    ``explain_plan`` closed form over the local (tp-sharded) leaves,
+    invariant across the mesh shapes, confined to the data axes (a
+    model/pipe name in a gradient psum means the exchange leaked into
+    the model-parallel domain), accompanied by at least one TP
+    activation psum, and vs_baseline must be null (a wire-shape round
+    on the CPU mesh has no throughput peer)."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            ts = parsed.get("threed")
+            if not ts:
+                continue
+            leg = ts.get("dp_leg_bytes")
+            if not (isinstance(leg, int) and leg > 0):
+                bad.append((path, f"dp_leg_bytes must be a positive "
+                                  f"int, got {leg!r}"))
+            if not ts.get("dp_leg_matches_plan"):
+                bad.append((path, "traced DP leg diverged from the "
+                                  "explain_plan closed form over the "
+                                  "local leaves"))
+            if not ts.get("mesh_invariant"):
+                bad.append((path, "DP leg bytes varied across meshes "
+                                  "sharing the TP extent"))
+            axes = ts.get("dp_axes")
+            if not (isinstance(axes, list) and axes
+                    and all(a in ("dcn", "data") for a in axes)):
+                bad.append((path, f"DP psums must span only the data "
+                                  f"axes, got {axes!r}"))
+            tp_n = ts.get("tp_psum_count")
+            if not (isinstance(tp_n, int) and tp_n >= 1):
+                bad.append((path, f"tp_psum_count must be an int >= 1, "
+                                  f"got {tp_n!r}: a TP step with no "
+                                  f"model-axis psum sharded nothing"))
+            tp = ts.get("tp")
+            if not isinstance(tp, int) or tp < 2:
+                bad.append((path, f"tp extent must be an int >= 2, "
+                                  f"got {tp!r}"))
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "3D entries must carry a null "
+                                  "vs_baseline on the CPU mesh"))
+    return bad
+
+
+def test_committed_3d_entries_well_formed():
+    assert scan_3d_entries(REPO) == []
+
+
+def test_committed_3d_round_exists_and_matches_plan():
+    """Acceptance gate: a committed bench round must record the 3D
+    exchange with a plan-matched, mesh-invariant DP gradient leg riding
+    only the data axes."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            ts = (entry.get("parsed") or {}).get("threed")
+            if ts:
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries a threed block"
+    for path, parsed in found:
+        ts = parsed["threed"]
+        assert parsed["metric"] == "threed_dp_leg_mib", path
+        assert ts["dp_leg_matches_plan"] and ts["mesh_invariant"], \
+            (path, ts)
+        assert ts["dp_leg_bytes"] > 0 and ts["tp_psum_count"] >= 1, \
+            (path, ts)
+        assert len(ts["ns"]) >= 2, (path, ts["ns"])
+
+
+def _write_3d(tmp_path, name, ts, vs_baseline=None):
+    parsed = {"metric": "threed_dp_leg_mib", "value": 0.13,
+              "unit": "MiB", "vs_baseline": vs_baseline,
+              "config": "bert_tiny_3d_dcn2_tp2_fp16dp",
+              "baseline_config": "batch256_s2d_bf16", "threed": ts}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 18, "cmd": "bench_scaling.py --models bert-3d", "rc": 0,
+         "tail": "", "parsed": parsed}))
+
+
+def _good_3d_block():
+    return {"tp": 2, "ns": [8, 16],
+            "meshes": {"8": [2, 2, 2], "16": [2, 4, 2]},
+            "dp_leg_bytes": 134788, "dp_buckets": 1,
+            "dp_axes": ["data", "dcn"], "tp_psum_count": 8,
+            "tp_psum_bytes": 262144,
+            "dp_leg_matches_plan": True, "mesh_invariant": True}
+
+
+def test_3d_guard_accepts_good_entry(tmp_path):
+    _write_3d(tmp_path, "BENCH_r75.json", _good_3d_block())
+    assert scan_3d_entries(str(tmp_path)) == []
+    # ...and the >=0.98 gate ignores it (vs_baseline null).
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_3d_guard_trips_on_bad_entries(tmp_path):
+    _write_3d(tmp_path, "BENCH_r76.json",
+              dict(_good_3d_block(), dp_leg_bytes=0,
+                   dp_leg_matches_plan=False, mesh_invariant=False))
+    _write_3d(tmp_path, "BENCH_r77.json",
+              dict(_good_3d_block(),
+                   dp_axes=["data", "model"],   # exchange leaked into TP
+                   tp_psum_count=0, tp=1))
+    _write_3d(tmp_path, "BENCH_r78.json", _good_3d_block(),
+              vs_baseline=1.0)                  # must be null on CPU
+    why = " ".join(w for _, w in scan_3d_entries(str(tmp_path)))
+    assert "dp_leg_bytes" in why
+    assert "diverged from the explain_plan" in why
+    assert "varied across meshes" in why
+    assert "only the data axes" in why
+    assert "sharded nothing" in why
+    assert "tp extent" in why
+    assert "vs_baseline" in why
