@@ -191,6 +191,22 @@ PREFIX_BENCH = _env_on("BENCH_PREFIX")
 PREFIX_REQUESTS = int(os.environ.get("BENCH_PREFIX_REQUESTS", "28"))
 PREFIX_RATE = float(os.environ.get("BENCH_PREFIX_RATE", "6"))
 SERVING_R15_TOKENS_PER_S = 975.11
+# BENCH_PLANIR=1 runs the round-19 exchange-plan IR drill: the plans a
+# real step's consumers make (reverse-planned DP hier buckets, the
+# ZeRO-1 arena, the SDC guard screen, a serving decode step, one MoE
+# layer) are built host-side for a virtual 2x32 contended-DCN mesh
+# (2 DCN slices x 32 ICI chips, world 64), then the whole-step leg
+# list is issued A/B -- HOROVOD_EXCHANGE_SCHEDULE=bandwidth order vs
+# pure program order -- through controller.fusion.simulate_issue's
+# two-link contention model on the v5e ChipSpec.  Gates: (1) the two
+# orders carry a BYTE-IDENTICAL wire payload (scheduling moves WHEN
+# legs issue, never WHAT goes on the wire), (2) zero warm replans (a
+# repeat step resolves every plan from the shared cache -- the
+# plan-once claim), (3) the scheduled order's modeled dispatch-gap
+# fraction strictly below program order's with makespan no worse.
+# Purely a host-side model -> vs_baseline null; the committed entry is
+# gated by tests/test_bench_guard.py::scan_planir_entries.
+PLANIR_BENCH = _env_on("BENCH_PLANIR")
 
 
 def _config() -> str:
@@ -947,6 +963,136 @@ def _main_autoscale():
     os._exit(0)
 
 
+def _main_planir():
+    """BENCH_PLANIR=1: exchange-plan IR + overlap-aware scheduler A/B."""
+    import dataclasses
+
+    from horovod_tpu.controller import fusion as _fusion
+    from horovod_tpu.utils.scaling import V5E
+
+    n_dcn, n_ici = 2, 32
+    world = n_dcn * n_ici
+    # Reverse-planned DP buckets (backward readies the LAST layer's
+    # bucket first): f32 element counts of a transformer-ish tail.
+    bucket_elems = [25_000_000, 8_000_000, 2_000_000, 512_000]
+    zero_elems = [4_000_000, 1_000_000]
+
+    def step_legs():
+        """Plan every consumer's legs for one step; returns the program-
+        order leg list with process-wide bucket ids (chains)."""
+        legs, bucket = [], 0
+        for size in reversed(bucket_elems):
+            plan = _fusion.plan_exchange(
+                "hier", size=size, dtype="float32", n_dcn=n_dcn,
+                n_ici=n_ici, compression="ici:none,dcn:fp16")
+            legs += [dataclasses.replace(l, bucket=bucket)
+                     for l in plan.legs]
+            bucket += 1
+        zbufs = []
+        for size in zero_elems:
+            padded = size + (-size) % world
+            zbufs.append(("float32", size, padded, padded // world))
+        zplan = _fusion.plan_exchange(
+            "zero", buffers=tuple(zbufs), world=world, compression=None,
+            axes_shape=None, axes=(), use_rs=True)
+        legs += [dataclasses.replace(l, bucket=bucket + l.bucket)
+                 for l in zplan.legs]
+        bucket += len(zero_elems)
+        splan = _fusion.plan_exchange(
+            "serving", kind="serving_decode", layers=4, slots=8, width=1,
+            d_model=1024, dtype="bfloat16", axis="tp")
+        legs += [dataclasses.replace(l, bucket=bucket + l.bucket)
+                 for l in splan.legs]
+        bucket += 4
+        mplan = _fusion.plan_exchange(
+            "moe", n_experts=16, capacity=128, d_model=1024,
+            compression="bf16", axis="ep")
+        legs += [dataclasses.replace(l, bucket=bucket)
+                 for l in mplan.legs]
+        bucket += 1
+        legs += [dataclasses.replace(
+            _fusion.plan_exchange("guard").legs[0], bucket=bucket)]
+        return legs
+
+    # Replan accounting: a cold step plans every exchange once; a warm
+    # (repeat) step must resolve ALL of them from the shared cache.
+    _fusion.clear_plan_cache()
+    program = step_legs()
+    cold = _fusion.plan_cache_stats()
+    warm_legs = step_legs()
+    warm = _fusion.plan_cache_stats()
+    warm_replans = warm["misses"] - cold["misses"]
+    warm_hits = warm["hits"] - cold["hits"]
+    assert warm_legs == program
+
+    scheduled = _fusion.schedule_legs(program, mode="bandwidth",
+                                      chip=V5E)
+
+    def payload(legs):
+        return sorted((l.tag, int(l.bucket), l.collective, l.wire_dtype,
+                       int(l.nbytes)) for l in legs)
+
+    byte_identical = (payload(scheduled) == payload(program)
+                      and sum(l.nbytes for l in scheduled)
+                      == sum(l.nbytes for l in program))
+    sim_prog = _fusion.simulate_issue(program, chip=V5E)
+    sim_sched = _fusion.simulate_issue(scheduled, chip=V5E)
+    speedup = sim_prog["makespan_s"] / max(sim_sched["makespan_s"],
+                                           1e-12)
+    gap_drop = (sim_prog["dispatch_gap_fraction"]
+                - sim_sched["dispatch_gap_fraction"])
+    phases = _fusion.overlap_phases(program, 4, mode="bandwidth",
+                                    chip=V5E)
+
+    ok = (byte_identical and warm_replans == 0 and warm_hits > 0
+          and gap_drop > 0.0 and speedup >= 1.0
+          and _fusion.schedule_legs(program, mode="program") == program)
+    result = {
+        "metric": "planir_scheduled_speedup",
+        "value": round(speedup, 4),
+        "unit": "x",
+        "vs_baseline": None,  # host-side contention model, no wire peer
+        "config": f"virtual_{n_dcn}x{n_ici}_sched_bandwidth",
+        "baseline_config": f"virtual_{n_dcn}x{n_ici}_sched_program",
+        "planir": {
+            "world": world,
+            "mesh": [n_dcn, n_ici],
+            "chip": V5E.name,
+            "legs": len(program),
+            "bucket_elems": bucket_elems,
+            "zero_elems": zero_elems,
+            "consumers": ["hier-dp", "zero1", "serving-decode", "moe",
+                          "guard"],
+            "wire_bytes": int(sum(l.nbytes for l in program)),
+            "byte_identical": bool(byte_identical),
+            "plans_cold": int(cold["misses"]),
+            "replans_warm": int(warm_replans),
+            "hits_warm": int(warm_hits),
+            "program": {
+                "makespan_s": round(sim_prog["makespan_s"], 6),
+                "dispatch_gap_fraction": round(
+                    sim_prog["dispatch_gap_fraction"], 4),
+                "busy_s": {k: round(v, 6)
+                           for k, v in sim_prog["busy_s"].items()},
+            },
+            "scheduled": {
+                "makespan_s": round(sim_sched["makespan_s"], 6),
+                "dispatch_gap_fraction": round(
+                    sim_sched["dispatch_gap_fraction"], 4),
+                "busy_s": {k: round(v, 6)
+                           for k, v in sim_sched["busy_s"].items()},
+            },
+            "speedup": round(speedup, 4),
+            "gap_drop": round(gap_drop, 4),
+            "overlap_phase_sizes": [len(p) for p in phases],
+        },
+    }
+    if not ok:
+        result["error"] = "planir drill failed a gate (see planir block)"
+    print(json.dumps(result), flush=True)
+    os._exit(0 if ok else 2)
+
+
 def _main_roofline():
     """BENCH_ROOFLINE=1: single-chip Pallas kernel roofline drill.
 
@@ -1251,6 +1397,8 @@ def main():
         _main_prefix()
     if AUTOSCALE_BENCH:
         _main_autoscale()
+    if PLANIR_BENCH:
+        _main_planir()
     if ROOFLINE_BENCH:
         _main_roofline()
     if SDC_BENCH:

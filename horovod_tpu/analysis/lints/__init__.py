@@ -3,6 +3,7 @@
 from .base import (LintContext, LintRule, all_rules, rule_catalogue,
                    run_lints)
 from .envreg import EnvRegistryRule, read_env_vars, scan_env_vars
+from .legplan import LegDerivationOutsidePlannerRule
 from .locks import UnlockedSharedStateRule
 from .nondeterminism import NondeterminismInStepRule
 from .planner import CollectiveOutsidePlannerRule
@@ -11,5 +12,5 @@ __all__ = [
     "LintContext", "LintRule", "all_rules", "rule_catalogue", "run_lints",
     "EnvRegistryRule", "read_env_vars", "scan_env_vars",
     "UnlockedSharedStateRule", "NondeterminismInStepRule",
-    "CollectiveOutsidePlannerRule",
+    "CollectiveOutsidePlannerRule", "LegDerivationOutsidePlannerRule",
 ]

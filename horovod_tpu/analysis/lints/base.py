@@ -78,12 +78,14 @@ class LintRule:
 
 def all_rules() -> List[LintRule]:
     from .envreg import EnvRegistryRule
+    from .legplan import LegDerivationOutsidePlannerRule
     from .locks import UnlockedSharedStateRule
     from .nondeterminism import NondeterminismInStepRule
     from .pallas_tests import PallasInterpretTestRule
     from .planner import CollectiveOutsidePlannerRule
     return [UnlockedSharedStateRule(), NondeterminismInStepRule(),
-            CollectiveOutsidePlannerRule(), EnvRegistryRule(),
+            CollectiveOutsidePlannerRule(),
+            LegDerivationOutsidePlannerRule(), EnvRegistryRule(),
             PallasInterpretTestRule()]
 
 

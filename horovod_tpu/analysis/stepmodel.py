@@ -173,10 +173,12 @@ def expected_exchange(params, meta: dict) -> ExpectedExchange:
         # The SDC guard screen: one f32[2] psum (nonfinite count +
         # grad-norm square) riding beside the gradient exchange,
         # identical on every modeled path including world=1.  Priced
-        # here, NOT absorbed by the scalar-aux allowance -- elements==2
-        # is deliberate so an unmodeled auditor flags it.
-        expected.ops.append(ExpectedOp("psum", "float32", 2,
-                                       "guard/screen"))
+        # from the SAME plan row the step notes (audit label is
+        # complete), NOT absorbed by the scalar-aux allowance --
+        # elements==2 is deliberate so an unmodeled auditor flags it.
+        from ..controller import fusion as _fusion
+        expected.ops.extend(
+            _plan_ops(_fusion.plan_exchange("guard").legs, tag=""))
     return expected
 
 
@@ -259,10 +261,7 @@ def _expected_exchange(params, meta: dict) -> ExpectedExchange:
     if is_hier_legs(comp):
         # Flat-mesh degrade: the DCN hop is vacuous, the psum-compatible
         # ICI codec rides the flat exchange (collective() parity).
-        ops = [ExpectedOp("psum", _wire_dtype(comp.ici, r["dtype"]),
-                          r["elements"],
-                          f"bucket{r['bucket']}({r['dtype']})/allreduce")
-               for r in rows]
+        ops = _flat_bucket_ops(rows, comp.ici)
         return ExpectedExchange(ops=ops, plan_rows=rows, notes=(
             "per-leg codec on a flat mesh: ICI codec on the flat psum",))
     chunk = exchange_chunk_bytes()
@@ -271,61 +270,50 @@ def _expected_exchange(params, meta: dict) -> ExpectedExchange:
                                 plan_rows=rows,
                                 notes=(f"chunked exchange ({chunk}B chunks "
                                        "of the wire buffer)",))
-    ops = [ExpectedOp("psum", _wire_dtype(comp, r["dtype"]),
-                      r["elements"],
-                      f"bucket{r['bucket']}({r['dtype']})/allreduce")
-           for r in rows]
-    return ExpectedExchange(ops=ops, plan_rows=rows)
+    return ExpectedExchange(ops=_flat_bucket_ops(rows, comp),
+                            plan_rows=rows)
+
+
+def _flat_bucket_ops(rows: List[dict], comp) -> List[ExpectedOp]:
+    """One flat psum per bucket at the codec's wire dtype, rendered from
+    the memoized ``plan_exchange("flat", ...)`` rows."""
+    from ..controller import fusion as _fusion
+    ops = []
+    for r in rows:
+        plan = _fusion.plan_exchange(
+            "flat", size=int(r["elements"]), dtype=str(r["dtype"]),
+            compression=comp)
+        ops += _plan_ops(plan.legs,
+                         tag=f"bucket{r['bucket']}({r['dtype']})")
+    return ops
+
+
+def _plan_ops(legs, tag=None) -> List[ExpectedOp]:
+    """Render plan-IR legs' audit contracts as ExpectedOp rows -- the
+    expectation IS the plan, flattened by ``fusion.ops_from_legs``."""
+    from ..controller import fusion as _fusion
+    return [ExpectedOp(kind, dt, elements, label)
+            for kind, dt, elements, label
+            in _fusion.ops_from_legs(legs, tag=tag)]
 
 
 def _hier_bucket_ops(tag: str, size: int, dtype, comp, n_dcn: int,
-                     n_ici: int) -> List[ExpectedOp]:
+                     n_ici: int, axes=None) -> List[ExpectedOp]:
     """The collective legs one bucket of ``ops.hierarchical_allreduce``
-    emits: intra-slice reduce-scatter, cross-slice hop under the DCN
-    codec, intra-slice allgather (same arithmetic as
-    ``fusion.plan_hier_legs``, but in first-operand element counts --
-    what the jaxpr auditor records)."""
-    from ..collectives.compression import is_hier_legs
-    dt = jnp.dtype(dtype)
-    floating = jnp.issubdtype(dt, jnp.floating)
-    if is_hier_legs(comp):
-        ici_c, dcn_c = comp.ici, comp.dcn
-    else:
-        # A flat cast codec compresses the bucket before the op: every
-        # leg rides the wire dtype with no codec inside the exchange.
-        dt = jnp.dtype(_wire_dtype(comp, dt))
-        ici_c = dcn_c = Compression.none
-    if not floating:
-        ici_c = dcn_c = Compression.none
-    if n_dcn <= 1:
-        # Single slice: the op statically falls back to the flat psum.
-        return [ExpectedOp("psum", str(dt), size, f"{tag}/flat-ar")]
-    quantum = _ops.microbatch_pad_quantum(n_ici)
-    padded = size + (-size) % quantum
-    shard = padded // n_ici
-    ici_dt = _wire_dtype(ici_c, dt)
-    ops = [ExpectedOp("reduce_scatter", ici_dt, padded, f"{tag}/ici-rs")]
-    if floating and is_powersgd(dcn_c):
-        pw, qw = powersgd_factor_widths(shard, dcn_c.rank)
-        ops.append(ExpectedOp("psum", "float32", pw, f"{tag}/dcn-psum-P"))
-        ops.append(ExpectedOp("psum", "float32", qw, f"{tag}/dcn-psum-Q"))
-    elif floating and is_error_feedback(dcn_c):
-        k = min(topk_count(shard, dcn_c.fraction), shard)
-        ops.append(ExpectedOp("all_gather", "float32", k,
-                              f"{tag}/dcn-gather-values"))
-        ops.append(ExpectedOp("all_gather", "int32", k,
-                              f"{tag}/dcn-gather-indices"))
-    elif floating and is_fp8(dcn_c):
-        # Quantized gather-sum: e4m3 shards + one f32 scale per slice.
-        ops.append(ExpectedOp("all_gather", "float8_e4m3fn", shard,
-                              f"{tag}/dcn-gather-q"))
-        ops.append(ExpectedOp("all_gather", "float32", 1,
-                              f"{tag}/dcn-gather-scale"))
-    else:
-        ops.append(ExpectedOp("psum", _wire_dtype(dcn_c, dt), shard,
-                              f"{tag}/dcn-ar"))
-    ops.append(ExpectedOp("all_gather", ici_dt, shard, f"{tag}/ici-ag"))
-    return ops
+    emits -- the SAME memoized ``plan_exchange("hier", ...)`` rows the
+    executor notes, rendered in first-operand element counts (what the
+    jaxpr auditor records).  ``axes`` overrides the ``(dcn, ici)`` axis
+    names for exchanges over a mesh subset (the 3-D data pair); the
+    default asks the world mesh so the plan-cache entry is shared with
+    the executor."""
+    from ..controller import fusion as _fusion
+    if axes is None:
+        axes = _fusion.hier_mesh_axes() or ("dcn", "ici")
+    plan = _fusion.plan_exchange(
+        "hier", size=int(size), dtype=str(jnp.dtype(dtype)),
+        n_dcn=int(n_dcn), n_ici=int(n_ici), compression=comp,
+        dcn_axis=str(axes[0]), ici_axis=str(axes[1]))
+    return _plan_ops(plan.legs, tag=tag)
 
 
 def _chunked_ops(rows: List[dict], comp, chunk_bytes: int,
@@ -333,23 +321,17 @@ def _chunked_ops(rows: List[dict], comp, chunk_bytes: int,
     """The RS+AG pieces ``ops.chunked_allreduce`` emits per bucket.
 
     Chunking acts on the COMPRESSED wire buffer (collective() compresses
-    first), so the chunk element quantum derives from the wire itemsize
-    and every piece rides the wire dtype."""
+    first), so each bucket's plan is keyed on the wire dtype/size -- the
+    SAME ``plan_exchange("chunked", ...)`` entry the executor notes."""
+    from ..controller import fusion as _fusion
     ops = []
     for r in rows:
         wire = _wire_dtype(comp, r["dtype"])
-        wire_item = jnp.dtype(wire).itemsize
-        chunk_elems = max(1, int(chunk_bytes) // wire_item)
-        chunk_elems += (-chunk_elems) % world
-        size = r["elements"]
         tag = f"bucket{r['bucket']}({r['dtype']})"
-        for j, off in enumerate(range(0, size, chunk_elems)):
-            piece = min(chunk_elems, size - off)
-            padded = piece + (-piece) % world
-            ops.append(ExpectedOp("reduce_scatter", wire, padded,
-                                  f"{tag}/chunk{j}-rs"))
-            ops.append(ExpectedOp("all_gather", wire, padded // world,
-                                  f"{tag}/chunk{j}-ag"))
+        plan = _fusion.plan_exchange(
+            "chunked", size=int(r["elements"]), dtype=wire,
+            chunk_bytes=int(chunk_bytes), world=int(world))
+        ops += _plan_ops(plan.legs, tag=tag)
     return ops
 
 
@@ -379,18 +361,21 @@ def _expected_serving_decode(meta: dict) -> ExpectedExchange:
         return _unsupported(
             (f"serving decode meta missing {'/'.join(missing)}: "
              "cannot derive activation widths",))
+    from ..controller import fusion as _fusion
     layers = int(meta["num_layers"])
     width = int(meta.get("width", 1))
     elements = int(meta["slots"]) * width * int(meta["d_model"])
     dtype = str(jnp.dtype(meta.get("dtype", "float32")))
     kind_tag = ("serving-tp-verify" if meta.get("kind") == "serving_verify"
                 else "serving-tp-decode")
-    ops: List[ExpectedOp] = []
-    for li in range(layers):
-        ops.append(ExpectedOp("psum", dtype, elements,
-                              f"layer{li}/attn_wo/allreduce"))
-        ops.append(ExpectedOp("psum", dtype, elements,
-                              f"layer{li}/mlp_down/allreduce"))
+    # The SAME memoized plan the decode step builder notes; audit labels
+    # are complete, so no tag prefix.
+    plan = _fusion.plan_exchange(
+        "serving", kind=str(meta.get("kind", "serving_decode")),
+        layers=layers, slots=int(meta["slots"]), width=width,
+        d_model=int(meta["d_model"]), dtype=dtype,
+        axis=str(meta.get("tp_axis", "tp")))
+    ops: List[ExpectedOp] = _plan_ops(plan.legs, tag="")
     rows = [{"bucket": 0, "dtype": dtype, "leaves": 2 * layers,
              "elements": 2 * layers * elements,
              "kind": kind_tag}]
@@ -415,29 +400,22 @@ def _ef_ops(rows: List[dict], comp,
     With ``hier_shape`` (a per-leg ``ici:...,dcn:powersgd/topk`` codec on
     the two-level mesh) each floating bucket routes through
     ``hierarchical_allreduce`` with the EF codec scoped to the DCN hop;
-    non-float buckets still ride the plain flat psum."""
+    non-float buckets still ride the plain flat psum.  Both shapes come
+    from the memoized plan IR -- the flat path from the SAME
+    ``plan_exchange("ef", ...)`` entry ``ef_exchange`` notes."""
+    from ..controller import fusion as _fusion
     ops = []
     for r in rows:
         tag = f"bucket{r['bucket']}({r['dtype']})"
-        if not jnp.issubdtype(jnp.dtype(r["dtype"]), jnp.floating):
-            ops.append(ExpectedOp("psum", r["dtype"], r["elements"],
-                                  f"{tag}/allreduce"))
-            continue
-        if hier_shape is not None:
+        floating = jnp.issubdtype(jnp.dtype(r["dtype"]), jnp.floating)
+        if floating and hier_shape is not None:
             ops += _hier_bucket_ops(tag, r["elements"], r["dtype"], comp,
                                     *hier_shape)
             continue
-        size = r["elements"]
-        if is_powersgd(comp):
-            pw, qw = powersgd_factor_widths(size, comp.rank)
-            ops.append(ExpectedOp("psum", "float32", pw, f"{tag}/psum-P"))
-            ops.append(ExpectedOp("psum", "float32", qw, f"{tag}/psum-Q"))
-        else:
-            k = min(topk_count(size, comp.fraction), size)
-            ops.append(ExpectedOp("all_gather", "float32", k,
-                                  f"{tag}/gather-values"))
-            ops.append(ExpectedOp("all_gather", "int32", k,
-                                  f"{tag}/gather-indices"))
+        plan = _fusion.plan_exchange(
+            "ef", size=int(r["elements"]), dtype=str(r["dtype"]),
+            compression=comp)
+        ops += _plan_ops(plan.legs, tag=tag)
     return ops
 
 
@@ -464,22 +442,23 @@ def _expected_microbatch(leaves, exchange, k: int, world: int
         return ExpectedExchange(ops=_ef_ops(rows, comp), plan_rows=rows,
                                 notes=("EF-once-per-step microbatch pipe",))
 
+    from ..controller import fusion as _fusion
     spec = plan_buckets(leaves, exchange["fusion_threshold"], reverse=True)
-    q = _ops.microbatch_pad_quantum(world)
+    plan = _fusion.plan_exchange(
+        "microbatch",
+        buffers=tuple((str(jnp.dtype(dt)), sum(s.size for s in lspecs))
+                      for dt, lspecs in spec.buffers),
+        k=int(k), world=int(world), compression=comp)
+    nb = len(spec.buffers)
     ops, rows = [], []
     for i, (dt, lspecs) in enumerate(spec.buffers):
-        size = sum(s.size for s in lspecs)
-        padded = size + (-size) % q
-        wire = _wire_dtype(comp, dt)
+        rs, ag = plan.legs[i], plan.legs[nb + i]
         tag = f"bucket{i}({jnp.dtype(dt)})"
-        for j in range(k):
-            ops.append(ExpectedOp("reduce_scatter", wire, padded,
-                                  f"{tag}/scatter-mb{j}"))
-        ops.append(ExpectedOp("all_gather", wire, padded // world,
-                              f"{tag}/allgather"))
+        ops += _plan_ops([rs, ag], tag=tag)
         rows.append({"bucket": i, "dtype": str(jnp.dtype(dt)),
-                     "leaves": len(lspecs), "elements": size,
-                     "padded": padded, "wire_dtype": wire,
+                     "leaves": len(lspecs),
+                     "elements": sum(s.size for s in lspecs),
+                     "padded": rs.elements, "wire_dtype": rs.wire_dtype,
                      "codec": comp.__name__, "kind": "microbatch-pipe"})
     return ExpectedExchange(ops=ops, plan_rows=rows)
 
@@ -510,23 +489,32 @@ def _expected_zero(leaves, meta: dict, world: int,
     if is_error_feedback(comp) or is_fp8(comp):
         return _unsupported(
             (f"unmodeled zero allgather codec: {comp.__name__}",))
+    from ..controller import fusion as _fusion
     spec = _zero.plan_arena(leaves, world)
     use_rs = _zero._use_reducescatter()
     if axes_shape is None:
         two_level = hier_mesh_shape()
+        ax_names = _fusion.hier_mesh_axes() or ()
     else:
         two_level = tuple(int(n) for n in axes_shape) \
             if len(axes_shape) == 2 else None
+        ax_names = tuple(meta.get("data_axes") or ()) \
+            if two_level is not None else ()
     hier = is_hier_legs(comp) and two_level is not None
     if hier and is_fp8(comp.dcn):
         return _unsupported(("unmodeled zero DCN-leg codec: fp8 "
                              "(quantized leader gather)",))
+    plan = _fusion.plan_exchange(
+        "zero",
+        buffers=tuple((str(jnp.dtype(b.dtype)), int(b.size),
+                       int(b.padded), int(b.shard)) for b in spec.buffers),
+        world=int(world), compression=comp, axes_shape=two_level,
+        axes=ax_names, use_rs=use_rs)
+    nb = len(spec.buffers)
     ops, rows = [], []
     notes = []
     if two_level is not None:
         n_dcn, n_ici = two_level
-        # Axis extents in the order the RS loop scatters over them.
-        rs_order = (n_ici, n_dcn) if hier else (n_dcn, n_ici)
         notes.append(f"per-axis zero exchange on the ({n_dcn}, {n_ici}) "
                      f"mesh{' (per-leg codec)' if hier else ''}")
     for i, buf in enumerate(spec.buffers):
@@ -534,38 +522,7 @@ def _expected_zero(leaves, meta: dict, world: int,
             continue
         dt = str(jnp.dtype(buf.dtype))
         tag = f"arena{i}({dt})"
-        if use_rs:
-            if two_level is not None:
-                running = buf.padded
-                for j, n_a in enumerate(rs_order):
-                    ops.append(ExpectedOp("reduce_scatter", dt, running,
-                                          f"{tag}/reduce-scatter-ax{j}"))
-                    running //= n_a
-            else:
-                ops.append(ExpectedOp("reduce_scatter", dt, buf.padded,
-                                      f"{tag}/reduce-scatter"))
-        else:
-            ops.append(ExpectedOp("psum", dt, buf.padded,
-                                  f"{tag}/allreduce"))
-        if hier:
-            # compressed_allgather over (dcn,) then (ici,), each hop at
-            # its leg codec's wire dtype.
-            ops.append(ExpectedOp("all_gather",
-                                  _wire_dtype(comp.dcn, buf.dtype),
-                                  buf.shard, f"{tag}/allgather-dcn"))
-            ops.append(ExpectedOp("all_gather",
-                                  _wire_dtype(comp.ici, buf.dtype),
-                                  buf.shard * n_dcn, f"{tag}/allgather-ici"))
-        elif two_level is not None:
-            # ops.allgather gathers reversed(axes): ici first, dcn last.
-            wire = _wire_dtype(comp, buf.dtype)
-            ops.append(ExpectedOp("all_gather", wire, buf.shard,
-                                  f"{tag}/allgather-ici"))
-            ops.append(ExpectedOp("all_gather", wire, buf.shard * n_ici,
-                                  f"{tag}/allgather-dcn"))
-        else:
-            ops.append(ExpectedOp("all_gather", _wire_dtype(comp, buf.dtype),
-                                  buf.shard, f"{tag}/allgather"))
+        ops += _plan_ops([plan.legs[i], plan.legs[nb + i]], tag=tag)
         rows.append({"bucket": i, "dtype": dt, "leaves": len(buf.leaves),
                      "elements": buf.size, "padded": buf.padded,
                      "shard": buf.shard, "codec": comp.__name__,
@@ -688,11 +645,12 @@ def _expected_3d(params, meta: dict) -> ExpectedExchange:
             hier = ((hier_requested(comp) or is_hier_legs(comp))
                     and len(data_mesh) == 2)
             if hier:
+                d_axes = tuple(meta.get("data_axes") or ()) or None
                 hops = []
                 for r in rows:
                     hops += _hier_bucket_ops(
                         f"bucket{r['bucket']}({r['dtype']})", r["elements"],
-                        r["dtype"], comp, *data_mesh)
+                        r["dtype"], comp, *data_mesh, axes=d_axes)
                 base = ExpectedExchange(ops=hops, plan_rows=rows, notes=(
                     f"two-level DP leg on the {data_mesh} data axes",))
             elif is_hier_legs(comp):
@@ -700,13 +658,8 @@ def _expected_3d(params, meta: dict) -> ExpectedExchange:
                     "per-leg codec without the (dcn, data) pair: the "
                     "runtime raises",))
             else:
-                base = ExpectedExchange(
-                    ops=[ExpectedOp(
-                        "psum", _wire_dtype(comp, r["dtype"]),
-                        r["elements"],
-                        f"bucket{r['bucket']}({r['dtype']})/allreduce")
-                        for r in rows],
-                    plan_rows=rows)
+                base = ExpectedExchange(ops=_flat_bucket_ops(rows, comp),
+                                        plan_rows=rows)
     if not base.supported:
         return base
 

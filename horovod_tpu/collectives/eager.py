@@ -554,11 +554,14 @@ def _deferred_error(handle: int, cause: BaseException,
 @dataclasses.dataclass
 class _FlushUnit:
     """One collective dispatch within a flush: a fused bucket of
-    compatible ops, or a single op on the per-op path."""
+    compatible ops, or a single op on the per-op path.  ``leg`` is the
+    unit's exchange-plan IR row (fused buckets only) -- the scheduler
+    orders units by its cost model under the default bandwidth mode."""
     pos: int                       # issue position of the first member
     handles: List[int]
     dispatch: Callable[[], Dict[int, Any]]
     fused: bool = False
+    leg: Any = None                # Optional[fusion.ExchangeLeg]
 
 
 def _single_unit(pos: int, h: int, entry) -> _FlushUnit:
@@ -624,7 +627,15 @@ def _fused_unit(bucket, widths, k: int) -> _FlushUnit:
         vals = st.cache.get_or_build(key, build)(red)
         return dict(zip(handles, vals))
 
-    return _FlushUnit(pos, handles, dispatch, fused=True)
+    # Plan-IR row for the fused payload: one flat allreduce of the
+    # [k, sum(widths)] concat at this bucket's wire dtype.  Pure in the
+    # member shapes/codec, so every SPMD process derives the same row.
+    from ..controller import fusion as _fusion
+    leg = _fusion.plan_exchange(
+        "flat", size=k * sum(widths),
+        dtype=jnp.dtype(r0.x.dtype).name,
+        compression=r0.compression).legs[0]
+    return _FlushUnit(pos, handles, dispatch, fused=True, leg=leg)
 
 
 def _plan_flush_units(pending, fuse: bool) -> List[_FlushUnit]:
@@ -674,7 +685,19 @@ def _plan_flush_units(pending, fuse: bool) -> List[_FlushUnit]:
                 continue
             units.append(_fused_unit([members[s.index] for s in lspecs],
                                      [s.size for s in lspecs], k))
-    units.sort(key=lambda u: u.pos)
+    if _fusion.exchange_schedule_mode() == "bandwidth":
+        # Bandwidth-ordered issue (HOROVOD_EXCHANGE_SCHEDULE=program
+        # restores pure issue order): costliest planned legs dispatch
+        # first so their wire time overlaps the cheaper units' host
+        # glue.  Pure in the plan rows + issue order -- every SPMD
+        # process cuts the identical sequence, which the drained-rank
+        # protocol requires.  Payloads are untouched; only issue order
+        # moves.
+        units.sort(key=lambda u: (
+            -_fusion.leg_cost_seconds(u.leg) if u.leg is not None
+            else 0.0, u.pos))
+    else:
+        units.sort(key=lambda u: u.pos)
     return units
 
 

@@ -262,8 +262,7 @@ def hierarchical_allreduce(x,
     bitwise identical to :func:`allreduce` on the same mesh.
     """
     from .compression import (Compression, fp8_quantize, is_error_feedback,
-                              is_fp8, is_powersgd, is_topk,
-                              wire_payload_bytes)
+                              is_fp8, is_powersgd, is_topk)
     if op not in (Sum, Average):
         raise ValueError(
             f"hierarchical_allreduce supports Sum/Average, got {op}")
@@ -308,15 +307,20 @@ def hierarchical_allreduce(x,
     itemsize = jnp.dtype(dtype).itemsize
     ici_wire, ici_ctx = ici_codec.compress(flat)
     ici_itemsize = jnp.dtype(ici_wire.dtype).itemsize
-    # Trace-time per-leg registration (fires once per trace): the RS/AG
-    # legs move the full padded bucket at the ICI wire width, the DCN hop
-    # only the 1/n_ici shard at the DCN codec's payload.
+    # Trace-time per-leg registration (fires once per trace): the legs
+    # come from the shared exchange-plan IR -- the SAME plan object the
+    # auditor and explain_plan consume -- and each row carries the wire
+    # byte accounting (RS/AG move the full padded bucket at the ICI wire
+    # width, the DCN hop only the 1/n_ici shard at the DCN codec's
+    # payload).
+    from ..controller import fusion as _fusion
     from ..timeline import spans as _spans
-    _spans.note_leg("hier/ici_rs", nbytes=padded * ici_itemsize)
-    _spans.note_leg("hier/dcn_ar",
-                    nbytes=wire_payload_bytes(dcn_codec, shard_len,
-                                              itemsize))
-    _spans.note_leg("hier/ici_ag", nbytes=padded * ici_itemsize)
+    for _leg in _fusion.plan_exchange(
+            "hier", size=int(x.size), dtype=str(dtype),
+            n_dcn=int(n_dcn), n_ici=int(n_ici),
+            ici_codec=ici_codec, dcn_codec=dcn_codec,
+            dcn_axis=dcn_axis, ici_axis=ici_axis).legs:
+        _spans.note_leg(_leg)
 
     shard = lax.psum_scatter(ici_wire, ici_axis, scatter_dimension=0,
                              tiled=True)
@@ -408,8 +412,13 @@ def chunked_allreduce(x,
     chunk_elems += (-chunk_elems) % n
     # Trace-time leg registration for straggler attribution (fires once
     # per trace; RS(B)+AG(B) moves an equivalent-allreduce payload).
+    # The leg row comes from the shared plan IR: chunking acts on the
+    # already-compressed wire buffer, so the plan sees the wire dtype.
+    from ..controller import fusion as _fusion
     from ..timeline import spans as _spans
-    _spans.note_leg("chunked_rs_ag", nbytes=int(flat.size) * itemsize)
+    _spans.note_leg(_fusion.plan_exchange(
+        "chunked", size=int(flat.size), dtype=str(dtype),
+        chunk_bytes=int(chunk_bytes), world=int(n)).legs[0])
     pieces = []
     for off in range(0, flat.size, chunk_elems):
         piece = flat[off:off + chunk_elems]
@@ -851,9 +860,11 @@ def fp8_allreduce(x,
         flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
     rows = flat.reshape(n, -1)                     # row j -> rank j
     # Trace-time leg registration: fp8 all_to_all + result allgather,
-    # one wire byte per e4m3 element in each direction.
+    # one wire byte per e4m3 element in each direction (plan-IR row).
+    from ..controller import fusion as _fusion
     from ..timeline import spans as _spans
-    _spans.note_leg("fp8_allreduce", nbytes=2 * int(flat.size))
+    _spans.note_leg(_fusion.plan_exchange(
+        "fp8", size=int(x.size), world=int(n)).legs[0])
     q, scales = fp8_quantize(rows, axis=0)         # per-destination scales
     recv = lax.all_to_all(q, a, split_axis=0, concat_axis=0, tiled=True)
     # scale matrix: S[src, dst]; my column is the scale each sender used
@@ -961,9 +972,12 @@ def powersgd_allreduce(x,
     pad = m * c - size
     r = max(1, min(int(rank), m, c))
     if note:
-        # Trace-time leg registration: two f32 factor allreduces.
+        # Trace-time leg registration: two f32 factor allreduces
+        # (plan-IR row).
+        from ..controller import fusion as _fusion
         from ..timeline import spans as _spans
-        _spans.note_leg("powersgd_allreduce", nbytes=2 * r * (m + c) * 4)
+        _spans.note_leg(_fusion.plan_exchange(
+            "powersgd", size=int(size), rank=int(rank)).legs[0])
 
     from ..ops import pallas as _pallas
     if _pallas.pallas_enabled("fused_update"):
@@ -974,8 +988,11 @@ def powersgd_allreduce(x,
         # are identical to the unfused path below.
         from ..ops import fused_update as _fused
         if note:
+            from ..controller import fusion as _fusion
             from ..timeline import spans as _spans
-            _spans.note_leg("pallas/fused_update", nbytes=size * 4)
+            _spans.note_leg(_fusion.plan_exchange(
+                "kernel", kernel="fused_update", nbytes=int(size) * 4
+            ).legs[0])
         xf = x.ravel()
         xp = jnp.concatenate([xf, jnp.zeros((pad,), xf.dtype)]) \
             if pad else xf
@@ -1067,9 +1084,12 @@ def topk_allreduce(x,
     size = acc.size
     k = min(topk_count(size, fraction), size)
     if note:
-        # Trace-time leg registration: (value f32, index int32) pairs.
+        # Trace-time leg registration: (value f32, index int32) pairs
+        # (plan-IR row).
+        from ..controller import fusion as _fusion
         from ..timeline import spans as _spans
-        _spans.note_leg("topk_allreduce", nbytes=8 * k)
+        _spans.note_leg(_fusion.plan_exchange(
+            "topk", size=int(size), fraction=float(fraction)).legs[0])
 
     _, idx = lax.top_k(jnp.abs(acc), k)            # int32 indices
     vals = jnp.take(acc, idx)

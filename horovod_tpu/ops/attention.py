@@ -303,9 +303,11 @@ def _flash_decode(q, k, v, lengths, scale: float, bk: int):
     nk = s // bk
     q4 = q.reshape(b, h_kv, rep, d)
     len2 = lengths.astype(jnp.int32).reshape(b, 1)
+    from ..controller import fusion as _fusion
     from ..timeline import spans as _spans
-    _spans.note_leg("pallas/flash_decode",
-                    nbytes=k.size * k.dtype.itemsize * 2)
+    _spans.note_leg(_fusion.plan_exchange(
+        "kernel", kernel="flash_decode",
+        nbytes=k.size * k.dtype.itemsize * 2).legs[0])
     kernel = functools.partial(_decode_kernel, scale=scale, bk=bk, nk=nk)
     o = pl.pallas_call(
         kernel,

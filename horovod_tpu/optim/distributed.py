@@ -208,6 +208,7 @@ def allreduce_gradients(grads,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor)
                 return r
+            _note_flat_leg(c, compression.ici)
             ci, ictx = compression.ici.compress(c)
             r = _ops.allreduce(ci, op, axes=axes, process_set=process_set,
                                prescale_factor=prescale_factor,
@@ -229,10 +230,23 @@ def allreduce_gradients(grads,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor)
         else:
+            _note_flat_leg(buf, compression)
             r = _ops.allreduce(c, op, axes=axes, process_set=process_set,
                                prescale_factor=prescale_factor,
                                postscale_factor=postscale_factor)
         return compression.decompress(r, ctx)
+
+    def _note_flat_leg(buf, comp):
+        # Flat fused-bucket exchange: register the plan-IR row at trace
+        # time (the hier/chunked/fp8/EF paths note inside their ops; a
+        # world-1 "reduction" is the identity and moves no bytes).
+        if world == 1:
+            return
+        from ..controller import fusion as _fusion
+        from ..timeline import spans as _spans
+        _spans.note_leg(_fusion.plan_exchange(
+            "flat", size=int(buf.size), dtype=str(buf.dtype),
+            compression=comp).legs[0])
 
     # Axis sizes are static at trace time: a one-device reduction is the
     # identity (every reduce op over a single member returns its input), so
@@ -410,11 +424,15 @@ def ef_exchange(grads, residuals, *, compression, op=Average,
     for i, (buf, res, (dt, _ls)) in enumerate(
             zip(buffers, residuals, spec.buffers)):
         if not hier:
-            # The two-level path notes its own hier/* legs per hop.
+            # The two-level path notes its own hier/* legs per hop.  The
+            # ledger row (wire payload accounting) comes from the shared
+            # exchange-plan IR; the nested powersgd/topk collective rows
+            # fire from inside the op itself.
+            from ..controller import fusion as _fusion
             _spans.note_leg(
-                "ef_exchange",
-                nbytes=wire_payload_bytes(compression, int(buf.size),
-                                          jnp.dtype(buf.dtype).itemsize),
+                _fusion.plan_exchange(
+                    "ef", size=int(buf.size), dtype=str(buf.dtype),
+                    compression=compression).legs[0],
                 bucket_id=i)
         if not jnp.issubdtype(buf.dtype, jnp.floating):
             out_bufs.append(_ops.allreduce(

@@ -307,12 +307,23 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
     # Trace-time leg registration (fires once per trace, like
     # _note_compression_ratio): attributes the compiled step's exchange
     # bytes to the ZeRO RS/AG legs for the cross-rank straggler report.
+    # The RS/AG rows come from the shared exchange-plan IR -- this
+    # executor only picks which collective to run per row.
+    from ..controller import fusion as _fusion
     from ..timeline import spans as _spans
+    zplan = _fusion.plan_exchange(
+        "zero",
+        buffers=tuple((str(jnp.dtype(b.dtype)), int(b.size),
+                       int(b.padded), int(b.shard)) for b in spec.buffers),
+        world=int(n), compression=comp,
+        axes_shape=(tuple(int(lax.axis_size(a)) for a in ax)
+                    if len(ax) == 2 else None),
+        axes=(ax if len(ax) == 2 else ()), use_rs=use_rs)
+    rs_legs = zplan.legs[:len(spec.buffers)]
+    ag_legs = zplan.legs[len(spec.buffers):]
     g_shards, p_shards = [], []
     for i, (g, p, buf) in enumerate(zip(g_arenas, p_arenas, spec.buffers)):
-        _spans.note_leg("zero_rs" if use_rs else "zero_allreduce",
-                        nbytes=int(g.size) * jnp.dtype(g.dtype).itemsize,
-                        bucket_id=i)
+        _spans.note_leg(rs_legs[i], bucket_id=i)
         if use_rs:
             gs = _ops.reducescatter(g, Average, axes=rs_axes)
         else:
@@ -332,10 +343,7 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
         full, new_res = [], []
         for i, (old, new, res, arena, buf) in enumerate(zip(
                 old_shards, p_shards, residuals, p_arenas, spec.buffers)):
-            _spans.note_leg(
-                "zero_ag",
-                nbytes=int(new.size) * jnp.dtype(new.dtype).itemsize,
-                bucket_id=i)
+            _spans.note_leg(ag_legs[i], bucket_id=i)
             if (not jnp.issubdtype(buf.dtype, jnp.floating)
                     or buf.shard < 1):
                 full.append(_ops.allgather(new, axes=rs_axes))
@@ -357,9 +365,7 @@ def zero_apply(optimizer, grads, zero_state, params, *, axes,
             jax.tree.map(lambda v: v[None], inner))
     full = []
     for i, s in enumerate(p_shards):
-        _spans.note_leg(
-            "zero_ag", nbytes=int(s.size) * jnp.dtype(s.dtype).itemsize,
-            bucket_id=i)
+        _spans.note_leg(ag_legs[i], bucket_id=i)
         if hier:
             # Leader exchange over the slice axis rides the DCN codec;
             # the intra-slice reassembly rides the (psum-compatible) ICI

@@ -54,12 +54,12 @@ def resolve_moe_compression(compression=None):
 
 
 def _a2a_leg(slots, *, axis, split_axis, concat_axis, codec, leg):
-    """One MoE all_to_all leg: note the wire payload for the trace
-    auditor, cast to the wire dtype, shuffle, cast back to f32."""
+    """One MoE all_to_all leg: note the plan-IR row (tag + planned wire
+    payload) for the trace auditor, cast to the wire dtype, shuffle,
+    cast back to f32."""
     from ..timeline import spans as _spans
     wire = _MOE_CODECS[codec]
-    itemsize = jnp.dtype(wire).itemsize if wire is not None else 4
-    _spans.note_leg(leg, nbytes=int(slots.size) * itemsize)
+    _spans.note_leg(leg)
     if wire is not None:
         slots = slots.astype(wire)
     out = _ops.alltoall(slots, axes=axis, split_axis=split_axis,
@@ -123,18 +123,24 @@ def moe_ffn(x, router_kernel, w_up, w_down, *, capacity_factor: float = 1.25,
         position_base = position_base + onehot.sum(0).astype(jnp.int32)
         remaining = remaining * (1.0 - onehot)
 
+    # Both shuffle legs come from the shared exchange-plan IR (one
+    # memoized plan per (E, C, d, codec, axis) shape).
+    from ..controller import fusion as _fusion
+    mplan = _fusion.plan_exchange(
+        "moe", n_experts=n_experts, capacity=capacity, d_model=d,
+        compression=codec, axis=axis)
     # (t_l, E, C) x (t_l, d) -> (E, C, d): slots for every global expert.
     slots = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     # all_to_all: split the expert dim across ranks, concat token slots ->
     # (E_l, ep * C, d): every slot destined for my local experts.
     slots = _a2a_leg(slots, axis=axis, split_axis=0, concat_axis=1,
-                     codec=codec, leg="moe/a2a_dispatch")
+                     codec=codec, leg=mplan.legs[0])
     h = jnp.einsum("ecd,edf->ecf", slots.astype(x.dtype), w_up)
     h = activation(h)
     out = jnp.einsum("ecf,efd->ecd", h, w_down)
     # Route results back: split slots, concat experts -> (E, C, d).
     out = _a2a_leg(out.astype(jnp.float32), axis=axis, split_axis=1,
-                   concat_axis=0, codec=codec, leg="moe/a2a_combine")
+                   concat_axis=0, codec=codec, leg=mplan.legs[1])
     y = jnp.einsum("tec,ecd->td", combine, out)
     return y.astype(x.dtype), _load_balance_loss(probs, dispatch)
 

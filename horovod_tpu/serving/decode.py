@@ -343,7 +343,20 @@ def build_decode_step(config: LlamaConfig, mesh, *,
     kvh_l = cfg.num_kv_heads // tp
     hd = cfg.head_dim
     kind = "serving_decode" if width == 1 else "serving_verify"
-    nbytes_leg = slots * width * cfg.d_model * jnp.dtype(dtype).itemsize
+    # Per-layer TP psum rows come from the shared exchange-plan IR
+    # (planned once, rendered verbatim by spans/auditor): legs[2*li] is
+    # layer li's attn_wo psum, legs[2*li + 1] its mlp_down psum.
+    from ..controller import fusion as _fusion
+    splan = _fusion.plan_exchange(
+        "serving", kind=kind, layers=cfg.num_layers, slots=slots,
+        width=width, d_model=cfg.d_model, dtype=str(jnp.dtype(dtype)),
+        axis=tp_axis)
+    # Register the plan rows at BUILD time, not trace time: plan-
+    # fingerprint executable sharing means an identical step may never
+    # re-trace, but each built step still owns its legs in the span
+    # registry (one registration per build, like one per trace before).
+    for _leg in splan.legs:
+        _spans.note_leg(_leg, bucket_id=_leg.bucket)
     max_len = pages_per_slot * page_size
 
     def spmd(params, k_pool, v_pool, tokens, positions, page_table,
@@ -455,8 +468,6 @@ def build_decode_step(config: LlamaConfig, mesh, *,
 
             # Row-parallel closures: the activation allreduce routes
             # through collectives.ops (planner/auditor/span visible).
-            _spans.note_leg(f"{kind}/layer{li}/attn_wo",
-                            nbytes=nbytes_leg)
             y = row_parallel(o.astype(dtype),
                              attn["wo"]["kernel"].astype(dtype),
                              axis=tp_axis)
@@ -474,8 +485,6 @@ def build_decode_step(config: LlamaConfig, mesh, *,
                         lora_select=lora("mlp", "w_up"),
                         lora_alpha=lora_alpha)
             act = (jax.nn.silu(gate) * up).astype(dtype)
-            _spans.note_leg(f"{kind}/layer{li}/mlp_down",
-                            nbytes=nbytes_leg)
             y = row_parallel(act, mlp["w_down"]["kernel"].astype(dtype),
                              axis=tp_axis)
             wd_lora = lora("mlp", "w_down")
@@ -509,15 +518,20 @@ def build_decode_step(config: LlamaConfig, mesh, *,
 
     # The jitted callable is built lazily on first call so the shard_map
     # in_specs can mirror the actual params tree (LoRA leaves included).
-    state = {}
-
+    # Memoized in the session ExecutableCache by the plan fingerprint:
+    # serving steps sharing exchange structure (same config/slots/width)
+    # on the same mesh share one compiled executable.
     def step(*args):
-        key = len(args)
-        if key not in state:
-            state[key] = _build(
-                args[0],
-                args[n_base] if len(args) > n_base else None)
-        return state[key](*args)
+        # The fingerprint keys the exchange structure; the extras pin
+        # the non-exchange statics (page geometry, arg arity, mesh) the
+        # compiled program also depends on.
+        fn = _fusion.plan_executable(
+            splan,
+            lambda: _build(args[0],
+                           args[n_base] if len(args) > n_base else None),
+            extra=(len(args), bool(compress), int(page_size),
+                   int(pages_per_slot), mesh))
+        return fn(*args)
 
     meta = {"kind": kind, "world": tp, "tp": tp,
             "num_layers": cfg.num_layers, "d_model": cfg.d_model,

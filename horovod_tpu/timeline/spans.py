@@ -165,14 +165,22 @@ class SpanRecorder:
                      fuse_key=fuse_key)
 
     # -- trace-time leg registry ------------------------------------------
-    def note_leg(self, leg: str, nbytes: int = 0,
+    def note_leg(self, leg, nbytes: Optional[int] = None,
                  bucket_id: Optional[int] = None,
                  fuse_key: Optional[str] = None) -> None:
         """Register an in-jit exchange leg (called at TRACE time from
         inside jitted code -- a host side effect that fires once per
         trace, like ``_note_compression_ratio``).  The byte totals let
         the offline report split compiled-step exchange time across
-        legs; they are per-trace wire payloads, not per-step timings."""
+        legs; they are per-trace wire payloads, not per-step timings.
+
+        ``leg`` is either a plan-IR ``ExchangeLeg`` row (preferred: the
+        tag AND byte count come from the plan, so the registry renders
+        the IR verbatim) or a bare tag string.  All entry points -- this
+        method and the module-level :func:`note_leg` -- normalize
+        through :func:`_normalize_leg`, the single tag/byte derivation
+        path."""
+        leg, nbytes = _normalize_leg(leg, nbytes)
         with self._lock:
             lg = self.legs.setdefault(leg, {"nbytes": 0, "buckets": 0})
             lg["nbytes"] += int(nbytes)
@@ -248,9 +256,30 @@ def recorder() -> SpanRecorder:
     return _recorder
 
 
-def note_leg(leg: str, nbytes: int = 0, bucket_id: Optional[int] = None,
+def _normalize_leg(leg, nbytes: Optional[int] = None):
+    """THE tag-normalization path for leg registration.
+
+    Accepts a plan-IR leg row (anything with ``.tag``/``.nbytes`` --
+    ``controller.fusion.ExchangeLeg``) or a bare tag string.  When the
+    caller passes an IR row and no byte override, the leg's planned wire
+    bytes are recorded -- the registry then renders the IR verbatim and
+    executor-emitted tags cannot drift from plan-rendered tags.  Both
+    ``SpanRecorder.note_leg`` and the module-level :func:`note_leg`
+    funnel through here (there is no second derivation)."""
+    tag = getattr(leg, "tag", None)
+    if tag is not None:
+        if nbytes is None:
+            nbytes = getattr(leg, "nbytes", 0)
+        return str(tag), int(nbytes)
+    return str(leg), int(nbytes if nbytes is not None else 0)
+
+
+def note_leg(leg, nbytes: Optional[int] = None,
+             bucket_id: Optional[int] = None,
              fuse_key: Optional[str] = None) -> None:
     """Module-level convenience for in-jit call sites (keeps the traced
-    code's import surface to one function)."""
+    code's import surface to one function).  Delegates to the recorder
+    method; tag normalization happens exactly once, in
+    :func:`_normalize_leg`."""
     _recorder.note_leg(leg, nbytes=nbytes, bucket_id=bucket_id,
                        fuse_key=fuse_key)

@@ -393,6 +393,14 @@ def _resolve_guard() -> Tuple[bool, float]:
     return _guard.step_guard()
 
 
+def _note_guard_leg():
+    """Trace-time registration of the SDC screen's one extra psum: the
+    leg row comes from the shared exchange-plan IR ("guard" family)."""
+    from .controller import fusion as _fusion
+    from .timeline import spans as _spans
+    _spans.note_leg(_fusion.plan_exchange("guard").legs[0])
+
+
 def _guard_screen_vec(grads):
     """Local half of the SDC screen: ``[nonfinite_count, sq_sum]`` f32[2].
 
@@ -573,6 +581,7 @@ def make_train_step(
             "tp": tp,
             "pipeline_stages": pipeline_stages,
             "data_mesh": tuple(int(mesh.shape[a]) for a in axes),
+            "data_axes": tuple(str(a) for a in axes),
             "mesh_shape": tuple((a, int(mesh.shape[a]))
                                 for a in mesh.axis_names),
             "param_specs": param_specs,
@@ -613,6 +622,7 @@ def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
             aux = None
         if guard:
             old_params, old_opt = params, opt_state
+            _note_guard_leg()
             gvec = _ops.allreduce(_guard_screen_vec(grads), Sum,
                                   axes=g_axes)
         if zero_stage:
@@ -643,7 +653,7 @@ def _build_local_step(loss_fn, optimizer, axes, loss_has_aux, aux_mode,
     return local_step
 
 
-def _microbatch_grad_pipe(exchange, axes):
+def _microbatch_grad_pipe(exchange, axes, k=1):
     """Build ``(accumulate, finalize)`` for the backward-overlap exchange.
 
     ``accumulate(grads, state)`` is called once per microbatch, right after
@@ -689,6 +699,17 @@ def _microbatch_grad_pipe(exchange, axes):
     pre = exchange["prescale_factor"]
     post = exchange["postscale_factor"]
 
+    def _plan(bufspec, n):
+        # One memoized plan-IR lookup shared by accumulate/finalize (and
+        # by stepmodel's expected multiset): rs rows first, then ag.
+        from .controller import fusion as _fusion
+        legs = _fusion.plan_exchange(
+            "microbatch",
+            buffers=tuple((dt, sum(s.size for s in lspecs))
+                          for dt, lspecs in bufspec),
+            k=int(k), world=int(n), compression=compression).legs
+        return legs[:len(bufspec)], legs[len(bufspec):]
+
     def accumulate(grads, state):
         leaves = jax.tree.leaves(grads)
         spec = plan_buckets(leaves, threshold, reverse=True)
@@ -696,17 +717,16 @@ def _microbatch_grad_pipe(exchange, axes):
         n = _ops.axis_size(axes)
         q = _ops.microbatch_pad_quantum(n)
         from .timeline import spans as _spans
+        rs_legs, _ag = _plan(spec.buffers, n)
         shards = []
         for i, buf in enumerate(bufs):
             c, ctx = compression.compress(buf)
             if pre != 1.0:
                 c = c * jnp.asarray(pre, dtype=c.dtype)
             # Trace-time leg registration (once per trace): the overlap
-            # RS leg's wire bytes per bucket, for straggler attribution.
-            _spans.note_leg(
-                "microbatch_rs",
-                nbytes=int(c.size) * jnp.dtype(c.dtype).itemsize,
-                bucket_id=i)
+            # RS leg's planned wire bytes per bucket, for straggler
+            # attribution (noted once per microbatch).
+            _spans.note_leg(rs_legs[i], bucket_id=i)
             shard = _ops.psum_scatter_bucket(c, axes=axes, quantum=q)
             shards.append(
                 compression.decompress(shard, ctx).astype(jnp.float32))
@@ -722,6 +742,7 @@ def _microbatch_grad_pipe(exchange, axes):
         if exchange["op"] is Average:
             scale = scale / n
         from .timeline import spans as _spans
+        _rs, ag_legs = _plan(spec.buffers, n)
         out = []
         for i, (shard, (dt, lspecs)) in enumerate(
                 zip(state, spec.buffers)):
@@ -731,10 +752,7 @@ def _microbatch_grad_pipe(exchange, axes):
             shard = shard.astype(dt)
             c2, ctx2 = compression.compress(shard)
             size = sum(s.size for s in lspecs)
-            _spans.note_leg(
-                "microbatch_ag",
-                nbytes=int(c2.size) * jnp.dtype(c2.dtype).itemsize,
-                bucket_id=i)
+            _spans.note_leg(ag_legs[i], bucket_id=i)
             full = _ops.allgather_bucket(c2, size, axes=axes)
             out.append(compression.decompress(full, ctx2))
         return jax.tree.unflatten(treedef, unpack(out, spec))
@@ -777,7 +795,7 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
     """
     ef = exchange is not None and _is_ef_exchange(exchange)
     accumulate, finalize = _microbatch_grad_pipe(
-        None if ef else exchange, axes)
+        None if ef else exchange, axes, k=k)
     g_axes = tuple(guard_axes) if guard_axes is not None else axes
 
     def local_step(params, opt_state, batch, *frozen):
@@ -812,6 +830,7 @@ def _build_microbatch_local_step(loss_fn, inner, exchange, axes,
             # opt_state here is still the incoming carry (normalized to
             # _EFState on the ef path), structure-matched to the new one.
             old_params, old_opt = params, opt_state
+            _note_guard_leg()
             gvec = _ops.allreduce(_guard_screen_vec(reduced), Sum,
                                   axes=g_axes)
         if ef:
@@ -873,7 +892,7 @@ def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
             return _softmax_xent(logits, y)
     ef = exchange is not None and _is_ef_exchange(exchange)
     accumulate, finalize = _microbatch_grad_pipe(
-        None if ef else exchange, axes)
+        None if ef else exchange, axes, k=k)
     g_axes = tuple(guard_axes) if guard_axes is not None else axes
 
     def local_step(params, batch_stats, opt_state, batch):
@@ -911,6 +930,7 @@ def _build_flax_microbatch_local_step(apply_fn, inner, exchange, loss_fn,
         reduced = finalize(state, k, grads)
         if guard:
             old_params, old_opt = params, opt_state
+            _note_guard_leg()
             gvec = _ops.allreduce(_guard_screen_vec(reduced), Sum,
                                   axes=g_axes)
         if ef:
@@ -1053,6 +1073,7 @@ def make_train_loop(
             "tp": tp,
             "pipeline_stages": pipeline_stages,
             "data_mesh": tuple(int(mesh.shape[a]) for a in axes),
+            "data_axes": tuple(str(a) for a in axes),
             "mesh_shape": tuple((a, int(mesh.shape[a]))
                                 for a in mesh.axis_names),
             "param_specs": param_specs,
@@ -1370,6 +1391,7 @@ def make_flax_train_step(
             "tp": tp,
             "pipeline_stages": pipeline_stages,
             "data_mesh": tuple(int(mesh.shape[a]) for a in axes),
+            "data_axes": tuple(str(a) for a in axes),
             "mesh_shape": tuple((a, int(mesh.shape[a]))
                                 for a in mesh.axis_names),
             "param_specs": param_specs,
@@ -1407,6 +1429,7 @@ def _build_flax_local_step(apply_fn, optimizer, loss_fn, axes, zero_stage,
         (loss, new_stats), grads = jax.value_and_grad(lf, has_aux=True)(params)
         if guard:
             old_params, old_opt = params, opt_state
+            _note_guard_leg()
             gvec = _ops.allreduce(_guard_screen_vec(grads), Sum,
                                   axes=g_axes)
         if zero_stage:
@@ -1519,6 +1542,7 @@ def make_flax_train_loop(
             "tp": tp,
             "pipeline_stages": pipeline_stages,
             "data_mesh": tuple(int(mesh.shape[a]) for a in axes),
+            "data_axes": tuple(str(a) for a in axes),
             "mesh_shape": tuple((a, int(mesh.shape[a]))
                                 for a in mesh.axis_names),
             "param_specs": param_specs,

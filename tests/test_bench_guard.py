@@ -2373,3 +2373,153 @@ def test_3d_guard_trips_on_bad_entries(tmp_path):
     assert "sharded nothing" in why
     assert "tp extent" in why
     assert "vs_baseline" in why
+
+
+def scan_planir_entries(bench_dir):
+    """Return [(path, why), ...] for malformed plan-IR entries.
+
+    A planir entry records the round-19 exchange-plan-IR drill: one
+    step's consumer plans (hier DP buckets, ZeRO arenas, serving
+    decode, MoE, the guard screen) built host-side for a virtual
+    contended-DCN mesh and issued A/B -- bandwidth-scheduled vs pure
+    program order -- through the two-link contention model.  Gates:
+    the two orders must carry a byte-identical wire payload, a warm
+    (repeat) step must replan NOTHING (cache hits only), and the
+    scheduled order must strictly cut the dispatch-gap fraction with a
+    makespan no worse than program order.  vs_baseline must be null (a
+    host-side model round has no wire peer)."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            pi = parsed.get("planir")
+            if not pi:
+                continue
+            if not pi.get("byte_identical"):
+                bad.append((path, "scheduled and program orders must "
+                                  "carry a byte-identical wire payload"))
+            replans = pi.get("replans_warm")
+            if replans != 0:
+                bad.append((path, f"a warm step must replan nothing, "
+                                  f"got replans_warm={replans!r}"))
+            hits = pi.get("hits_warm")
+            if not (isinstance(hits, int) and hits >= 1):
+                bad.append((path, f"hits_warm must be an int >= 1, got "
+                                  f"{hits!r}: the warm step never hit "
+                                  f"the plan cache"))
+            prog = pi.get("program") or {}
+            sched = pi.get("scheduled") or {}
+            pg, sg = prog.get("dispatch_gap_fraction"), \
+                sched.get("dispatch_gap_fraction")
+            if not (isinstance(pg, (int, float))
+                    and isinstance(sg, (int, float)) and sg < pg):
+                bad.append((path, f"scheduled dispatch-gap fraction "
+                                  f"must be strictly below program "
+                                  f"order's, got {sg!r} vs {pg!r}"))
+            pm, sm = prog.get("makespan_s"), sched.get("makespan_s")
+            if not (isinstance(pm, (int, float))
+                    and isinstance(sm, (int, float)) and sm <= pm):
+                bad.append((path, f"scheduled makespan must be no worse "
+                                  f"than program order, got {sm!r} vs "
+                                  f"{pm!r}"))
+            nlegs = pi.get("legs")
+            if not (isinstance(nlegs, int) and nlegs >= 2):
+                bad.append((path, f"legs must be an int >= 2, got "
+                                  f"{nlegs!r}: nothing to schedule"))
+            wire = pi.get("wire_bytes")
+            if not (isinstance(wire, int) and wire > 0):
+                bad.append((path, f"wire_bytes must be a positive int, "
+                                  f"got {wire!r}"))
+            if parsed.get("vs_baseline") is not None:
+                bad.append((path, "planir entries must carry a null "
+                                  "vs_baseline (host-side model round)"))
+    return bad
+
+
+def test_committed_planir_entries_well_formed():
+    assert scan_planir_entries(REPO) == []
+
+
+def test_committed_planir_round_passes_all_gates():
+    """Acceptance gate: a committed bench round must record the plan-IR
+    A/B with a byte-identical payload, a replan-free warm step and a
+    strict scheduled dispatch-gap cut."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            pi = (entry.get("parsed") or {}).get("planir")
+            if pi:
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries a planir block"
+    for path, parsed in found:
+        pi = parsed["planir"]
+        assert parsed["metric"] == "planir_scheduled_speedup", path
+        assert pi["byte_identical"] and pi["replans_warm"] == 0, \
+            (path, pi)
+        assert pi["scheduled"]["dispatch_gap_fraction"] \
+            < pi["program"]["dispatch_gap_fraction"], (path, pi)
+        assert pi["speedup"] >= 1.0, (path, pi)
+        assert len(pi["consumers"]) >= 3, (path, pi["consumers"])
+
+
+def _write_planir(tmp_path, name, pi, vs_baseline=None):
+    parsed = {"metric": "planir_scheduled_speedup", "value": 1.33,
+              "unit": "x", "vs_baseline": vs_baseline,
+              "config": "virtual_2x32_sched_bandwidth",
+              "baseline_config": "virtual_2x32_sched_program",
+              "planir": pi}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 19, "cmd": "BENCH_PLANIR=1 python bench.py", "rc": 0,
+         "tail": "", "parsed": parsed}))
+
+
+def _good_planir_block():
+    return {"world": 64, "mesh": [2, 32], "chip": "v5e", "legs": 27,
+            "consumers": ["hier-dp", "zero1", "serving-decode", "moe",
+                          "guard"],
+            "wire_bytes": 315150268, "byte_identical": True,
+            "plans_cold": 8, "replans_warm": 0, "hits_warm": 8,
+            "program": {"makespan_s": 0.00455,
+                        "dispatch_gap_fraction": 0.3122},
+            "scheduled": {"makespan_s": 0.003421,
+                          "dispatch_gap_fraction": 0.0851},
+            "speedup": 1.3302, "gap_drop": 0.2271}
+
+
+def test_planir_guard_accepts_good_entry(tmp_path):
+    _write_planir(tmp_path, "BENCH_r80.json", _good_planir_block())
+    assert scan_planir_entries(str(tmp_path)) == []
+    # ...and the >=0.98 gate ignores it (vs_baseline null).
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_planir_guard_trips_on_bad_entries(tmp_path):
+    _write_planir(tmp_path, "BENCH_r81.json",
+                  dict(_good_planir_block(), byte_identical=False,
+                       replans_warm=3, hits_warm=0))
+    _write_planir(tmp_path, "BENCH_r82.json",
+                  dict(_good_planir_block(),
+                       scheduled={"makespan_s": 0.005,
+                                  "dispatch_gap_fraction": 0.35},
+                       legs=1, wire_bytes=0))
+    _write_planir(tmp_path, "BENCH_r83.json", _good_planir_block(),
+                  vs_baseline=1.0)              # must be null
+    why = " ".join(w for _, w in scan_planir_entries(str(tmp_path)))
+    assert "byte-identical" in why
+    assert "replan nothing" in why
+    assert "never hit" in why
+    assert "strictly below" in why
+    assert "no worse" in why
+    assert "nothing to schedule" in why
+    assert "wire_bytes" in why
+    assert "vs_baseline" in why

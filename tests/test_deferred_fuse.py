@@ -329,7 +329,10 @@ def test_flush_plan_reuses_shared_plan_cache(hvd, monkeypatch):
     before = fusion.plan_cache_stats()
     batch()
     after = fusion.plan_cache_stats()
-    assert after["hits"] == before["hits"] + 1
+    # The repeat flush is planning-free: no new cache misses, and both
+    # the flush-unit plan and the per-bucket exchange-plan IR rows
+    # resolve as hits against the first batch's entries.
+    assert after["hits"] > before["hits"]
     assert after["misses"] == before["misses"]
     assert eager.deferred_fuse_stats()["fused_buckets"] == 2
 
