@@ -207,6 +207,30 @@ SERVING_R15_TOKENS_PER_S = 975.11
 # Purely a host-side model -> vs_baseline null; the committed entry is
 # gated by tests/test_bench_guard.py::scan_planir_entries.
 PLANIR_BENCH = _env_on("BENCH_PLANIR")
+# BENCH_FLEET=1 runs the round-20 disaggregated serving fleet drill in
+# three phases on the forced 8-way CPU host.  Parity: a 1-prefill +
+# 1-decode fleet streaming f32 KV pages over the rendezvous plane must
+# emit token streams BITWISE equal to a colocated engine on the same
+# mesh spec, with every handoff actually travelling the wire.
+# Throughput (the headline, matched 8 devices): the fleet -- prefill
+# workers on one 4-device half, the decode engine on the other -- must
+# beat the BEST single colocated engine (tp=8 and tp=4 both measured)
+# on generated tokens/s, because offloading prompt math means the
+# decode host never stalls a batch for a kilotoken prefill.  Chaos: the
+# fleet_spec surge (arrival rate DOUBLES mid-run, 3:1 arrival skew)
+# plus a prefill-host kill mid-handoff; the scaler must grow to 2
+# decode engines under live traffic (migrating queued requests), the
+# decode side must absorb the reaped KV objects via local-prefill
+# fallback, SLO-violation seconds must stay under
+# BENCH_FLEET_BUDGET_S, and BOTH decode engines must drain to zero
+# leaked pages with balanced refcounts.  CPU-mesh serving drill -> the
+# vs_baseline peer is the best colocated engine at matched device
+# count; the committed entry is gated by
+# tests/test_bench_guard.py::scan_fleet_entries.
+FLEET_BENCH = _env_on("BENCH_FLEET")
+FLEET_REQUESTS = int(os.environ.get("BENCH_FLEET_REQUESTS", "32"))
+FLEET_RATE = float(os.environ.get("BENCH_FLEET_RATE", "40"))
+FLEET_BUDGET_S = float(os.environ.get("BENCH_FLEET_BUDGET_S", "30"))
 
 
 def _config() -> str:
@@ -1093,6 +1117,247 @@ def _main_planir():
     os._exit(0 if ok else 2)
 
 
+def _main_fleet():
+    """BENCH_FLEET=1: round-20 disaggregated serving fleet drill."""
+    import dataclasses
+    from horovod_tpu.utils.platform import force_host_device_count
+    force_host_device_count(8, cpu=True)  # before jax touches the backend
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from horovod_tpu import serving
+    from horovod_tpu.models.transformer import LLAMA_SERVE, LlamaLM
+    from horovod_tpu.run.http_kv import KVClient, RendezvousServer
+    from horovod_tpu.run.secret import make_secret_key
+    from horovod_tpu.serving.fleet import _SCOPE as _fleet_scope
+
+    cfg = LLAMA_SERVE
+    model = LlamaLM(cfg, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    devs = jax.devices()
+    slots = SERVING_SLOTS
+
+    def _engine(lo, hi, page_size=8, max_len=256):
+        mesh = Mesh(np.asarray(devs[lo:hi]), ("tp",))
+        return serving.ServingEngine(
+            cfg, params, mesh=mesh, slots=slots, page_size=page_size,
+            max_len=max_len, prefetch_depth=1, prefill_chunk=0)
+
+    secret = make_secret_key()
+    srv = RendezvousServer(secret, host="127.0.0.1")
+    kv = KVClient("127.0.0.1", srv.port, secret)
+
+    def _fleet(n_prefill, lo, hi, page_size=8, max_len=256,
+               scaler_policy=None):
+        return serving.ServingFleet(
+            [serving.PrefillWorker(f"p{i}", cfg, params, kv,
+                                   page_size=page_size, tier="f32")
+             for i in range(n_prefill)],
+            [serving.DecodeWorker(
+                "decode0", _engine(lo, hi, page_size, max_len), kv)],
+            kv, scaler_policy=scaler_policy,
+            engine_factory=lambda: _engine(lo, hi, page_size, max_len))
+
+    # --- phase P: bitwise parity, disaggregated vs colocated -------------
+    # Same mesh spec both sides (tp=1): the f32 wire tier is bitwise
+    # and per-slot decode logits are batch-independent, so the streams
+    # must be bit-for-bit equal -- with every handoff on the wire.
+    par_spec = serving.fleet_spec(
+        num_requests=12, rate_rps=50.0, rate_double_at_s=0.0,
+        engine_skew=(), vocab_size=cfg.vocab_size, seed=3)
+    reqs_colo = serving.generate(par_spec)
+    _engine(0, 1).serve(reqs_colo)
+    reqs_par = serving.generate(par_spec)
+    frep_par = _fleet(1, 0, 1).serve(reqs_par)
+    bitwise = ({r.rid: list(r.tokens) for r in reqs_par}
+               == {r.rid: list(r.tokens) for r in reqs_colo})
+    parity_ok = (bitwise
+                 and frep_par.completed == par_spec.num_requests
+                 and frep_par.handoffs_streamed == frep_par.completed
+                 and frep_par.handoffs_local == 0
+                 and frep_par.kv_bytes_in == frep_par.kv_bytes_out
+                 and all(v == 0 for v in frep_par.leaked_pages.values())
+                 and frep_par.refcounts_balanced)
+    print(f"# parity: bitwise={bitwise}, "
+          f"{frep_par.handoffs_streamed} handoffs streamed, "
+          f"{frep_par.kv_bytes_in} KV bytes", file=sys.stderr)
+
+    # --- phase A: throughput at matched hardware (8 devices) -------------
+    # Kilotoken prefix-shared prompts (the round-17 mixture): prefill
+    # is the expensive regime, so colocated spends the decode host's
+    # clock on every 1056-token prompt while the fleet moves that math
+    # to the prefill half and only pays the (much cheaper) page import
+    # on the decode host.  Both single-engine shapes are measured and
+    # the fleet must beat the BEST of them.
+    tp_spec = serving.fleet_spec(
+        num_requests=FLEET_REQUESTS, rate_rps=FLEET_RATE,
+        prompt_lens=(32,), output_lens=(12, 16),
+        prefix_share=0.75, num_prefixes=2, prefix_lens=(1024,),
+        rate_double_at_s=0.0, engine_skew=(),
+        vocab_size=cfg.vocab_size, seed=7)
+    # Warm-up covers both prefill shapes {32, 1056} on every engine
+    # outside the timed runs.
+    warm = dataclasses.replace(tp_spec, num_requests=10,
+                               rate_rps=1000.0, prefix_share=0.5,
+                               seed=1)
+
+    colo = {}
+    for name, lo, hi in (("tp8", 0, 8), ("tp4", 0, 4)):
+        eng = _engine(lo, hi, page_size=16, max_len=2048)
+        eng.serve(serving.generate(warm))
+        colo[name] = eng.serve(serving.generate(tp_spec))
+        print(f"# colocated {name}: {colo[name].tokens_per_s:.1f} "
+              f"tokens/s, TTFT p99 {colo[name].ttft_p99_s * 1e3:.1f} ms",
+              file=sys.stderr)
+    best_name, best = max(colo.items(),
+                          key=lambda kv_: kv_[1].tokens_per_s)
+
+    fleet = _fleet(2, 4, 8, page_size=16, max_len=2048)
+    # Deterministic compile warm-up for both prefill shapes on BOTH
+    # workers (round-robin dispatch would otherwise leave a jit
+    # compile inside the timed run's busy clock).
+    for w in fleet.prefill_workers:
+        for tlen in (32, 1056):
+            rq = serving.Request(
+                rid=900_000 + tlen,
+                prompt=np.arange(tlen, dtype=np.int32) % cfg.vocab_size,
+                max_new_tokens=1)
+            tk = w.run(rq, jax.device_put(
+                jnp.asarray(rq.prompt, jnp.int32)), 0.0)
+            kv.delete_large(_fleet_scope, tk.key)
+    fleet.serve(serving.generate(warm))
+    frep = fleet.serve(serving.generate(tp_spec))
+    print(f"# fleet (2 prefill + decode tp4): "
+          f"{frep.tokens_per_s:.1f} tokens/s, TTFT p99 "
+          f"{frep.ttft_p99_s * 1e3:.1f} ms, "
+          f"{frep.kv_bytes_in} KV bytes streamed", file=sys.stderr)
+    thr_ok = (frep.tokens_per_s > best.tokens_per_s
+              and frep.completed == tp_spec.num_requests
+              and frep.handoffs_local == 0
+              and all(v == 0 for v in frep.leaked_pages.values())
+              and frep.refcounts_balanced)
+
+    # --- phase B: chaos -- surge + skew + prefill-host kill --------------
+    # fleet_spec doubles the arrival rate mid-run and skews arrivals
+    # 3:1; a prefill host dies at step 3 with handoffs in flight.  The
+    # scaler must commission a second decode engine under live traffic
+    # and the reaped KV objects must degrade to local prefills.
+    chaos_spec = serving.fleet_spec(num_requests=48, rate_rps=80.0,
+                                    vocab_size=cfg.vocab_size)
+    fpol = serving.FleetPolicyConfig(
+        interval_s=0.01, queue_high=4, ttft_slo_s=0.5,
+        hysteresis=2, cooldown_s=0.5, max_engines=2)
+    cfleet = _fleet(2, 4, 8,
+                    scaler_policy=serving.FleetPolicy(fpol))
+    crep = cfleet.serve(serving.generate(chaos_spec),
+                        kill_prefill_at_step=3)
+    print(f"# chaos: {crep.completed}/48 completed, engines "
+          f"{crep.engines}, migrated {crep.migrated}, handoffs "
+          f"streamed/local {crep.handoffs_streamed}/"
+          f"{crep.handoffs_local}, SLO violation "
+          f"{crep.slo_violation_s:.2f}s, leaked {crep.leaked_pages}",
+          file=sys.stderr)
+    chaos_ok = (crep.completed == chaos_spec.num_requests
+                and crep.engines == 2
+                and crep.migrated > 0
+                and crep.handoffs_local >= 1
+                and crep.handoffs_streamed >= 1
+                and crep.slo_violation_s <= FLEET_BUDGET_S
+                and all(v == 0 for v in crep.leaked_pages.values())
+                and crep.refcounts_balanced)
+
+    srv.stop()
+    ok = parity_ok and thr_ok and chaos_ok
+    print(f"# gates: parity={parity_ok} (completed "
+          f"{frep_par.completed}, balanced "
+          f"{frep_par.refcounts_balanced}), throughput={thr_ok} "
+          f"(completed {frep.completed}, local {frep.handoffs_local}, "
+          f"balanced {frep.refcounts_balanced}), chaos={chaos_ok}",
+          file=sys.stderr)
+
+    config = f"llama_serve_fleet_w8_2p_tp4decode_slots{slots}"
+    result = {
+        "metric": "fleet_tokens_per_s",
+        "value": round(frep.tokens_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(frep.tokens_per_s / best.tokens_per_s, 2)
+        if best.tokens_per_s else None,
+        "config": config,
+        "baseline_config": f"llama_serve_w8_slots{slots}_colocated_best",
+        "fleet": {
+            "world": 8,
+            "slots": slots,
+            "page_size": 16,
+            "wire_tier": "f32",
+            "parity": {
+                "requests": par_spec.num_requests,
+                "page_size": 8,
+                "bitwise_equal": bool(bitwise),
+                "handoffs_streamed": frep_par.handoffs_streamed,
+                "handoffs_local": frep_par.handoffs_local,
+                "kv_bytes": frep_par.kv_bytes_in,
+                "leaked_pages": frep_par.leaked_pages,
+            },
+            "throughput": {
+                "fleet_tokens_per_s": round(frep.tokens_per_s, 2),
+                "colocated": {n: round(r.tokens_per_s, 2)
+                              for n, r in colo.items()},
+                "best_colocated": best_name,
+                "best_colocated_tokens_per_s":
+                    round(best.tokens_per_s, 2),
+                "vs_best_colocated":
+                    round(frep.tokens_per_s / best.tokens_per_s, 4),
+                "fleet_ttft_p99_ms": round(frep.ttft_p99_s * 1e3, 3),
+                "best_colocated_ttft_p99_ms":
+                    round(best.ttft_p99_s * 1e3, 3),
+                "handoffs_streamed": frep.handoffs_streamed,
+                "kv_bytes_out": frep.kv_bytes_out,
+                "kv_bytes_in": frep.kv_bytes_in,
+                "leaked_pages": frep.leaked_pages,
+            },
+            "chaos": {
+                "requests": chaos_spec.num_requests,
+                "completed": crep.completed,
+                "engines_start": 1,
+                "engines_end": crep.engines,
+                "migrated": crep.migrated,
+                "handoffs_streamed": crep.handoffs_streamed,
+                "handoffs_local": crep.handoffs_local,
+                "slo_violation_s": round(crep.slo_violation_s, 3),
+                "slo_budget_s": FLEET_BUDGET_S,
+                "leaked_pages": crep.leaked_pages,
+                "refcounts_balanced": crep.refcounts_balanced,
+                "decisions": (cfleet.scaler.decisions
+                              if cfleet.scaler else []),
+                "policy": {
+                    "interval_s": fpol.interval_s,
+                    "queue_high": fpol.queue_high,
+                    "ttft_slo_s": fpol.ttft_slo_s,
+                    "hysteresis": fpol.hysteresis,
+                    "cooldown_s": fpol.cooldown_s,
+                    "max_engines": fpol.max_engines,
+                },
+            },
+            "load": {"rate_rps": FLEET_RATE,
+                     "num_requests": FLEET_REQUESTS,
+                     "prompt_lens": list(tp_spec.prompt_lens),
+                     "output_lens": list(tp_spec.output_lens),
+                     "prefix_share": tp_spec.prefix_share,
+                     "prefix_lens": list(tp_spec.prefix_lens),
+                     "chaos_rate_rps": chaos_spec.rate_rps,
+                     "chaos_rate_double_at_s":
+                         chaos_spec.rate_double_at_s,
+                     "chaos_engine_skew": list(chaos_spec.engine_skew),
+                     "seed": tp_spec.seed},
+        },
+    }
+    if not ok:
+        result["error"] = "fleet drill failed a gate (see fleet block)"
+    print(json.dumps(result), flush=True)
+    os._exit(0 if ok else 2)
+
+
 def _main_roofline():
     """BENCH_ROOFLINE=1: single-chip Pallas kernel roofline drill.
 
@@ -1399,6 +1664,8 @@ def main():
         _main_autoscale()
     if PLANIR_BENCH:
         _main_planir()
+    if FLEET_BENCH:
+        _main_fleet()
     if ROOFLINE_BENCH:
         _main_roofline()
     if SDC_BENCH:

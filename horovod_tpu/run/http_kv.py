@@ -236,6 +236,91 @@ class KVClient:
             self._check(f"DELETE {scope}/{key}", code)
         self._retrying(_once, f"kv DELETE {scope}/{key}")
 
+    # -- chunked bulk transfer (KV-page streaming) -------------------------
+    #
+    # A prompt's K/V pages are megabytes; one PUT of the whole payload
+    # ties a request thread up for the full transfer and makes a mid-
+    # stream failure all-or-nothing.  put_large splits the value into
+    # fixed-size parts at ``<key>.part<i>`` and writes a tiny manifest
+    # at ``<key>`` LAST, so a reader either sees no manifest (write in
+    # flight or dead) or a complete, hash-verified object -- the same
+    # commit-point discipline as the membership document.  Each part
+    # PUT/GET rides the client's RetryPolicy independently, so a driver
+    # blackout in the middle of a stream is survived per-chunk.
+
+    MANIFEST_MAGIC = "HVDL1"
+    CHUNK_BYTES = 1 << 20
+
+    def put_large(self, scope: str, key: str, value: bytes,
+                  chunk_bytes: int = 0) -> int:
+        """Chunked binary-safe PUT; returns the number of parts."""
+        import hashlib
+        import json
+        cb = int(chunk_bytes) or self.CHUNK_BYTES
+        parts = max(1, -(-len(value) // cb))  # ceil; empty value = 1 part
+        for i in range(parts):
+            self.put(scope, f"{key}.part{i}", value[i * cb:(i + 1) * cb])
+        manifest = json.dumps({
+            "v": self.MANIFEST_MAGIC, "parts": parts,
+            "bytes": len(value), "chunk_bytes": cb,
+            "sha256": hashlib.sha256(value).hexdigest()},
+            sort_keys=True).encode()
+        self.put(scope, key, manifest)
+        return parts
+
+    def get_large(self, scope: str, key: str) -> Optional[bytes]:
+        """Chunked GET: None until the manifest commits; a committed
+        manifest whose parts are missing, short, or hash-mismatched
+        raises ``ValueError`` (torn or corrupted object)."""
+        import hashlib
+        import json
+        raw = self.get(scope, key)
+        if raw is None:
+            return None
+        try:
+            m = json.loads(raw)
+            ok = m.get("v") == self.MANIFEST_MAGIC
+        except (ValueError, AttributeError):
+            ok = False
+        if not ok:
+            raise ValueError(
+                f"kv {scope}/{key}: not a chunked-object manifest")
+        chunks = []
+        for i in range(int(m["parts"])):
+            part = self.get(scope, f"{key}.part{i}")
+            if part is None:
+                raise ValueError(
+                    f"kv {scope}/{key}: manifest committed but part {i} "
+                    f"of {m['parts']} is missing")
+            chunks.append(part)
+        value = b"".join(chunks)
+        if len(value) != int(m["bytes"]):
+            raise ValueError(
+                f"kv {scope}/{key}: reassembled {len(value)} byte(s), "
+                f"manifest promises {m['bytes']}")
+        if hashlib.sha256(value).hexdigest() != m["sha256"]:
+            raise ValueError(
+                f"kv {scope}/{key}: content hash mismatch after "
+                "reassembly")
+        return value
+
+    def delete_large(self, scope: str, key: str) -> None:
+        """Delete manifest FIRST (readers stop seeing the object), then
+        the parts."""
+        import json
+        raw = self.get(scope, key)
+        parts = 0
+        if raw is not None:
+            try:
+                m = json.loads(raw)
+                if m.get("v") == self.MANIFEST_MAGIC:
+                    parts = int(m["parts"])
+            except (ValueError, AttributeError):
+                parts = 0
+        self.delete(scope, key)
+        for i in range(parts):
+            self.delete(scope, f"{key}.part{i}")
+
     def server_time(self) -> float:
         """The KV server's wall clock (seconds since the epoch), for
         NTP-style offset estimation (``timeline/sync.py``).  Retried

@@ -45,6 +45,13 @@ class RetryPolicy:
     multiplier: float = 2.0
     max_backoff_ms: float = 2000.0
     jitter: float = 0.5
+    # Total backoff-sleep budget in seconds across ALL attempts of one
+    # call (None = unbounded, the pre-PR-20 behavior).  Bulk transfers
+    # -- a multi-MiB KV-page stream is many chunked PUTs, each with its
+    # own retry loop -- use this to cap worst-case stall per chunk so a
+    # dead peer fails the handoff in bounded time instead of
+    # retries * max_backoff per chunk.
+    budget_s: Optional[float] = None
 
     @classmethod
     def from_env(cls) -> "RetryPolicy":
@@ -76,6 +83,7 @@ def call_with_retries(fn: Callable[[], T], *,
     if policy is None:
         policy = RetryPolicy.from_env()
     attempt = 0
+    slept = 0.0
     while True:
         try:
             return fn()
@@ -85,6 +93,13 @@ def call_with_retries(fn: Callable[[], T], *,
             if attempt >= policy.retries:
                 raise
             delay = policy.delay_s(attempt, rng)
+            if policy.budget_s is not None \
+                    and slept + delay > policy.budget_s:
+                # The next backoff would blow the per-call stall budget:
+                # fail NOW with the underlying error so bulk callers
+                # (chunked KV streams) see a bounded worst case.
+                raise
+            slept += delay
             logger.debug("retry %d/%d for %s after %s: %.3fs backoff",
                          attempt + 1, policy.retries, describe or "call",
                          e, delay)

@@ -12,18 +12,24 @@ the training loop uses.
 """
 
 from .controlplane import (ControlPlaneReport,  # noqa: F401
-                           ServingControlPlane)
+                           FleetScaler, ServingControlPlane)
 from .decode import (build_decode_step, build_verify_step,  # noqa: F401
                      decode_param_specs, greedy_sample, prefill_forward,
                      stack_adapters, ServingDecodeStep)
 from .engine import (RequestPrefetcher, ServingEngine,  # noqa: F401
                      ServingReport)
+from .fleet import (DecodeWorker, FleetReport,  # noqa: F401
+                    HandoffTicket, PrefillWorker, ServingFleet)
 from .kvcache import (CacheConfig, PagedKVCache,  # noqa: F401
                       PrefixCache, cache_sharding)
-from .loadgen import (LoadSpec, generate, long_prompt_spec,  # noqa: F401
-                      prefix_spec)
-from .policy import (Decision, PolicyConfig, ScalePolicy,  # noqa: F401
-                     SLOSample, valid_tp_sizes)
+from .kvwire import (WirePages, decode_kv, encode_kv,  # noqa: F401
+                     import_pages, wire_tier)
+from .loadgen import (LoadSpec, fleet_spec, generate,  # noqa: F401
+                      long_prompt_spec, prefix_spec)
+from .policy import (Decision, FleetPolicy,  # noqa: F401
+                     FleetPolicyConfig, FleetSample, PolicyConfig,
+                     ScalePolicy, SLOSample, valid_tp_sizes)
+from .router import FleetRouter  # noqa: F401
 from .scheduler import (ContinuousBatchScheduler, Request,  # noqa: F401
                         TenantClass, parse_tenant_classes)
 from .spec import ModelDrafter, NgramDrafter  # noqa: F401

@@ -55,7 +55,7 @@ from .policy import (Decision, PolicyConfig, ScalePolicy, SLOSample,
                      valid_tp_sizes)
 from .scheduler import Request
 
-__all__ = ["ServingControlPlane", "ControlPlaneReport"]
+__all__ = ["ServingControlPlane", "ControlPlaneReport", "FleetScaler"]
 
 
 class _VirtualFaults:
@@ -520,3 +520,97 @@ class ServingControlPlane:
             drain_leaked_pages=st["drain_leaked_pages"],
             slo_violation_s=st["slo_violation_s"],
             lost_requests=(len(requests) - rejected - len(completed)))
+
+
+class FleetScaler:
+    """Grow-by-adding-capacity controller for a disaggregated fleet.
+
+    The per-engine :class:`ServingControlPlane` resizes ONE engine's tp
+    mesh; the fleet scaler watches the SAME SLO signals summed across
+    every decode engine and, on a sustained breach, asks the fleet to
+    commission a whole new decode engine under live traffic
+    (``fleet.add_decode_worker``).  The fleet object is duck-typed --
+    anything with ``schedulers()`` (name -> Scheduler), ``num_engines``
+    and ``add_decode_worker(reason)`` works -- so this module never
+    imports :mod:`.fleet` (which imports us for exactly this class).
+
+    TTFT p99 is windowed fleet-wide: all engines observe into the one
+    shared ``horovod_serving_ttft_seconds`` histogram, and the scaler
+    keeps its own snapshot base so each tick sees only the TTFTs that
+    landed since the previous tick (the ``ServingControlPlane._sample``
+    pattern).
+    """
+
+    def __init__(self, fleet, policy: Optional["FleetPolicy"] = None):
+        from .policy import FleetPolicy
+        self.fleet = fleet
+        self.policy = policy or FleetPolicy()
+        self.decisions: List[dict] = []
+        self.slo_violation_s = 0.0
+        self._last_tick = 0.0
+        self._ttft_base: Any = None
+        reg = _metrics.registry()
+        self._m_decisions = reg.counter(
+            "horovod_fleet_decisions_total",
+            "Fleet scaler decisions by action", labelnames=("action",))
+        self._m_violation = reg.counter(
+            "horovod_fleet_slo_violation_seconds_total",
+            "Cumulative seconds the fleet spent outside its SLO")
+        self._m_ttft_p99 = reg.gauge(
+            "horovod_fleet_ttft_p99_seconds",
+            "Fleet-wide windowed TTFT p99 seen by the scaler")
+
+    def _fleet_p99(self) -> Optional[float]:
+        scheds = list(self.fleet.schedulers().values())
+        if not scheds:
+            return None
+        snap_fn = getattr(scheds[0]._m_ttft, "snapshot", None)
+        if snap_fn is None:
+            return None
+        curr = snap_fn()
+        win = _metrics.histogram_window(curr, self._ttft_base)
+        self._ttft_base = curr
+        return _metrics.histogram_quantile(win, 0.99)
+
+    def sample(self, now_s: float) -> "FleetSample":
+        from .policy import FleetSample
+        scheds = self.fleet.schedulers()
+        queued = sum(len(s.queue) for s in scheds.values())
+        occ = (float(np.mean([s.occupancy for s in scheds.values()]))
+               if scheds else 0.0)
+        p99 = self._fleet_p99()
+        self._m_ttft_p99.set(p99 or 0.0)
+        return FleetSample(now_s=now_s, queue_depth=queued,
+                           ttft_p99_s=p99, occupancy=occ,
+                           engines=self.fleet.num_engines)
+
+    def tick(self, now_s: float) -> Decision:
+        cfg = self.policy.config
+        if now_s - self._last_tick < cfg.interval_s:
+            return Decision("hold", "interval")
+        sample = self.sample(now_s)
+        violated = (sample.queue_depth >= cfg.queue_high
+                    or (sample.ttft_p99_s is not None
+                        and sample.ttft_p99_s > cfg.ttft_slo_s))
+        if violated:
+            dt = max(now_s - self._last_tick, 0.0)
+            self.slo_violation_s += dt
+            self._m_violation.inc(dt)
+        self._last_tick = now_s
+
+        decision = self.policy.decide(sample)
+        self._m_decisions.labels(action=decision.action).inc()
+        self.decisions.append({
+            "now_s": round(now_s, 4), "action": decision.action,
+            "reason": decision.reason,
+            "target_size": decision.target_size,
+            "queue_depth": sample.queue_depth,
+            "ttft_p99_s": sample.ttft_p99_s})
+        if decision.is_hold:
+            return decision
+        rec = _spans.recorder()
+        with rec.span("ctl", name=f"fleet:{decision.action}",
+                      leg=f"ctl/{decision.action}/{decision.reason}"):
+            self.fleet.add_decode_worker(decision.reason)
+        self.policy.mark_applied(decision, now_s)
+        return decision

@@ -386,9 +386,10 @@ class ServingEngine:
 
     def _decode_slots(self) -> List[int]:
         """Slots actually in the decode batch: live requests minus
-        still-chunking prefills (those have no resident context yet)."""
+        still-chunking prefills and pages-in-flight handoffs (neither
+        has resident context yet)."""
         return [s for s, r in self.scheduler.active.items()
-                if r.state != "prefill"]
+                if r.state not in ("prefill", "handoff")]
 
     def _quarantine_logits(self, st: Dict[str, Any], slot: int,
                            req: Request) -> None:

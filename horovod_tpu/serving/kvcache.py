@@ -498,6 +498,74 @@ class PagedKVCache:
         self._allocated[slot] = len(entries)
         self.lengths[slot] = int(length)
 
+    def adopt_pages(self, k_pages, v_pages) -> List[Tuple[str, int]]:
+        """Materialize STREAMED full pages (``[L, n, page_size, H, D]``,
+        the ``serving.kvwire`` f32 tier) as resident pool pages at
+        refcount 1, owned by the caller.  The disaggregated import path
+        then maps them into a slot with :meth:`attach_pages` and drops
+        the importer's reference -- exactly the prefix-hit flow, except
+        the bytes arrived over the rendezvous KV plane instead of being
+        computed here.  Contents are written verbatim (no requantize,
+        no cast beyond the pool dtype), so an f32-tier import is
+        bitwise identical to a local ``write_prefill``."""
+        k_pages = np.asarray(k_pages)
+        n = int(k_pages.shape[1])
+        if n == 0:
+            return []
+        short = n - len(self._free)
+        if short > 0 and self.reclaim_cb is not None:
+            self.reclaim_cb(short)
+            short = n - len(self._free)
+        if short > 0 and self.compress:
+            self._reclaim(short)
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV page pool exhausted: adopting {n} streamed "
+                f"page(s), {len(self._free)} free")
+        pids = np.asarray([self._free.pop() for _ in range(n)], np.int32)
+        for pid in pids:
+            self._refcount[pid] = 1
+        dt = jnp.dtype(self.config.dtype)
+        dev = jnp.asarray(pids)
+        self.k = self.k.at[:, dev].set(jnp.asarray(k_pages, dt))
+        self.v = self.v.at[:, dev].set(jnp.asarray(np.asarray(v_pages),
+                                                   dt))
+        return [("f", int(p)) for p in pids]
+
+    def adopt_compressed_pages(self, kq, vq, kscale, vscale
+                               ) -> List[Tuple[str, int]]:
+        """fp8 twin of :meth:`adopt_pages`: land streamed e4m3 pages +
+        per-row scales (the ``serving.kvwire`` fp8 tier, the PR 14
+        cold-page codec) straight into the compressed pool at refcount
+        1.  Because the wire quantization reuses ``_quantize_pages``'s
+        exact reshape/axis, an imported page is bit-identical to
+        :meth:`demote_page` of the same resident bytes -- the decode
+        gather blend cannot tell the two apart."""
+        if not self.compress:
+            raise RuntimeError("cache built without compress=True")
+        kq = np.asarray(kq)
+        n = int(kq.shape[1])
+        if n == 0:
+            return []
+        if n > len(self._cfree):
+            raise RuntimeError(
+                f"e4m3 pool exhausted: adopting {n} streamed cold "
+                f"page(s), {len(self._cfree)} free")
+        cpids = np.asarray([self._cfree.pop() for _ in range(n)],
+                           np.int32)
+        for cpid in cpids:
+            self._crefcount[cpid] = 1
+        cp = jnp.asarray(cpids)
+        self.kq = self.kq.at[:, cp].set(
+            jnp.asarray(kq, jnp.float8_e4m3fn))
+        self.vq = self.vq.at[:, cp].set(
+            jnp.asarray(np.asarray(vq), jnp.float8_e4m3fn))
+        self.kscale = self.kscale.at[:, cp].set(
+            jnp.asarray(np.asarray(kscale), jnp.float32))
+        self.vscale = self.vscale.at[:, cp].set(
+            jnp.asarray(np.asarray(vscale), jnp.float32))
+        return [("c", int(p)) for p in cpids]
+
     def gather_pages(self, entries: Sequence[Tuple[str, int]]) -> tuple:
         """Materialize page contents as chunked-prefill ``past``
         operands: ``(k, v)`` each ``[num_layers, 1, n * page_size,

@@ -63,6 +63,18 @@ class LoadSpec:
     # Tenant mix: ``((name, arrival_weight), ...)``; empty = everyone
     # is the single implicit "default" tenant.
     tenants: Tuple[Tuple[str, float], ...] = ()
+    # Fleet traffic shapes (PR 20; 0/empty disables, streams stay
+    # byte-identical to the PR 16 generator):
+    # * rate doubling -- arrivals at/after this offset come twice as
+    #   fast (each post-boundary gap is halved AFTER the draw, so the
+    #   underlying exponential stream is untouched), the step-function
+    #   surge the fleet scaler must absorb;
+    # * per-engine arrival skew -- each request draws an
+    #   ``engine_hint`` from these weights (one per engine), modeling
+    #   an external LB that sprays engines unevenly.  The router
+    #   honors hints verbatim, so skew stresses spill/migration.
+    rate_double_at_s: float = 0.0
+    engine_skew: Tuple[float, ...] = ()
 
     def __post_init__(self):
         if self.num_requests < 1:
@@ -96,6 +108,15 @@ class LoadSpec:
             if len(t) != 2 or not t[0] or float(t[1]) <= 0:
                 raise ValueError(
                     f"tenants entries are (name, weight > 0): {t}")
+        if self.rate_double_at_s < 0:
+            raise ValueError(
+                f"rate_double_at_s must be >= 0: {self.rate_double_at_s}")
+        if self.engine_skew and any(
+                float(w) < 0 for w in self.engine_skew):
+            raise ValueError(
+                f"engine_skew weights must be >= 0: {self.engine_skew}")
+        if self.engine_skew and sum(self.engine_skew) <= 0:
+            raise ValueError("engine_skew must have positive mass")
 
 
 def _norm(weights: Optional[Sequence[float]], n: int):
@@ -132,6 +153,20 @@ def prefix_spec(**overrides) -> LoadSpec:
     return LoadSpec(**base)
 
 
+def fleet_spec(**overrides) -> LoadSpec:
+    """The BENCH_r20 fleet chaos mixture: prefix-shared traffic that
+    DOUBLES its arrival rate partway through the run while an external
+    LB skews arrivals 3:1 toward engine 0 -- the surge + imbalance the
+    fleet router's spill path and the scaler's grow-under-traffic path
+    must absorb together."""
+    base = dict(num_requests=48, rate_rps=30.0,
+                prompt_lens=(8, 16), output_lens=(8, 16),
+                prefix_share=0.5, num_prefixes=4, prefix_lens=(64,),
+                rate_double_at_s=0.8, engine_skew=(3.0, 1.0), seed=0)
+    base.update(overrides)
+    return LoadSpec(**base)
+
+
 def generate(spec: LoadSpec) -> List[Request]:
     """Materialize the request stream for ``spec`` (sorted by arrival).
 
@@ -153,13 +188,21 @@ def generate(spec: LoadSpec) -> List[Request]:
             prefixes.append(rng.randint(
                 0, spec.vocab_size, size=plen).astype(np.int32))
     sessions_on = spec.session_share > 0 and spec.session_turns > 1
+    skw = _norm(spec.engine_skew, len(spec.engine_skew)) \
+        if spec.engine_skew else None
     open_sessions: List[dict] = []   # FIFO of {sid, ctx, turns}
     next_sid = 0
     out: List[Request] = []
     t = 0.0
     for rid in range(spec.num_requests):
-        # Poisson process: exponential inter-arrival gaps.
-        t += float(rng.exponential(1.0 / spec.rate_rps))
+        # Poisson process: exponential inter-arrival gaps.  The rate
+        # doubling halves the gap AFTER the draw, so the exponential
+        # stream (and every later draw) is byte-identical to the
+        # undoubled spec's.
+        gap = float(rng.exponential(1.0 / spec.rate_rps))
+        if spec.rate_double_at_s > 0 and t >= spec.rate_double_at_s:
+            gap *= 0.5
+        t += gap
         tenant = "default"
         if tenant_names:
             tenant = tenant_names[int(rng.choice(len(tenant_names),
@@ -196,7 +239,13 @@ def generate(spec: LoadSpec) -> List[Request]:
                 open_sessions.append(
                     {"sid": sid, "ctx": prompt, "turns": 1})
         adapter = rid % spec.num_adapters if spec.num_adapters else 0
+        # Engine skew draws LAST, so skew-free specs never touch the
+        # stream (defaults byte-identical to the PR 16 generator).
+        hint: Optional[int] = None
+        if skw is not None:
+            hint = int(rng.choice(len(skw), p=skw))
         out.append(Request(rid=rid, prompt=prompt, max_new_tokens=olen,
                            adapter_id=adapter, arrival_s=t,
-                           tenant=tenant, session_id=sid))
+                           tenant=tenant, session_id=sid,
+                           engine_hint=hint))
     return out

@@ -40,6 +40,9 @@ __all__ = [
     "Decision",
     "ScalePolicy",
     "valid_tp_sizes",
+    "FleetPolicyConfig",
+    "FleetSample",
+    "FleetPolicy",
 ]
 
 
@@ -206,3 +209,76 @@ class ScalePolicy:
         self._last_action_s = now_s
         self._breach_high = 0
         self._breach_low = 0
+
+
+# -- fleet-level policy (disaggregated serving, PR 20) ---------------------
+
+@dataclasses.dataclass(frozen=True)
+class FleetPolicyConfig:
+    """Knobs for :class:`FleetPolicy` -- the fleet-level analogue of
+    :class:`PolicyConfig`.  Where the per-engine policy moves ONE
+    engine along the tp ladder, the fleet policy adds WHOLE decode
+    engines (grow-by-adding-capacity); it never shrinks, because
+    retiring an engine under live sessions is a migration problem the
+    operator triggers explicitly."""
+
+    interval_s: float = 0.25       # fleet controller cadence
+    queue_high: int = 8            # fleet-wide queued requests = overload
+    ttft_slo_s: float = 0.5        # fleet TTFT p99 objective
+    hysteresis: int = 2            # consecutive breach samples required
+    cooldown_s: float = 1.0        # min seconds between engine adds
+    max_engines: int = 4           # hard capacity ceiling
+
+    @classmethod
+    def from_env(cls) -> "FleetPolicyConfig":
+        d = cls()
+        return cls(
+            interval_s=_env_float("FLEET_INTERVAL_S", d.interval_s),
+            queue_high=_env_int("FLEET_QUEUE_HIGH", d.queue_high),
+            ttft_slo_s=_env_float("FLEET_TTFT_SLO_S", d.ttft_slo_s),
+            hysteresis=_env_int("FLEET_HYSTERESIS", d.hysteresis),
+            cooldown_s=_env_float("FLEET_COOLDOWN_S", d.cooldown_s),
+            max_engines=_env_int("FLEET_MAX_ENGINES", d.max_engines),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSample:
+    """One fleet-controller observation: sums/percentiles across every
+    registered decode engine."""
+
+    now_s: float
+    queue_depth: int               # total queued across engines
+    ttft_p99_s: Optional[float]    # fleet-wide windowed p99 (None = none)
+    occupancy: float               # mean occupancy across engines
+    engines: int                   # decode engines currently registered
+
+
+class FleetPolicy:
+    """Add-only engine scaling with the same hysteresis + cooldown
+    debouncing :class:`ScalePolicy` uses -- a transient arrival burst
+    must not commission hardware."""
+
+    def __init__(self, config: Optional[FleetPolicyConfig] = None):
+        self.config = config or FleetPolicyConfig.from_env()
+        self._breach = 0
+        self._last_action_s = float("-inf")
+
+    def decide(self, s: FleetSample) -> Decision:
+        cfg = self.config
+        overload = (s.queue_depth >= cfg.queue_high
+                    or (s.ttft_p99_s is not None
+                        and s.ttft_p99_s > cfg.ttft_slo_s))
+        self._breach = self._breach + 1 if overload else 0
+        cooled = s.now_s - self._last_action_s >= cfg.cooldown_s
+        if (self._breach >= cfg.hysteresis and cooled
+                and s.engines < cfg.max_engines):
+            return Decision("add-engine", "fleet-slo-breach",
+                            target_size=s.engines + 1)
+        return Decision("hold", "steady")
+
+    def mark_applied(self, decision: Decision, now_s: float) -> None:
+        if decision.is_hold:
+            return
+        self._last_action_s = now_s
+        self._breach = 0

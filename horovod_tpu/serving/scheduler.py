@@ -13,16 +13,22 @@ detour used by the elastic control plane: a draining slot stops
 admitting follow-on work and its request either runs to completion
 (``completed``) or is ``suspended`` -- popped off the batch with its KV
 pages freed -- to be restored and re-prefilled on the post-resize
-mesh.  Every transition is instrumented through the PR 6
+mesh.  Disaggregated serving (PR 20) adds a ``handoff`` stop between
+``prefill`` and ``decode``: the prompt's K/V was computed on a REMOTE
+prefill worker and its pages are still in flight over the rendezvous
+KV plane, so the slot holds a request that cannot decode yet -- the
+fleet router and control plane must not count it as decoding capacity.
+Every transition is instrumented through the PR 6
 :class:`MetricsRegistry` --
 
 * ``horovod_serving_requests_total{event}`` -- submitted / admitted /
   completed / rejected / draining / suspended / reprefill transitions,
 * ``horovod_serving_tokens_total{phase}`` -- prefill vs decode tokens,
 * ``horovod_serving_queue_depth`` / ``horovod_serving_batch_occupancy``
-  gauges plus ``horovod_serving_slot_states{state}`` (active / draining
-  / free slot counts, so dashboards can tell a draining batch from an
-  idle one),
+  gauges plus ``horovod_serving_slot_states{state}`` (active / handoff
+  / draining / free slot counts, so dashboards can tell a draining
+  batch from an idle one and a pages-in-flight slot from a decoding
+  one),
 * ``horovod_serving_spec_tokens_total{outcome}`` -- speculative-decoding
   draft tokens proposed vs accepted (acceptance rate =
   accepted / proposed),
@@ -112,7 +118,8 @@ class Request:
     max_new_tokens: int
     adapter_id: int = 0
     arrival_s: float = 0.0             # open-loop arrival offset
-    state: str = "queued"              # queued|prefill|decode|draining|done
+    # queued|prefill|handoff|decode|draining|done
+    state: str = "queued"
     slot: int = -1
     tokens: List[int] = dataclasses.field(default_factory=list)
     admit_s: Optional[float] = None
@@ -121,6 +128,9 @@ class Request:
     token_latencies: List[float] = dataclasses.field(default_factory=list)
     tenant: str = "default"            # SLO class (TenantClass.name)
     session_id: Optional[int] = None   # multi-turn warm-KV session key
+    # Load-generator engine affinity hint (per-engine arrival skew in
+    # fleet traffic shapes); None = the router decides freely.
+    engine_hint: Optional[int] = None
 
     @property
     def prompt_len(self) -> int:
@@ -215,13 +225,21 @@ class ContinuousBatchScheduler:
     def draining_slots(self) -> List[int]:
         return [s for s, r in self.active.items() if r.state == "draining"]
 
+    @property
+    def handoff_slots(self) -> List[int]:
+        """Slots whose prompt K/V is computed but still in flight from
+        a remote prefill worker (disaggregated serving)."""
+        return [s for s, r in self.active.items() if r.state == "handoff"]
+
     def _update_gauges(self) -> None:
         self._m_queue.set(len(self.queue))
         self._m_occ.set(self.occupancy)
         draining = len(self.draining_slots)
+        handoff = len(self.handoff_slots)
         self._m_slot_states.labels(state="draining").set(draining)
+        self._m_slot_states.labels(state="handoff").set(handoff)
         self._m_slot_states.labels(state="active").set(
-            len(self.active) - draining)
+            len(self.active) - draining - handoff)
         self._m_slot_states.labels(state="free").set(len(self._free_slots))
         for tname in self._tenants_seen:
             self._m_tenant_occ.labels(tenant=tname).set(
@@ -321,6 +339,15 @@ class ContinuousBatchScheduler:
         self._update_gauges()
         return out
 
+    def note_handoff(self, req: Request) -> None:
+        """prefill -> handoff: a remote prefill worker computed the
+        prompt's K/V and its pages are in flight over the KV plane; the
+        slot is occupied but NOT decodable until the import lands
+        (:meth:`note_prefill` completes the transition)."""
+        req.state = "handoff"
+        self._m_requests.labels(event="handoff").inc()
+        self._update_gauges()
+
     def note_prefill(self, req: Request, now_s: float) -> None:
         """prefill done: the prompt's KV is resident and the first token
         sampled -- the request joins the decode batch."""
@@ -331,6 +358,10 @@ class ContinuousBatchScheduler:
         self._m_ttft.observe(max(now_s - req.arrival_s, 0.0))
         self._m_ttft_tenant.labels(tenant=req.tenant).observe(
             max(now_s - req.arrival_s, 0.0))
+        # The handoff -> decode transition must surface immediately:
+        # the router/control plane count handoff slots as
+        # not-yet-decodable capacity.
+        self._update_gauges()
 
     def note_decode_token(self, req: Request, latency_s: float) -> None:
         self._m_tokens.labels(phase="decode").inc()

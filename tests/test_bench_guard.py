@@ -2523,3 +2523,205 @@ def test_planir_guard_trips_on_bad_entries(tmp_path):
     assert "nothing to schedule" in why
     assert "wire_bytes" in why
     assert "vs_baseline" in why
+
+
+def scan_fleet_entries(bench_dir):
+    """Return [(path, why), ...] for malformed fleet entries.
+
+    A fleet entry records the round-20 disaggregated-serving drill:
+    prefill workers and decode engines on separate (virtual) meshes,
+    KV pages streamed over the rendezvous plane.  Gates: the parity
+    run's decode streams must be BITWISE equal to the colocated engine
+    with every handoff on the wire; the fleet must strictly beat the
+    best single colocated engine on tokens/s at matched hardware with
+    wire bytes conserved (in == out > 0); and the chaos run (surge +
+    prefill-host kill) must grow to >= 2 decode engines, complete every
+    request via >= 1 local-prefill fallback, keep SLO-violation seconds
+    inside the budget, and drain EVERY decode engine to zero leaked
+    pages with balanced refcounts."""
+    bad = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        with open(path) as f:
+            try:
+                doc = json.load(f)
+            except ValueError:
+                continue  # scan_bench_results already flags these
+        entries = doc if isinstance(doc, list) else [doc]
+        for entry in entries:
+            parsed = entry.get("parsed") or {}
+            fl = parsed.get("fleet")
+            if not fl:
+                continue
+            par = fl.get("parity") or {}
+            if not par.get("bitwise_equal"):
+                bad.append((path, "disaggregated decode streams must be "
+                                  "bitwise-equal to the colocated engine"))
+            ps, pl = par.get("handoffs_streamed"), par.get("handoffs_local")
+            if not (isinstance(ps, int) and ps >= 1 and pl == 0):
+                bad.append((path, f"the parity run must stream every "
+                                  f"handoff over the KV plane, got "
+                                  f"streamed={ps!r} local={pl!r}"))
+            thr = fl.get("throughput") or {}
+            ft, bt = thr.get("fleet_tokens_per_s"), \
+                thr.get("best_colocated_tokens_per_s")
+            if not (isinstance(ft, (int, float))
+                    and isinstance(bt, (int, float)) and 0 < bt < ft):
+                bad.append((path, f"the fleet must strictly beat the best "
+                                  f"single colocated engine on tokens/s, "
+                                  f"got {ft!r} vs {bt!r}"))
+            ko, ki = thr.get("kv_bytes_out"), thr.get("kv_bytes_in")
+            if not (isinstance(ko, int) and ko > 0 and ki == ko):
+                bad.append((path, f"streamed KV bytes must be conserved "
+                                  f"(in == out > 0), got out={ko!r} "
+                                  f"in={ki!r}"))
+            ch = fl.get("chaos") or {}
+            ng = ch.get("engines_end")
+            if not (isinstance(ng, int) and ng >= 2):
+                bad.append((path, f"the chaos run must grow the fleet to "
+                                  f">= 2 decode engines, got {ng!r}"))
+            nreq, ndone = ch.get("requests"), ch.get("completed")
+            if not (isinstance(ndone, int) and ndone >= 1
+                    and ndone == nreq):
+                bad.append((path, f"every chaos request must complete, "
+                                  f"got {ndone!r} of {nreq!r}"))
+            hl = ch.get("handoffs_local")
+            if not (isinstance(hl, int) and hl >= 1):
+                bad.append((path, f"the prefill kill must exercise the "
+                                  f"local-prefill fallback at least once, "
+                                  f"got handoffs_local={hl!r}"))
+            mig = ch.get("migrated")
+            if not (isinstance(mig, int) and mig >= 1):
+                bad.append((path, f"growing under live traffic must "
+                                  f"migrate queued requests, got "
+                                  f"migrated={mig!r}"))
+            slo, budget = ch.get("slo_violation_s"), ch.get("slo_budget_s")
+            if not (isinstance(slo, (int, float))
+                    and isinstance(budget, (int, float))
+                    and 0 <= slo <= budget):
+                bad.append((path, f"chaos SLO-violation seconds must stay "
+                                  f"inside the budget, got {slo!r} vs "
+                                  f"budget {budget!r}"))
+            leaked = ch.get("leaked_pages")
+            if not (isinstance(leaked, dict) and len(leaked) >= 2
+                    and all(v == 0 for v in leaked.values())):
+                bad.append((path, f"chaos drain must show zero leaked "
+                                  f"pages on BOTH decode engines, got "
+                                  f"{leaked!r}"))
+            if not ch.get("refcounts_balanced"):
+                bad.append((path, "chaos drain must leave page refcounts "
+                                  "balanced"))
+            for phase in ("parity", "throughput"):
+                pk = (fl.get(phase) or {}).get("leaked_pages")
+                if not (isinstance(pk, dict) and pk
+                        and all(v == 0 for v in pk.values())):
+                    bad.append((path, f"the {phase} run must drain to "
+                                      f"zero leaked pages, got {pk!r}"))
+    return bad
+
+
+def test_committed_fleet_entries_well_formed():
+    assert scan_fleet_entries(REPO) == []
+
+
+def test_committed_fleet_round_passes_all_gates():
+    """Acceptance gate: a committed bench round must record the
+    disaggregated fleet beating the best colocated engine at matched
+    hardware, bitwise parity, and the chaos drill's clean drain."""
+    found = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))):
+        try:
+            doc = json.load(open(path))
+        except ValueError:
+            continue
+        for entry in (doc if isinstance(doc, list) else [doc]):
+            fl = (entry.get("parsed") or {}).get("fleet")
+            if fl:
+                found.append((path, entry["parsed"]))
+    assert found, "no committed bench round carries a fleet block"
+    for path, parsed in found:
+        fl = parsed["fleet"]
+        assert parsed["metric"] == "fleet_tokens_per_s", path
+        assert parsed["vs_baseline"] > 1.0, (path, parsed["vs_baseline"])
+        assert fl["parity"]["bitwise_equal"], path
+        thr = fl["throughput"]
+        assert thr["fleet_tokens_per_s"] \
+            > thr["best_colocated_tokens_per_s"], (path, thr)
+        ch = fl["chaos"]
+        assert ch["engines_end"] >= 2 and ch["handoffs_local"] >= 1, \
+            (path, ch)
+        assert ch["slo_violation_s"] <= ch["slo_budget_s"], (path, ch)
+        assert set(ch["leaked_pages"].values()) == {0}, (path, ch)
+
+
+def _write_fleet(tmp_path, name, fl, vs_baseline=1.22):
+    parsed = {"metric": "fleet_tokens_per_s", "value": 91.22,
+              "unit": "tokens/s", "vs_baseline": vs_baseline,
+              "config": "llama_serve_fleet_w8_2p_tp4decode_slots8",
+              "baseline_config": "llama_serve_w8_slots8_colocated_best",
+              "fleet": fl}
+    (tmp_path / name).write_text(json.dumps(
+        {"n": 20, "cmd": "BENCH_FLEET=1 python bench.py", "rc": 0,
+         "tail": "", "parsed": parsed}))
+
+
+def _good_fleet_block():
+    return {
+        "world": 8, "slots": 8, "page_size": 16, "wire_tier": "f32",
+        "parity": {"requests": 12, "page_size": 8,
+                   "bitwise_equal": True, "handoffs_streamed": 12,
+                   "handoffs_local": 0, "kv_bytes": 1231458,
+                   "leaked_pages": {"decode0": 0}},
+        "throughput": {"fleet_tokens_per_s": 91.22,
+                       "colocated": {"tp8": 70.7, "tp4": 74.9},
+                       "best_colocated": "tp4",
+                       "best_colocated_tokens_per_s": 74.9,
+                       "vs_best_colocated": 1.218,
+                       "handoffs_streamed": 32,
+                       "kv_bytes_out": 52436000,
+                       "kv_bytes_in": 52436000,
+                       "leaked_pages": {"decode0": 0}},
+        "chaos": {"requests": 48, "completed": 48, "engines_start": 1,
+                  "engines_end": 2, "migrated": 19,
+                  "handoffs_streamed": 47, "handoffs_local": 1,
+                  "slo_violation_s": 4.01, "slo_budget_s": 30.0,
+                  "leaked_pages": {"decode0": 0, "decode1": 0},
+                  "refcounts_balanced": True},
+    }
+
+
+def test_fleet_guard_accepts_good_entry(tmp_path):
+    _write_fleet(tmp_path, "BENCH_r90.json", _good_fleet_block())
+    assert scan_fleet_entries(str(tmp_path)) == []
+    # ...and the >=0.98 gate sees a healthy 1.22 vs_baseline.
+    assert scan_bench_results(str(tmp_path), "") == []
+
+
+def test_fleet_guard_trips_on_bad_entries(tmp_path):
+    fl = _good_fleet_block()
+    fl["parity"] = dict(fl["parity"], bitwise_equal=False,
+                        handoffs_streamed=0, handoffs_local=3)
+    fl["throughput"] = dict(fl["throughput"],
+                            fleet_tokens_per_s=60.0,
+                            kv_bytes_in=1, kv_bytes_out=0,
+                            leaked_pages={"decode0": 4})
+    _write_fleet(tmp_path, "BENCH_r91.json", fl)
+    fl2 = _good_fleet_block()
+    fl2["chaos"] = dict(fl2["chaos"], engines_end=1, completed=40,
+                        handoffs_local=0, migrated=0,
+                        slo_violation_s=99.0,
+                        leaked_pages={"decode0": 2},
+                        refcounts_balanced=False)
+    _write_fleet(tmp_path, "BENCH_r92.json", fl2)
+    why = " ".join(w for _, w in scan_fleet_entries(str(tmp_path)))
+    assert "bitwise-equal" in why
+    assert "stream every" in why
+    assert "strictly beat" in why
+    assert "conserved" in why
+    assert ">= 2 decode engines" in why
+    assert "must complete" in why
+    assert "local-prefill fallback" in why
+    assert "migrate queued" in why
+    assert "inside the budget" in why
+    assert "BOTH decode engines" in why
+    assert "refcounts" in why
+    assert "throughput run must drain" in why

@@ -313,6 +313,66 @@ def test_call_with_retries_budget_and_no_retry():
     assert sleeps == []  # first attempt, no backoff burned
 
 
+def test_retry_budget_caps_cumulative_backoff():
+    """``budget_s`` bounds the total planned sleep of ONE call: the
+    attempt whose backoff would cross the budget fails immediately --
+    a bulk KV-page stream gets a bounded worst-case stall per chunk."""
+    sleeps = []
+
+    def always_down():
+        raise ConnectionError("driver gone")
+
+    # Unbudgeted: 10 retries * 100ms flat = 1.0s of planned sleep.
+    flat = RetryPolicy(retries=10, backoff_ms=100.0, multiplier=1.0,
+                       jitter=0.0)
+    with pytest.raises(ConnectionError):
+        call_with_retries(always_down, policy=flat, sleep=sleeps.append)
+    assert len(sleeps) == 10
+    # Budgeted at 0.35s: 3 x 0.1s sleeps fit, the 4th would cross.
+    sleeps.clear()
+    capped = RetryPolicy(retries=10, backoff_ms=100.0, multiplier=1.0,
+                         jitter=0.0, budget_s=0.35)
+    with pytest.raises(ConnectionError, match="driver gone"):
+        call_with_retries(always_down, policy=capped,
+                          sleep=sleeps.append)
+    assert len(sleeps) == 3 and abs(sum(sleeps) - 0.3) < 1e-9
+    # A call that succeeds within budget is unaffected.
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert call_with_retries(flaky, policy=capped,
+                             sleep=lambda s: None) == "ok"
+
+
+def test_chunked_kv_rides_out_blackout_at_page_sizes():
+    """The KV-page streaming transport survives a driver blackout
+    mid-stream: every chunk PUT/GET retries independently, so a
+    payload of realistic page sizes lands intact through a 503
+    window."""
+    secret = make_secret_key()
+    srv = RendezvousServer(secret, host="127.0.0.1")
+    try:
+        policy = RetryPolicy(retries=6, backoff_ms=50.0, multiplier=1.5,
+                             max_backoff_ms=200.0, jitter=0.0)
+        kv = KVClient("127.0.0.1", srv.port, secret, retry_policy=policy)
+        # One LLAMA_SERVE-geometry prompt's framed pages: L=2 layers x
+        # 24 tokens x 8 kv-heads x 16 head-dim x (K+V) x f32 ~ 50 KiB;
+        # chunk at 16 KiB so the stream is several parts.
+        value = bytes(np.random.RandomState(0).bytes(
+            2 * 24 * 8 * 16 * 2 * 4))
+        srv.blackout(0.3)
+        kv.put_large("pages", "r0", value, chunk_bytes=16_384)
+        srv.blackout(0.3)
+        assert kv.get_large("pages", "r0") == value
+    finally:
+        srv.stop()
+
+
 def test_kv_client_rides_out_server_blackout():
     """A simulated driver outage (503 window) is survived by the retry
     policy; a wrong secret still fails on the FIRST attempt."""

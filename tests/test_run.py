@@ -306,6 +306,53 @@ def test_http_kv_roundtrip_and_auth():
         srv.stop()
 
 
+def test_http_kv_chunked_large_object_roundtrip():
+    """put_large/get_large: binary-safe chunked transfer with a
+    commit-last manifest and sha256 verification -- the KV-page
+    streaming transport."""
+    import json
+    from horovod_tpu.run.http_kv import KVClient, RendezvousServer
+    from horovod_tpu.run.secret import make_secret_key
+    secret = make_secret_key()
+    srv = RendezvousServer(secret, host="127.0.0.1")
+    try:
+        kv = KVClient("127.0.0.1", srv.port, secret)
+        # Binary payload (every byte value, not valid UTF-8), larger
+        # than the chunk size and NOT a multiple of it.
+        value = bytes(range(256)) * 1021
+        parts = kv.put_large("pages", "obj", value, chunk_bytes=50_000)
+        assert parts == -(-len(value) // 50_000) and parts >= 2
+        assert kv.get_large("pages", "obj") == value
+        # The manifest commits LAST: the raw key holds JSON, parts are
+        # separate keys.
+        m = json.loads(kv.get("pages", "obj"))
+        assert m["parts"] == parts and m["bytes"] == len(value)
+        assert kv.get("pages", "obj.part0") == value[:50_000]
+        # Absent object -> None (not an error): reader polls until the
+        # manifest commits.
+        assert kv.get_large("pages", "missing") is None
+        # Tampered part -> hash mismatch ValueError.
+        kv.put("pages", "obj.part1", b"X" * 50_000)
+        with pytest.raises(ValueError, match="hash mismatch"):
+            kv.get_large("pages", "obj")
+        # Missing part -> torn-object ValueError.
+        kv.delete("pages", "obj.part1")
+        with pytest.raises(ValueError, match="part 1"):
+            kv.get_large("pages", "obj")
+        # A plain (non-manifest) value read through get_large is
+        # rejected, not misparsed.
+        kv.put("pages", "plain", b"\x00\x01raw")
+        with pytest.raises(ValueError, match="manifest"):
+            kv.get_large("pages", "plain")
+        # delete_large removes manifest + parts.
+        kv.put_large("pages", "obj", value, chunk_bytes=50_000)
+        kv.delete_large("pages", "obj")
+        assert kv.get("pages", "obj") is None
+        assert kv.get("pages", "obj.part0") is None
+    finally:
+        srv.stop()
+
+
 def test_notifier_reads_assignment_over_http(monkeypatch):
     import json
     from horovod_tpu.elastic.notify import ASSIGNMENT_KEY, Notifier
